@@ -1,0 +1,36 @@
+"""racon_tpu — TPU-native consensus / polishing framework.
+
+A ground-up re-design of the capabilities of NVIDIA-Genomics-Research/racon-gpu
+for TPU hardware: the two compute hot spots (pairwise read<->contig alignment and
+per-window partial-order-alignment consensus) run as batched, fixed-shape JAX/XLA
+programs sharded over a TPU mesh; the host pipeline (parsing, overlap filtering,
+windowing, stitching) mirrors the reference's semantics
+(reference: src/polisher.cpp, src/overlap.cpp, src/window.cpp).
+
+Public API (mirrors reference src/polisher.hpp:42-57):
+    create_polisher(...) -> Polisher
+    Polisher.initialize()
+    Polisher.polish(drop_unpolished_sequences) -> list[Sequence]
+"""
+
+from .errors import RaconError
+from .core.sequence import Sequence, create_sequence
+from .core.overlap import Overlap
+from .core.window import Window, WindowType, create_window
+from .core.polisher import Polisher, PolisherType, create_polisher
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "RaconError",
+    "Sequence",
+    "create_sequence",
+    "Overlap",
+    "Window",
+    "WindowType",
+    "create_window",
+    "Polisher",
+    "PolisherType",
+    "create_polisher",
+    "__version__",
+]

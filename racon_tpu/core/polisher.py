@@ -1,0 +1,357 @@
+"""Polisher: whole-pipeline orchestration.
+
+parse -> filter -> align (device) -> window -> POA consensus (device) -> stitch.
+
+Mirrors the reference pipeline semantics (src/polisher.cpp:192-548) while
+replacing both compute hot spots with batched XLA programs:
+
+  - overlap CIGARs: ops/align.BatchAligner  (vs edlib / cudaaligner)
+  - window consensus: ops/poa.BatchPOA      (vs spoa / cudapoa)
+
+The reference's CPU/GPU split (Polisher vs CUDAPolisher,
+src/cuda/cudapolisher.cpp) becomes a single Polisher whose device batches run
+wherever JAX is pointed (TPU chip(s) or CPU), optionally sharded over a mesh
+(parallel/mesh.py) — the TPU-native equivalent of its multi-GPU batch loop.
+"""
+
+from __future__ import annotations
+
+import enum
+import sys
+
+import numpy as np
+
+from ..errors import RaconError
+from ..io.parsers import create_sequence_parser, create_overlap_parser
+from ..utils.logger import Logger
+from ..utils.cigar import cigar_from_ops
+from .sequence import Sequence, create_sequence
+from .window import Window, WindowType, create_window
+
+KCHUNK_SIZE = 1024 * 1024 * 1024  # reference polisher.cpp:26
+
+
+class PolisherType(enum.Enum):
+    kC = 0  # contig polishing
+    kF = 1  # fragment (read) error correction
+
+
+def create_polisher(sequences_path: str, overlaps_path: str, target_path: str,
+                    type_: PolisherType, window_length: int,
+                    quality_threshold: float, error_threshold: float,
+                    trim: bool = True, match: int = 3, mismatch: int = -5,
+                    gap: int = -4, num_threads: int = 1,
+                    tpu_poa_batches: int = 0, tpu_banded_alignment: bool = True,
+                    tpu_aligner_batches: int = 0,
+                    tpu_aligner_band_width: int = 0) -> "Polisher":
+    """Factory mirroring reference createPolisher (polisher.cpp:55-160).
+
+    The tpu_* knobs parallel the reference's CUDA flags (main.cpp:36-41); the
+    device path is always available, so they tune batching rather than select
+    a different subclass.
+    """
+    if not isinstance(type_, PolisherType):
+        raise RaconError("createPolisher", "invalid polisher type!")
+    if window_length == 0:
+        raise RaconError("createPolisher", "invalid window length!")
+
+    sparser = create_sequence_parser(sequences_path, "createPolisher")
+    oparser = create_overlap_parser(overlaps_path, "createPolisher")
+    tparser = create_sequence_parser(target_path, "createPolisher")
+
+    return Polisher(sparser, oparser, tparser, type_, window_length,
+                    quality_threshold, error_threshold, trim, match, mismatch,
+                    gap, num_threads, tpu_poa_batches, tpu_banded_alignment,
+                    tpu_aligner_batches, tpu_aligner_band_width)
+
+
+class Polisher:
+    def __init__(self, sparser, oparser, tparser, type_: PolisherType,
+                 window_length: int, quality_threshold: float,
+                 error_threshold: float, trim: bool, match: int, mismatch: int,
+                 gap: int, num_threads: int = 1, tpu_poa_batches: int = 0,
+                 tpu_banded_alignment: bool = True, tpu_aligner_batches: int = 0,
+                 tpu_aligner_band_width: int = 0):
+        self.sparser = sparser
+        self.oparser = oparser
+        self.tparser = tparser
+        self.type = type_
+        self.window_length = window_length
+        self.quality_threshold = quality_threshold
+        self.error_threshold = error_threshold
+        self.trim = trim
+        self.match = match
+        self.mismatch = mismatch
+        self.gap = gap
+        self.num_threads = num_threads
+        self.tpu_poa_batches = tpu_poa_batches
+        self.tpu_banded_alignment = tpu_banded_alignment
+        self.tpu_aligner_batches = tpu_aligner_batches
+        self.tpu_aligner_band_width = tpu_aligner_band_width
+
+        self.sequences: list[Sequence] = []
+        self.windows: list[Window] = []
+        self.targets_coverages: list[int] = []
+        self.dummy_quality = b"!" * window_length
+        self.logger = Logger()
+        self._num_targets = 0
+
+    # ------------------------------------------------------------------ init
+    def initialize(self) -> None:
+        if self.windows:
+            print("[racon_tpu::Polisher.initialize] warning: "
+                  "object already initialized!", file=sys.stderr)
+            return
+
+        log = self.logger
+        log.log()
+
+        # -- targets (loaded whole; reference polisher.cpp:202-217)
+        self.tparser.reset()
+        self.tparser.parse(self.sequences, -1)
+        targets_size = len(self.sequences)
+        self._num_targets = targets_size
+        if targets_size == 0:
+            raise RaconError("Polisher.initialize", "empty target sequences set!")
+
+        name_to_id: dict[str, int] = {}
+        id_to_id: dict[int, int] = {}
+        for i in range(targets_size):
+            name_to_id[self.sequences[i].name + "t"] = i
+            id_to_id[i << 1 | 1] = i
+
+        has_name = [True] * targets_size
+        has_data = [True] * targets_size
+        has_reverse_data = [False] * targets_size
+
+        log.log("[racon_tpu::Polisher.initialize] loaded target sequences")
+        log.log()
+
+        # -- reads streamed in chunks; duplicates of targets share storage
+        #    (reference polisher.cpp:228-264)
+        sequences_size = 0
+        total_sequences_length = 0
+        self.sparser.reset()
+        more = True
+        while more:
+            start = len(self.sequences)
+            more = self.sparser.parse(self.sequences, KCHUNK_SIZE)
+            kept: list[Sequence] = []
+            for seq in self.sequences[start:]:
+                total_sequences_length += len(seq.data)
+                tgt = name_to_id.get(seq.name + "t")
+                if tgt is not None:
+                    dup = self.sequences[tgt]
+                    if len(seq.data) != len(dup.data) or \
+                       len(seq.quality) != len(dup.quality):
+                        raise RaconError(
+                            "Polisher.initialize",
+                            f"duplicate sequence {seq.name} with unequal data")
+                    name_to_id[seq.name + "q"] = tgt
+                    id_to_id[sequences_size << 1 | 0] = tgt
+                else:
+                    gid = start + len(kept)
+                    name_to_id[seq.name + "q"] = gid
+                    id_to_id[sequences_size << 1 | 0] = gid
+                    kept.append(seq)
+                sequences_size += 1
+            del self.sequences[start:]
+            self.sequences.extend(kept)
+
+        if sequences_size == 0:
+            raise RaconError("Polisher.initialize", "empty sequences set!")
+
+        n_seqs = len(self.sequences)
+        has_name += [False] * (n_seqs - targets_size)
+        has_data += [False] * (n_seqs - targets_size)
+        has_reverse_data += [False] * (n_seqs - targets_size)
+
+        window_type = (WindowType.kNGS
+                       if total_sequences_length / sequences_size <= 1000
+                       else WindowType.kTGS)
+
+        log.log("[racon_tpu::Polisher.initialize] loaded sequences")
+        log.log()
+
+        # -- overlaps streamed; per-query filtering (polisher.cpp:284-355)
+        overlaps = self._load_overlaps(name_to_id, id_to_id,
+                                       has_data, has_reverse_data)
+        if not overlaps:
+            raise RaconError("Polisher.initialize", "empty overlap set!")
+
+        log.log("[racon_tpu::Polisher.initialize] loaded overlaps")
+        log.log()
+
+        # -- free unneeded storage; build revcomps where needed
+        for i, seq in enumerate(self.sequences):
+            seq.transmute(has_name[i], has_data[i], has_reverse_data[i])
+
+        self.find_overlap_breaking_points(overlaps)
+
+        log.log()
+
+        # -- windows (polisher.cpp:384-399)
+        id_to_first_window_id = [0] * (targets_size + 1)
+        for i in range(targets_size):
+            data = self.sequences[i].data
+            quality = self.sequences[i].quality
+            k = 0
+            for j in range(0, len(data), self.window_length):
+                length = min(j + self.window_length, len(data)) - j
+                q = quality[j:j + length] if quality else self.dummy_quality[:length]
+                self.windows.append(create_window(
+                    i, k, window_type, data[j:j + length], q))
+                k += 1
+            id_to_first_window_id[i + 1] = id_to_first_window_id[i] + k
+
+        self.targets_coverages = [0] * targets_size
+
+        # -- layer assignment (polisher.cpp:403-457)
+        wl = self.window_length
+        for o in overlaps:
+            self.targets_coverages[o.t_id] += 1
+            seq = self.sequences[o.q_id]
+            bps = o.breaking_points
+            if bps is None:
+                continue
+            qual_fwd = seq.quality
+            has_qual = bool(qual_fwd) or bool(seq._reverse_quality)
+            if o.strand:
+                data_src = seq.reverse_complement
+                qual_src = seq.reverse_quality if has_qual else None
+            else:
+                data_src = seq.data
+                qual_src = qual_fwd if has_qual else None
+            qual_arr = (np.frombuffer(qual_src, dtype=np.uint8)
+                        if qual_src else None)
+
+            for t_first, q_first, t_last1, q_last1 in bps:
+                if q_last1 - q_first < 0.02 * wl:
+                    continue
+                if qual_arr is not None:
+                    avg = float(qual_arr[q_first:q_last1].mean()) - 33.0
+                    if avg < self.quality_threshold:
+                        continue
+                window_id = id_to_first_window_id[o.t_id] + t_first // wl
+                window_start = (t_first // wl) * wl
+                data = data_src[q_first:q_last1]
+                qual = (qual_src[q_first:q_last1] if qual_src else None)
+                self.windows[window_id].add_layer(
+                    data, qual, int(t_first - window_start),
+                    int(t_last1 - window_start - 1))
+            o.breaking_points = None
+
+        log.log("[racon_tpu::Polisher.initialize] transformed data into windows")
+
+    def _load_overlaps(self, name_to_id, id_to_id, has_data, has_reverse_data):
+        overlaps: list = []
+        error_threshold = self.error_threshold
+        is_kc = self.type == PolisherType.kC
+
+        def filter_group(group: list) -> list:
+            """Drop high-error/self overlaps; for contig polishing keep only
+            the longest overlap per query (polisher.cpp:284-308)."""
+            kept = [o for o in group
+                    if o.error <= error_threshold and o.q_id != o.t_id]
+            if is_kc and kept:
+                kept = [max(kept, key=lambda o: o.length)]
+            return kept
+
+        self.oparser.reset()
+        pending: list = []   # current same-q_id run
+        more = True
+        while more:
+            chunk: list = []
+            more = self.oparser.parse(chunk, KCHUNK_SIZE)
+            for o in chunk:
+                o.transmute(self.sequences, name_to_id, id_to_id)
+                if not o.is_valid:
+                    continue
+                if pending and pending[0].q_id != o.q_id:
+                    for f in filter_group(pending):
+                        overlaps.append(f)
+                        if f.strand:
+                            has_reverse_data[f.q_id] = True
+                        else:
+                            has_data[f.q_id] = True
+                    pending = []
+                pending.append(o)
+        for f in filter_group(pending):
+            overlaps.append(f)
+            if f.strand:
+                has_reverse_data[f.q_id] = True
+            else:
+                has_data[f.q_id] = True
+        return overlaps
+
+    # ------------------------------------------------------- alignment phase
+    def find_overlap_breaking_points(self, overlaps: list) -> None:
+        """Align CIGAR-less overlaps in device batches, then walk all CIGARs
+        into per-window breaking points (reference polisher.cpp:462-484 /
+        cudapolisher.cpp:74-214)."""
+        from ..ops.align import BatchAligner
+
+        need = [o for o in overlaps if not o.cigar]
+        if need:
+            pairs = []
+            for o in need:
+                q_span = o.aligned_query_span(self.sequences)
+                t_span = self.sequences[o.t_id].data[o.t_begin:o.t_end]
+                pairs.append((q_span, t_span))
+            aligner = BatchAligner(band_width=self.tpu_aligner_band_width)
+            runs = aligner.align(pairs)
+            skipped = 0
+            for o, r in zip(need, runs):
+                if r is None:
+                    skipped += 1
+                    o.is_valid = False  # capacity-rejected; no CPU path yet
+                    continue
+                o.cigar = cigar_from_ops(r).encode()
+            if skipped:
+                print(f"[racon_tpu::Polisher.align] {skipped} overlaps "
+                      "exceeded aligner capacity and were skipped",
+                      file=sys.stderr)
+
+        for o in overlaps:
+            if o.is_valid and o.cigar:
+                o.find_breaking_points(self.sequences, self.window_length)
+
+        self.logger.log("[racon_tpu::Polisher.initialize] aligned overlaps")
+
+    # ---------------------------------------------------------------- polish
+    def polish(self, drop_unpolished_sequences: bool = True) -> list[Sequence]:
+        from ..ops.poa import BatchPOA
+
+        self.logger.log()
+
+        engine = BatchPOA(self.match, self.mismatch, self.gap,
+                          self.window_length)
+        engine.generate_consensus(self.windows, self.trim)
+
+        dst: list[Sequence] = []
+        polished_data = bytearray()
+        num_polished_windows = 0
+
+        for i, window in enumerate(self.windows):
+            num_polished_windows += 1 if window.polished else 0
+            polished_data += window.consensus
+
+            last = (i == len(self.windows) - 1
+                    or self.windows[i + 1].rank == 0)
+            if last:
+                ratio = num_polished_windows / float(window.rank + 1)
+                if not drop_unpolished_sequences or ratio > 0:
+                    tags = "r" if self.type == PolisherType.kF else ""
+                    tags += f" LN:i:{len(polished_data)}"
+                    tags += f" RC:i:{self.targets_coverages[window.id]}"
+                    tags += f" XC:f:{ratio:.6f}"
+                    dst.append(create_sequence(
+                        self.sequences[window.id].name + tags,
+                        bytes(polished_data)))
+                num_polished_windows = 0
+                polished_data = bytearray()
+
+        self.logger.log("[racon_tpu::Polisher.polish] generated consensus")
+        self.windows = []
+        self.sequences = []
+        return dst

@@ -1,0 +1,15 @@
+"""Error type for user-facing failures.
+
+The reference hard-exits with a diagnostic prefix `[racon::Class::method] error: ...`
+(e.g. src/polisher.cpp:206-209, src/overlap.cpp:148-153, src/window.cpp:19-23).
+We raise RaconError with the same message shape; the CLI converts it to
+stderr + exit(1) so the observable behavior matches.
+"""
+
+
+class RaconError(RuntimeError):
+    """User-facing error carrying a `[racon_tpu::Scope] error: ...` message."""
+
+    def __init__(self, scope: str, message: str):
+        self.scope = scope
+        super().__init__(f"[racon_tpu::{scope}] error: {message}")

@@ -1,0 +1,19 @@
+from .parsers import (
+    FastaParser,
+    FastqParser,
+    MhapParser,
+    PafParser,
+    SamParser,
+    create_sequence_parser,
+    create_overlap_parser,
+)
+
+__all__ = [
+    "FastaParser",
+    "FastqParser",
+    "MhapParser",
+    "PafParser",
+    "SamParser",
+    "create_sequence_parser",
+    "create_overlap_parser",
+]

@@ -1,0 +1,54 @@
+"""Base-space encoding for device kernels.
+
+Sequences live as ASCII bytes on the host; device kernels work on small int8
+codes so comparisons vectorize and tensors stay narrow: A=0, C=1, G=2, T=3,
+everything else (N, IUPAC) = 4. Code 4 compares equal to itself, matching the
+reference's char-equality semantics ('N' vs 'N' is a match for spoa/edlib).
+PAD=5 never matches anything, including itself.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+A, C, G, T, N, PAD = 0, 1, 2, 3, 4, 5
+
+_LUT = np.full(256, N, dtype=np.int8)
+for i, b in enumerate(b"ACGT"):
+    _LUT[b] = i
+_DECODE = np.frombuffer(b"ACGTN-", dtype=np.uint8)
+
+
+def encode(seq: bytes) -> np.ndarray:
+    """ASCII bytes -> int8 codes."""
+    return _LUT[np.frombuffer(seq, dtype=np.uint8)]
+
+
+def decode(codes: np.ndarray) -> bytes:
+    """int8 codes -> ASCII bytes (PAD renders as '-')."""
+    return _DECODE[np.asarray(codes, dtype=np.int64)].tobytes()
+
+
+def encode_padded(seqs: list[bytes], length: int) -> tuple[np.ndarray, np.ndarray]:
+    """Encode a batch of sequences into a [len(seqs), length] int8 array
+    padded with PAD; returns (codes, lengths)."""
+    out = np.full((len(seqs), length), PAD, dtype=np.int8)
+    lens = np.empty(len(seqs), dtype=np.int32)
+    for i, s in enumerate(seqs):
+        n = min(len(s), length)
+        out[i, :n] = _LUT[np.frombuffer(s, dtype=np.uint8)[:n]]
+        lens[i] = n
+    return out, lens
+
+
+def phred_weights(quality: bytes | None, length: int, pad_to: int) -> np.ndarray:
+    """Phred+33 quality -> int32 weights (char - 33), like the reference GPU
+    path (src/cuda/cudabatch.cpp:182-191). None -> weight 1 per base (spoa's
+    qual-less default)."""
+    out = np.zeros(pad_to, dtype=np.int32)
+    if quality is None:
+        out[:length] = 1
+    else:
+        q = np.frombuffer(quality, dtype=np.uint8).astype(np.int32) - 33
+        out[: len(q)] = q
+    return out

@@ -1,0 +1,46 @@
+"""Phase timing + progress logging to stderr.
+
+Mirrors the reference Logger (src/logger.cpp:20-54): `log()` opens a timing
+section, `log(msg)` closes it printing elapsed seconds, `bar(msg)` renders a
+fixed 20-bin progress bar, `total(msg)` prints cumulative elapsed time.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+
+class Logger:
+    def __init__(self):
+        self._time = 0.0
+        self._bar = 0
+        self._total = 0.0
+
+    def log(self, msg: str | None = None) -> None:
+        now = time.perf_counter()
+        if msg is None:
+            self._time = now
+            return
+        elapsed = now - self._time
+        self._total += elapsed
+        print(f"{msg} {elapsed:.5f} s", file=sys.stderr)
+        self._time = now
+
+    def bar(self, msg: str) -> None:
+        self._bar = min(self._bar + 1, 20)
+        filled = "=" * self._bar + (">" if self._bar < 20 else "")
+        sys.stderr.write(f"{msg} [{filled:<20}] {self._bar * 5}%")
+        if self._bar == 20:
+            elapsed = time.perf_counter() - self._time
+            self._total += elapsed
+            sys.stderr.write(f" {elapsed:.5f} s\n")
+            self._bar = 0
+            self._time = time.perf_counter()
+        else:
+            sys.stderr.write("\r")
+        sys.stderr.flush()
+
+    def total(self, msg: str) -> None:
+        elapsed = self._total + (time.perf_counter() - self._time if self._bar else 0)
+        print(f"{msg} {self._total:.5f} s", file=sys.stderr)

@@ -1,0 +1,26 @@
+"""Test configuration.
+
+Force the CPU backend with 8 virtual devices BEFORE jax initializes, so
+sharding/mesh tests exercise the multi-chip code paths without TPU hardware
+(the driver separately dry-runs the multi-chip path the same way).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pathlib
+
+import pytest
+
+REFERENCE_DATA = pathlib.Path("/root/reference/test/data")
+
+
+@pytest.fixture(scope="session")
+def reference_data():
+    if not REFERENCE_DATA.is_dir():
+        pytest.skip("reference test data not available")
+    return REFERENCE_DATA

