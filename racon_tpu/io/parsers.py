@@ -95,6 +95,10 @@ class FastaParser(_StreamingParser):
 
 class FastqParser(_StreamingParser):
     def _records(self, f):
+        """Multi-line (wrapped) FASTQ: sequence lines accumulate until the
+        '+' separator, quality lines until their length reaches the sequence
+        length — the reference's bioparser contract (its own
+        test/data/sample_reads.fastq.gz is line-wrapped)."""
         while True:
             header = f.readline()
             if not header:
@@ -104,10 +108,29 @@ class FastqParser(_StreamingParser):
                 continue
             if not header.startswith(b"@"):
                 raise RaconError("FastqParser", f"malformed FASTQ file {self.path}!")
-            data = f.readline().rstrip()
-            plus = f.readline()
-            quality = f.readline().rstrip()
-            if not plus.startswith(b"+"):
+            chunks: list[bytes] = []
+            while True:
+                line = f.readline()
+                if not line:
+                    raise RaconError("FastqParser",
+                                     f"malformed FASTQ file {self.path}!")
+                line = line.rstrip()
+                if line.startswith(b"+"):
+                    break
+                chunks.append(line)
+            data = b"".join(chunks)
+            qchunks: list[bytes] = []
+            qlen = 0
+            while qlen < len(data):
+                line = f.readline()
+                if not line:
+                    raise RaconError("FastqParser",
+                                     f"malformed FASTQ file {self.path}!")
+                line = line.rstrip()  # Phred+33 bytes are never whitespace
+                qchunks.append(line)
+                qlen += len(line)
+            quality = b"".join(qchunks)
+            if len(quality) != len(data):
                 raise RaconError("FastqParser", f"malformed FASTQ file {self.path}!")
             name = _first_token(header[1:])
             yield Sequence(name, data, quality), len(name) + len(data) + len(quality)

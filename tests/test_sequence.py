@@ -22,7 +22,7 @@ def test_reverse_complement_lazy():
 
 def test_non_acgt_untouched_by_complement():
     s = Sequence("r1", b"ANRA")
-    assert s.reverse_complement == b"ARNT"
+    assert s.reverse_complement == b"TRNT"
 
 
 def test_transmute_frees_fields():
