@@ -286,30 +286,53 @@ class Polisher:
 
     # ------------------------------------------------------- alignment phase
     def find_overlap_breaking_points(self, overlaps: list) -> None:
-        """Align CIGAR-less overlaps in device batches, then walk all CIGARs
-        into per-window breaking points (reference polisher.cpp:462-484 /
-        cudapolisher.cpp:74-214)."""
-        from ..ops.align import BatchAligner
+        """Align CIGAR-less overlaps, then walk all CIGARs into per-window
+        breaking points (reference polisher.cpp:462-484 /
+        cudapolisher.cpp:74-214).
 
-        need = [o for o in overlaps if not o.cigar]
+        Default path is the host exact aligner (the edlib role). With
+        tpu_aligner_batches > 0 the batched device kernel handles everything
+        it can and the host aligns the rejects — the reference's GPU->CPU
+        fallback (cudapolisher.cpp:203-213): no overlap is ever dropped.
+        """
+        from ..native import nw_cigar_batch
+
+        need = [o for o in overlaps if not o.cigar and o.is_valid]
         if need:
             pairs = []
             for o in need:
                 q_span = o.aligned_query_span(self.sequences)
                 t_span = self.sequences[o.t_id].data[o.t_begin:o.t_end]
                 pairs.append((q_span, t_span))
-            aligner = BatchAligner(band_width=self.tpu_aligner_band_width)
-            runs = aligner.align(pairs)
-            skipped = 0
+
+            self.logger.bar_total(len(pairs))
+            bar_msg = "[racon_tpu::Polisher.initialize] aligning overlaps"
+
+            def bar_n(n):
+                for _ in range(n):
+                    self.logger.bar(bar_msg)
+
+            runs = [None] * len(pairs)
+            if self.tpu_aligner_batches > 0:
+                from ..ops.align import BatchAligner
+                aligner = BatchAligner(band_width=self.tpu_aligner_band_width)
+                runs = aligner.align(pairs, progress=bar_n)
+
+            # host exact aligner for everything the device didn't take —
+            # the reference's GPU->CPU fallback (cudapolisher.cpp:203-213)
+            rest = [i for i, r in enumerate(runs) if r is None]
+            if rest:
+                cigars = nw_cigar_batch([pairs[i] for i in rest],
+                                        n_threads=self.num_threads,
+                                        progress=bar_n)
+                for i, c in zip(rest, cigars):
+                    need[i].cigar = c
             for o, r in zip(need, runs):
-                if r is None:
-                    skipped += 1
-                    o.is_valid = False  # capacity-rejected; no CPU path yet
-                    continue
-                o.cigar = cigar_from_ops(r).encode()
-            if skipped:
-                print(f"[racon_tpu::Polisher.align] {skipped} overlaps "
-                      "exceeded aligner capacity and were skipped",
+                if r is not None:
+                    o.cigar = cigar_from_ops(r).encode()
+            if self.tpu_aligner_batches > 0 and rest:
+                print(f"[racon_tpu::Polisher.initialize] {len(rest)} overlaps "
+                      "aligned on host (device capacity fallback)",
                       file=sys.stderr)
 
         for o in overlaps:
@@ -325,7 +348,10 @@ class Polisher:
         self.logger.log()
 
         engine = BatchPOA(self.match, self.mismatch, self.gap,
-                          self.window_length)
+                          self.window_length, num_threads=self.num_threads,
+                          device_batches=self.tpu_poa_batches,
+                          band_width=self.tpu_aligner_band_width,
+                          logger=self.logger)
         engine.generate_consensus(self.windows, self.trim)
 
         dst: list[Sequence] = []

@@ -76,11 +76,11 @@ class Window:
         return sorted(range(1, len(self.sequences)),
                       key=lambda i: self.positions[i][0])
 
-    def apply_trim(self, consensus: bytes, coverages) -> None:
+    def apply_trim(self, consensus: bytes, coverages, trim: bool = True) -> None:
         """Post-consensus coverage trim for TGS windows (window.cpp:118-139)."""
         self.consensus = consensus
         self.polished = True
-        if self.type != WindowType.kTGS:
+        if self.type != WindowType.kTGS or not trim:
             return
         average_coverage = (len(self.sequences) - 1) // 2
         begin, end = 0, len(consensus) - 1

@@ -1,0 +1,253 @@
+"""Native host library: POA engine, exact aligner, threaded batch API.
+
+C++ equivalents of the reference's vendored native dependencies (SURVEY.md
+§2b): spoa (POA graph + consensus), edlib (exact NW + CIGAR), thread_pool
+(worker pool inside the batch entry point). Loaded through ctypes — no
+pybind11; the shared object is built on demand with g++ and cached next to
+the sources (rebuilt when any source is newer).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import pathlib
+import subprocess
+import threading
+
+import numpy as np
+
+_DIR = pathlib.Path(__file__).resolve().parent
+_SRC = _DIR / "src"
+_LIB = _DIR / "libracon_host.so"
+_SOURCES = ("poa.cpp", "nw.cpp", "api.cpp")
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+
+
+def _needs_build() -> bool:
+    if not _LIB.exists():
+        return True
+    lib_mtime = _LIB.stat().st_mtime
+    return any((_SRC / s).stat().st_mtime > lib_mtime for s in _SOURCES)
+
+
+def build(force: bool = False) -> pathlib.Path:
+    """Compile the shared library if missing or stale."""
+    with _lock:
+        if force or _needs_build():
+            cmd = [
+                os.environ.get("CXX", "g++"),
+                "-O3", "-std=c++17", "-fPIC", "-shared", "-pthread",
+                "-o", str(_LIB),
+            ] + [str(_SRC / s) for s in _SOURCES]
+            subprocess.run(cmd, check=True, capture_output=True, text=True)
+    return _LIB
+
+
+def get_lib() -> ctypes.CDLL:
+    global _lib
+    if _lib is None:
+        build()
+        lib = ctypes.CDLL(str(_LIB))
+        i64, i32, u8p = ctypes.c_int64, ctypes.c_int32, ctypes.POINTER(ctypes.c_uint8)
+        i64p, i32p = ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int32)
+        u32p = ctypes.POINTER(ctypes.c_uint32)
+
+        lib.rh_edit_distance.restype = i64
+        lib.rh_edit_distance.argtypes = [u8p, i64, u8p, i64]
+        lib.rh_nw_cigar.restype = i64
+        lib.rh_nw_cigar.argtypes = [u8p, i64, u8p, i64, ctypes.c_char_p, i64]
+        lib.rh_nw_cigar_batch.restype = None
+        lib.rh_nw_cigar_batch.argtypes = [
+            u8p, i64p, u8p, i64p, i64, i32, ctypes.c_char_p, i64, i64p,
+        ]
+        lib.rh_poa_batch.restype = i64
+        lib.rh_poa_batch.argtypes = [
+            u8p, i64p, u8p, i64p, i32p, i32p, i64p, i64,
+            i32p, i32p, i64p,
+            i32, i32, i32, i32,
+            u8p, u32p, i64, i64p,
+        ]
+        _lib = lib
+    return _lib
+
+
+def _u8(data: bytes | np.ndarray):
+    arr = np.frombuffer(data, dtype=np.uint8) if isinstance(data, (bytes, bytearray)) else data
+    return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), arr
+
+
+def edit_distance(a: bytes, b: bytes) -> int:
+    """Exact edit distance (adaptive-band NW) — the metric role edlib plays
+    in reference test/racon_test.cpp:16-25."""
+    lib = get_lib()
+    pa, ka = _u8(a)
+    pb, kb = _u8(b)
+    return int(lib.rh_edit_distance(pa, len(a), pb, len(b)))
+
+
+def nw_cigar(query: bytes, target: bytes) -> bytes:
+    """Global alignment CIGAR of query vs target, unit costs — the edlib NW
+    path role (reference src/overlap.cpp:205-224)."""
+    lib = get_lib()
+    pq, kq = _u8(query)
+    pt, kt = _u8(target)
+    cap = 4 * (len(query) + len(target)) + 64
+    buf = ctypes.create_string_buffer(cap)
+    n = int(lib.rh_nw_cigar(pq, len(query), pt, len(target), buf, cap))
+    if n < 0:
+        raise RuntimeError("rh_nw_cigar failed")
+    return buf.raw[:n]
+
+
+def nw_cigar_batch(pairs, n_threads: int = 1, progress=None,
+                   chunk: int = 256):
+    """Globally align many (query, target) pairs on the host thread pool.
+
+    Returns a list of CIGAR bytes (parallel to `pairs`). `progress(n)` is
+    called after each internal chunk completes.
+    """
+    lib = get_lib()
+    out: list[bytes | None] = [None] * len(pairs)
+    for s in range(0, len(pairs), chunk):
+        part = pairs[s:s + chunk]
+        q_off = np.zeros(len(part) + 1, dtype=np.int64)
+        t_off = np.zeros(len(part) + 1, dtype=np.int64)
+        for i, (q, t) in enumerate(part):
+            q_off[i + 1] = q_off[i] + len(q)
+            t_off[i + 1] = t_off[i] + len(t)
+        q_data = np.frombuffer(b"".join(q for q, _ in part) or b"\x00",
+                               dtype=np.uint8)
+        t_data = np.frombuffer(b"".join(t for _, t in part) or b"\x00",
+                               dtype=np.uint8)
+        slot = 4 * int(max(q_off[-1] // max(len(part), 1),
+                           t_off[-1] // max(len(part), 1)) + 1) + 64
+        lens = np.empty(len(part), dtype=np.int64)
+        while True:
+            buf = ctypes.create_string_buffer(slot * len(part))
+            lib.rh_nw_cigar_batch(
+                q_data.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+                q_off.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                t_data.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+                t_off.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                len(part), n_threads, buf, slot,
+                lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+            if (lens >= 0).all():
+                break
+            slot = int(-lens[lens < 0].min()) + 64
+        raw = buf.raw
+        for i in range(len(part)):
+            out[s + i] = raw[i * slot:i * slot + int(lens[i])]
+        if progress is not None:
+            progress(len(part))
+    return out
+
+
+def poa_batch(windows, match: int, mismatch: int, gap: int,
+              n_threads: int = 1, prealigned=None):
+    """Batched per-window POA consensus.
+
+    Args:
+      windows: list of windows; each is a list of (seq_bytes, qual_bytes|None,
+        begin, end) with element 0 the backbone.
+      prealigned: optional list (parallel to windows) of per-layer alignments;
+        each window entry is a list (parallel to its sequences, [0] ignored)
+        of (nodes int32 array, poss int32 array) or None for "engine-align".
+        All-or-nothing per call: either every layer of every window has a
+        path, or pass None.
+
+    Returns:
+      list of (consensus bytes, coverages uint32 array) per window.
+    """
+    lib = get_lib()
+    n_windows = len(windows)
+    if n_windows == 0:
+        return []
+
+    seq_parts, qual_parts = [], []
+    seq_off = [0]
+    qual_off = [0]
+    begins, ends = [], []
+    win_off = [0]
+    for win in windows:
+        for seq, qual, b, e in win:
+            seq_parts.append(seq)
+            seq_off.append(seq_off[-1] + len(seq))
+            if qual is not None:
+                qual_parts.append(qual)
+                qual_off.append(qual_off[-1] + len(qual))
+            else:
+                qual_off.append(qual_off[-1])
+            begins.append(b)
+            ends.append(e)
+        win_off.append(win_off[-1] + len(win))
+
+    seq_data = np.frombuffer(b"".join(seq_parts), dtype=np.uint8)
+    qual_data = np.frombuffer(b"".join(qual_parts) or b"\x00", dtype=np.uint8)
+    seq_off_a = np.asarray(seq_off, dtype=np.int64)
+    qual_off_a = np.asarray(qual_off, dtype=np.int64)
+    begins_a = np.asarray(begins, dtype=np.int32)
+    ends_a = np.asarray(ends, dtype=np.int32)
+    win_off_a = np.asarray(win_off, dtype=np.int64)
+
+    if prealigned is not None:
+        nodes_parts, pos_parts = [], []
+        aln_off = [0]
+        for w, win in enumerate(windows):
+            for i in range(len(win)):
+                entry = prealigned[w][i] if i > 0 else None
+                if entry is None:
+                    aln_off.append(aln_off[-1])
+                else:
+                    nodes, poss = entry
+                    nodes_parts.append(np.asarray(nodes, dtype=np.int32))
+                    pos_parts.append(np.asarray(poss, dtype=np.int32))
+                    aln_off.append(aln_off[-1] + len(nodes_parts[-1]))
+        aln_nodes = (np.concatenate(nodes_parts) if nodes_parts
+                     else np.empty(0, dtype=np.int32))
+        aln_pos = (np.concatenate(pos_parts) if pos_parts
+                   else np.empty(0, dtype=np.int32))
+        aln_off_a = np.asarray(aln_off, dtype=np.int64)
+        aln_args = (
+            aln_nodes.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            aln_pos.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            aln_off_a.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        )
+        keep = (aln_nodes, aln_pos, aln_off_a)
+    else:
+        aln_args = (None, None, None)
+        keep = ()
+
+    cons_cap = 2 * int(seq_off_a[-1]) + 64 * n_windows
+    cons_off = np.empty(n_windows + 1, dtype=np.int64)
+    while True:
+        cons_data = np.empty(cons_cap, dtype=np.uint8)
+        cov_data = np.empty(cons_cap, dtype=np.uint32)
+        total = int(lib.rh_poa_batch(
+            seq_data.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            seq_off_a.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            qual_data.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            qual_off_a.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            begins_a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            ends_a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            win_off_a.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            n_windows,
+            *aln_args,
+            match, mismatch, gap, n_threads,
+            cons_data.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            cov_data.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+            cons_cap,
+            cons_off.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        ))
+        if total >= 0:
+            break
+        cons_cap = -total
+    del keep
+
+    out = []
+    for w in range(n_windows):
+        a, b = int(cons_off[w]), int(cons_off[w + 1])
+        out.append((cons_data[a:b].tobytes(), cov_data[a:b].copy()))
+    return out

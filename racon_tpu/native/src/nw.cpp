@@ -1,0 +1,202 @@
+// Adaptive-band global alignment (edit distance + CIGAR) on the host.
+//
+// The host-exact-aligner role edlib plays in the reference
+// (src/overlap.cpp:205-224: NW mode, unit costs, CIGAR path): used as the
+// fallback for pairs the device aligner rejects (too long / band overflow),
+// mirroring the reference's GPU->CPU fallback (src/cuda/cudapolisher.cpp:203-213).
+//
+// Algorithm: banded NW over a band of half-width `hw` centered on the main
+// diagonal j == i. If the computed distance d satisfies d <= hw, every cell
+// of an optimal path has |i - j| <= d <= hw, i.e. the path never leaves the
+// band and the result is exact (Ukkonen's condition); otherwise the band is
+// doubled and the DP re-run. 2-bit backpointers are stored per row for the
+// traceback. Deterministic tie order: diagonal < up (I) < left (D).
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+namespace racon_host {
+
+namespace {
+constexpr int32_t kInf = 1 << 29;
+enum : uint8_t { BP_DIAG = 0, BP_UP = 1, BP_LEFT = 2 };
+}  // namespace
+
+// Banded DP. Returns distance, or -1 if the band was inconclusive.
+// When `bp` is non-null it receives packed 2-bit backpointers,
+// (m + 1) rows x band cells (4 per byte).
+static int64_t banded_pass(const uint8_t* q, int64_t m, const uint8_t* t,
+                           int64_t n, int64_t hw, std::vector<uint8_t>* bp,
+                           int64_t* band_out) {
+    const int64_t band = 2 * hw + 1;
+    if (band_out != nullptr) {
+        *band_out = band;
+    }
+    const int64_t bpb = (band + 3) / 4;  // bytes per row
+    if (bp != nullptr) {
+        bp->assign(static_cast<size_t>(m + 1) * bpb, 0);
+    }
+
+    // row i covers columns j in [i - hw, i + hw]
+    std::vector<int32_t> prev(band, kInf), cur(band, kInf);
+    for (int64_t k = 0; k <= std::min(hw, n); ++k) {
+        prev[hw + k] = static_cast<int32_t>(k);  // row 0: D[0][j] = j
+        if (bp != nullptr && k > 0) {
+            (*bp)[static_cast<size_t>(hw + k) >> 2] |=
+                BP_LEFT << (((hw + k) & 3) * 2);
+        }
+    }
+
+    for (int64_t i = 1; i <= m; ++i) {
+        uint8_t* row_bp =
+            bp != nullptr ? bp->data() + static_cast<size_t>(i) * bpb : nullptr;
+        const int64_t lo = std::max<int64_t>(0, i - hw);
+        const int64_t hi = std::min(n, i + hw);
+        std::fill(cur.begin(), cur.end(), kInf);
+        for (int64_t j = lo; j <= hi; ++j) {
+            const int64_t k = j - i + hw;  // band cell for (i, j)
+            // neighbors: diag (i-1, j-1) -> prev[k]; up (i-1, j) -> prev[k+1];
+            // left (i, j-1) -> cur[k-1]
+            int32_t best;
+            uint8_t code;
+            if (j > 0) {
+                best = prev[k] + (q[i - 1] != t[j - 1] ? 1 : 0);
+                code = BP_DIAG;
+            } else {
+                best = kInf;
+                code = BP_UP;
+            }
+            if (k + 1 < band) {
+                const int32_t up = prev[k + 1] + 1;
+                if (up < best) {
+                    best = up;
+                    code = BP_UP;
+                }
+            }
+            if (j > 0 && k >= 1) {
+                const int32_t left = cur[k - 1] + 1;
+                if (left < best) {
+                    best = left;
+                    code = BP_LEFT;
+                }
+            }
+            cur[k] = best;
+            if (row_bp != nullptr) {
+                row_bp[k >> 2] |= code << ((k & 3) * 2);
+            }
+        }
+        std::swap(prev, cur);
+    }
+
+    const int64_t k_end = n - m + hw;
+    if (k_end < 0 || k_end >= band) {
+        return -1;
+    }
+    const int64_t d = prev[k_end];
+    if (d > hw) {
+        return -1;  // band may have clipped the optimum
+    }
+    return d;
+}
+
+// Append "<len><op>" to dst.
+static void emit_run(std::vector<char>& dst, int64_t len, char op) {
+    if (len <= 0) return;
+    char buf[24];
+    int k = 0;
+    while (len > 0) {
+        buf[k++] = static_cast<char>('0' + len % 10);
+        len /= 10;
+    }
+    while (k > 0) dst.push_back(buf[--k]);
+    dst.push_back(op);
+}
+
+int64_t nw_align(const uint8_t* q, int64_t m, const uint8_t* t, int64_t n,
+                 std::vector<char>* cigar) {
+    if (m == 0 || n == 0) {
+        if (cigar != nullptr) {
+            cigar->clear();
+            if (m > 0) emit_run(*cigar, m, 'I');
+            if (n > 0) emit_run(*cigar, n, 'D');
+        }
+        return m + n;
+    }
+
+    int64_t hw = std::max<int64_t>({16, std::max(m, n) / 64,
+                                    std::llabs(m - n) + 8});
+    std::vector<uint8_t> bp;
+    int64_t band = 0, d = -1;
+    const int64_t hw_cap = m + n;
+    while (true) {
+        d = banded_pass(q, m, t, n, hw, cigar != nullptr ? &bp : nullptr,
+                        &band);
+        if (d >= 0 || hw >= hw_cap) {
+            break;
+        }
+        hw = std::min(hw * 2, hw_cap);
+    }
+    if (d < 0) {
+        return -1;  // cannot happen with hw == m + n, defensive
+    }
+    if (cigar == nullptr) {
+        return d;
+    }
+
+    // traceback
+    cigar->clear();
+    const int64_t bpb = (band + 3) / 4;
+    std::vector<char> rev_ops;
+    rev_ops.reserve(m + n);
+    int64_t i = m, j = n;
+    while (i > 0 || j > 0) {
+        uint8_t code;
+        if (i == 0) {
+            code = BP_LEFT;
+        } else if (j == 0) {
+            code = BP_UP;
+        } else {
+            const int64_t k = j - i + hw;
+            code = (bp[static_cast<size_t>(i) * bpb + (k >> 2)] >>
+                    ((k & 3) * 2)) & 3;
+        }
+        switch (code) {
+            case BP_DIAG:
+                rev_ops.push_back('M');
+                --i;
+                --j;
+                break;
+            case BP_UP:
+                rev_ops.push_back('I');
+                --i;
+                break;
+            default:
+                rev_ops.push_back('D');
+                --j;
+                break;
+        }
+    }
+    // run-length encode in forward order
+    char last = 0;
+    int64_t run = 0;
+    for (int64_t s = static_cast<int64_t>(rev_ops.size()) - 1; s >= 0; --s) {
+        if (rev_ops[s] == last) {
+            ++run;
+        } else {
+            emit_run(*cigar, run, last);
+            last = rev_ops[s];
+            run = 1;
+        }
+    }
+    emit_run(*cigar, run, last);
+    return d;
+}
+
+int64_t edit_distance(const uint8_t* a, int64_t m, const uint8_t* b,
+                      int64_t n) {
+    return nw_align(a, m, b, n, nullptr);
+}
+
+}  // namespace racon_host

@@ -1,0 +1,474 @@
+#include "poa.hpp"
+
+#include <algorithm>
+#include <cassert>
+#include <cstring>
+#include <deque>
+#include <limits>
+
+namespace racon_host {
+
+static uint8_t make_code(int c) {
+    switch (c) {
+        case 'A': return 0;
+        case 'C': return 1;
+        case 'G': return 2;
+        case 'T': return 3;
+        default: return 4;
+    }
+}
+
+const uint8_t kBaseCode[256] = {
+    4,4,4,4,4,4,4,4,4,4,4,4,4,4,4,4, 4,4,4,4,4,4,4,4,4,4,4,4,4,4,4,4,
+    4,4,4,4,4,4,4,4,4,4,4,4,4,4,4,4, 4,4,4,4,4,4,4,4,4,4,4,4,4,4,4,4,
+    4,0,4,1,4,4,4,2,4,4,4,4,4,4,4,4, 4,4,4,4,3,4,4,4,4,4,4,4,4,4,4,4,
+    4,0,4,1,4,4,4,2,4,4,4,4,4,4,4,4, 4,4,4,4,3,4,4,4,4,4,4,4,4,4,4,4,
+    4,4,4,4,4,4,4,4,4,4,4,4,4,4,4,4, 4,4,4,4,4,4,4,4,4,4,4,4,4,4,4,4,
+    4,4,4,4,4,4,4,4,4,4,4,4,4,4,4,4, 4,4,4,4,4,4,4,4,4,4,4,4,4,4,4,4,
+    4,4,4,4,4,4,4,4,4,4,4,4,4,4,4,4, 4,4,4,4,4,4,4,4,4,4,4,4,4,4,4,4,
+    4,4,4,4,4,4,4,4,4,4,4,4,4,4,4,4, 4,4,4,4,4,4,4,4,4,4,4,4,4,4,4,4,
+};
+const char kCodeBase[6] = {'A', 'C', 'G', 'T', 'N', '-'};
+
+int32_t Graph::add_node(uint8_t code, int32_t bpos) {
+    nodes.push_back(Node{code, bpos, 0, {}, {}, {}});
+    return static_cast<int32_t>(nodes.size()) - 1;
+}
+
+void Graph::add_edge(int32_t tail, int32_t head, int64_t weight) {
+    // merge with an existing parallel edge (in-degrees are small)
+    for (int32_t ei : nodes[head].in) {
+        if (edges[ei].tail == tail) {
+            edges[ei].weight += weight;
+            return;
+        }
+    }
+    int32_t ei = static_cast<int32_t>(edges.size());
+    edges.push_back(Edge{tail, head, weight});
+    nodes[tail].out.push_back(ei);
+    nodes[head].in.push_back(ei);
+}
+
+void Graph::add_alignment(const Alignment& aln, const uint8_t* seq,
+                          int32_t len, const uint32_t* weights) {
+    if (len <= 0) {
+        return;
+    }
+    const bool backbone = nodes.empty();
+
+    // Build the per-position node path, then connect consecutive path nodes
+    // with edges weighted w[i-1] + w[i] (the endpoint-weight-sum convention
+    // the reference GPU adapter mirrors with Phred int8 weights,
+    // src/cuda/cudabatch.cpp:182-191).
+    std::vector<int32_t> path(len, -1);
+
+    int32_t first = -1, last = -1;
+    for (const auto& p : aln) {
+        if (p.pos >= 0) {
+            if (first < 0) first = p.pos;
+            last = p.pos;
+        }
+    }
+
+    if (first < 0) {
+        // no aligned bases: whole sequence becomes a fresh path
+        for (int32_t i = 0; i < len; ++i) {
+            path[i] = add_node(kBaseCode[seq[i]], backbone ? i : 0);
+        }
+    } else {
+        // aligned middle
+        int32_t col_bpos = 0;  // bpos of the last visited column
+        bool col_seen = false;
+        for (const auto& p : aln) {
+            if (p.pos < 0) continue;
+            const uint8_t code = kBaseCode[seq[p.pos]];
+            int32_t cur;
+            if (p.node < 0) {
+                // insertion relative to the graph
+                cur = add_node(code, col_seen ? col_bpos : -1);
+            } else {
+                Node& q = nodes[p.node];
+                col_bpos = q.bpos;
+                col_seen = true;
+                if (q.code == code) {
+                    cur = p.node;
+                } else {
+                    cur = -1;
+                    for (int32_t a : q.aligned) {
+                        if (nodes[a].code == code) {
+                            cur = a;
+                            break;
+                        }
+                    }
+                    if (cur < 0) {
+                        cur = add_node(code, q.bpos);
+                        // register in the column: cur <-> node and all its
+                        // aligned alternates
+                        std::vector<int32_t> column = nodes[p.node].aligned;
+                        column.push_back(p.node);
+                        for (int32_t a : column) {
+                            nodes[a].aligned.push_back(cur);
+                            nodes[cur].aligned.push_back(a);
+                        }
+                    }
+                }
+                nodes[cur].bpos = nodes[cur].bpos;  // keep column bpos
+            }
+            path[p.pos] = cur;
+        }
+        // backfill bpos for leading insertions that preceded any column
+        if (col_seen) {
+            int32_t fill = -1;
+            for (int32_t i = last; i >= first; --i) {
+                if (path[i] >= 0 && nodes[path[i]].bpos >= 0) {
+                    fill = nodes[path[i]].bpos;
+                } else if (path[i] >= 0 && nodes[path[i]].bpos < 0) {
+                    nodes[path[i]].bpos = fill;
+                }
+            }
+        }
+        // unaligned prefix / suffix become fresh chains inheriting the bpos
+        // of the nearest aligned column
+        int32_t pre_bpos = path[first] >= 0 ? nodes[path[first]].bpos : 0;
+        for (int32_t i = 0; i < first; ++i) {
+            path[i] = add_node(kBaseCode[seq[i]], pre_bpos);
+        }
+        int32_t suf_bpos = path[last] >= 0 ? nodes[path[last]].bpos : 0;
+        for (int32_t i = last + 1; i < len; ++i) {
+            path[i] = add_node(kBaseCode[seq[i]], suf_bpos);
+        }
+    }
+
+    for (int32_t i = 0; i < len; ++i) {
+        nodes[path[i]].n_seqs += 1;
+    }
+    for (int32_t i = 1; i < len; ++i) {
+        const int64_t w = static_cast<int64_t>(weights[i - 1]) + weights[i];
+        add_edge(path[i - 1], path[i], w);
+    }
+}
+
+std::vector<int32_t> Graph::topo_order() const {
+    const int32_t n = static_cast<int32_t>(nodes.size());
+    std::vector<int32_t> indeg(n);
+    for (int32_t i = 0; i < n; ++i) {
+        indeg[i] = static_cast<int32_t>(nodes[i].in.size());
+    }
+    std::deque<int32_t> q;
+    for (int32_t i = 0; i < n; ++i) {
+        if (indeg[i] == 0) q.push_back(i);
+    }
+    std::vector<int32_t> order;
+    order.reserve(n);
+    while (!q.empty()) {
+        int32_t v = q.front();
+        q.pop_front();
+        order.push_back(v);
+        for (int32_t ei : nodes[v].out) {
+            int32_t h = edges[ei].head;
+            if (--indeg[h] == 0) q.push_back(h);
+        }
+    }
+    assert(static_cast<int32_t>(order.size()) == n && "graph has a cycle");
+    return order;
+}
+
+static constexpr int32_t kNegInf = std::numeric_limits<int32_t>::min() / 4;
+
+Alignment Graph::align_nw(const uint8_t* seq, int32_t len, int32_t match,
+                          int32_t mismatch, int32_t gap) const {
+    Alignment out;
+    const int32_t n = static_cast<int32_t>(nodes.size());
+    if (n == 0 || len <= 0) {
+        return out;
+    }
+
+    const std::vector<int32_t> order = topo_order();
+    std::vector<int32_t> rank_of(n);
+    for (int32_t r = 0; r < n; ++r) {
+        rank_of[order[r]] = r;
+    }
+
+    // H is (n + 1) x (len + 1); row 0 is the virtual source.
+    const int64_t stride = len + 1;
+    std::vector<int32_t> H(static_cast<size_t>(n + 1) * stride);
+    for (int32_t j = 0; j <= len; ++j) {
+        H[j] = j * gap;
+    }
+
+    std::vector<int32_t> pred_rows;  // predecessor row indices, reused
+    for (int32_t r = 1; r <= n; ++r) {
+        const Node& node = nodes[order[r - 1]];
+        int32_t* row = &H[static_cast<size_t>(r) * stride];
+
+        pred_rows.clear();
+        for (int32_t ei : node.in) {
+            pred_rows.push_back(rank_of[edges[ei].tail] + 1);
+        }
+        if (pred_rows.empty()) {
+            pred_rows.push_back(0);
+        }
+
+        // initialize from the first predecessor, then fold the rest in
+        {
+            const int32_t* prow = &H[static_cast<size_t>(pred_rows[0]) * stride];
+            row[0] = prow[0] + gap;
+            for (int32_t j = 1; j <= len; ++j) {
+                const int32_t sub =
+                    (kBaseCode[seq[j - 1]] == node.code) ? match : mismatch;
+                int32_t best = prow[j - 1] + sub;           // diagonal
+                const int32_t vert = prow[j] + gap;          // graph gap
+                if (vert > best) best = vert;
+                row[j] = best;
+            }
+        }
+        for (size_t pi = 1; pi < pred_rows.size(); ++pi) {
+            const int32_t* prow = &H[static_cast<size_t>(pred_rows[pi]) * stride];
+            if (prow[0] + gap > row[0]) row[0] = prow[0] + gap;
+            for (int32_t j = 1; j <= len; ++j) {
+                const int32_t sub =
+                    (kBaseCode[seq[j - 1]] == node.code) ? match : mismatch;
+                int32_t best = prow[j - 1] + sub;
+                const int32_t vert = prow[j] + gap;
+                if (vert > best) best = vert;
+                if (best > row[j]) row[j] = best;
+            }
+        }
+        // horizontal pass (sequence gap) — must run after all predecessors
+        for (int32_t j = 1; j <= len; ++j) {
+            const int32_t horiz = row[j - 1] + gap;
+            if (horiz > row[j]) row[j] = horiz;
+        }
+    }
+
+    // best sink row at the final column (ties -> smallest rank)
+    int32_t best_r = -1, best_score = kNegInf;
+    for (int32_t r = 1; r <= n; ++r) {
+        if (!nodes[order[r - 1]].out.empty()) continue;
+        const int32_t s = H[static_cast<size_t>(r) * stride + len];
+        if (s > best_score) {
+            best_score = s;
+            best_r = r;
+        }
+    }
+    if (best_r < 0) {  // no sink (can't happen in a DAG with nodes)
+        return out;
+    }
+
+    // traceback; preference: diagonal, vertical, horizontal (deterministic)
+    int32_t r = best_r, j = len;
+    while (r != 0 || j != 0) {
+        const int32_t cur = H[static_cast<size_t>(r) * stride + j];
+        bool moved = false;
+        if (r != 0) {
+            const Node& node = nodes[order[r - 1]];
+            pred_rows.clear();
+            for (int32_t ei : node.in) {
+                pred_rows.push_back(rank_of[edges[ei].tail] + 1);
+            }
+            if (pred_rows.empty()) {
+                pred_rows.push_back(0);
+            }
+            if (j > 0) {
+                const int32_t sub =
+                    (kBaseCode[seq[j - 1]] == node.code) ? match : mismatch;
+                for (int32_t pr : pred_rows) {
+                    if (H[static_cast<size_t>(pr) * stride + j - 1] + sub == cur) {
+                        out.push_back(AlnPair{order[r - 1], j - 1});
+                        r = pr;
+                        --j;
+                        moved = true;
+                        break;
+                    }
+                }
+            }
+            if (!moved) {
+                for (int32_t pr : pred_rows) {
+                    if (H[static_cast<size_t>(pr) * stride + j] + gap == cur) {
+                        out.push_back(AlnPair{order[r - 1], -1});
+                        r = pr;
+                        moved = true;
+                        break;
+                    }
+                }
+            }
+        }
+        if (!moved) {
+            // horizontal (consume sequence base against no node)
+            out.push_back(AlnPair{-1, j - 1});
+            --j;
+        }
+    }
+    std::reverse(out.begin(), out.end());
+    return out;
+}
+
+Graph Graph::subgraph(int32_t begin, int32_t end,
+                      std::vector<int32_t>& mapping) const {
+    const int32_t n = static_cast<int32_t>(nodes.size());
+    std::vector<int32_t> full_to_sub(n, -1);
+    mapping.clear();
+    for (int32_t i = 0; i < n; ++i) {
+        if (nodes[i].bpos >= begin && nodes[i].bpos <= end) {
+            full_to_sub[i] = static_cast<int32_t>(mapping.size());
+            mapping.push_back(i);
+        }
+    }
+
+    Graph sub;
+    sub.nodes.reserve(mapping.size());
+    for (int32_t fi : mapping) {
+        const Node& src = nodes[fi];
+        Node dst;
+        dst.code = src.code;
+        dst.bpos = src.bpos;
+        dst.n_seqs = src.n_seqs;
+        for (int32_t a : src.aligned) {
+            if (full_to_sub[a] >= 0) dst.aligned.push_back(full_to_sub[a]);
+        }
+        sub.nodes.push_back(std::move(dst));
+    }
+    for (const Edge& e : edges) {
+        const int32_t t = full_to_sub[e.tail], h = full_to_sub[e.head];
+        if (t >= 0 && h >= 0) {
+            sub.add_edge(t, h, e.weight);
+        }
+    }
+    return sub;
+}
+
+void Graph::update_alignment(Alignment& aln,
+                             const std::vector<int32_t>& mapping) {
+    for (auto& p : aln) {
+        if (p.node >= 0) {
+            p.node = mapping[p.node];
+        }
+    }
+}
+
+std::vector<uint8_t> Graph::consensus(std::vector<uint32_t>& coverages) const {
+    coverages.clear();
+    const int32_t n = static_cast<int32_t>(nodes.size());
+    std::vector<uint8_t> out;
+    if (n == 0) {
+        return out;
+    }
+
+    const std::vector<int32_t> order = topo_order();
+    std::vector<int64_t> score(n, 0);
+    std::vector<int32_t> pred(n, -1);
+
+    // heaviest bundle: per node pick the heaviest in-edge (ties -> the
+    // predecessor with the larger accumulated score, later edge wins equal)
+    int32_t max_node = order[0];
+    for (int32_t v : order) {
+        int64_t best_w = -1;
+        int32_t best_p = -1;
+        for (int32_t ei : nodes[v].in) {
+            const Edge& e = edges[ei];
+            if (e.weight > best_w ||
+                (e.weight == best_w &&
+                 (best_p < 0 || score[e.tail] >= score[best_p]))) {
+                best_w = e.weight;
+                best_p = e.tail;
+            }
+        }
+        if (best_p >= 0) {
+            score[v] = best_w + score[best_p];
+            pred[v] = best_p;
+        }
+        if (score[v] > score[max_node]) {
+            max_node = v;
+        }
+    }
+
+    // extend to a sink along the heaviest out-edges so the consensus spans
+    // the full graph (the reference engine completes branches similarly)
+    int32_t tip = max_node;
+    while (!nodes[tip].out.empty()) {
+        int64_t best_w = -1;
+        int32_t best_h = -1;
+        for (int32_t ei : nodes[tip].out) {
+            const Edge& e = edges[ei];
+            if (e.weight > best_w ||
+                (e.weight == best_w &&
+                 (best_h < 0 || score[e.head] >= score[best_h]))) {
+                best_w = e.weight;
+                best_h = e.head;
+            }
+        }
+        pred[best_h] = tip;
+        tip = best_h;
+    }
+
+    std::vector<int32_t> path;
+    for (int32_t v = tip; v >= 0; v = pred[v]) {
+        path.push_back(v);
+    }
+    std::reverse(path.begin(), path.end());
+
+    out.reserve(path.size());
+    coverages.reserve(path.size());
+    for (int32_t v : path) {
+        out.push_back(static_cast<uint8_t>(kCodeBase[nodes[v].code]));
+        uint32_t cov = static_cast<uint32_t>(nodes[v].n_seqs);
+        for (int32_t a : nodes[v].aligned) {
+            cov += static_cast<uint32_t>(nodes[a].n_seqs);
+        }
+        coverages.push_back(cov);
+    }
+    return out;
+}
+
+std::vector<uint8_t> window_consensus(
+    const uint8_t* const* seqs, const int32_t* lens,
+    const uint8_t* const* quals, const int32_t* begins, const int32_t* ends,
+    int32_t n_seqs, int32_t match, int32_t mismatch, int32_t gap,
+    std::vector<uint32_t>& coverages, const Alignment* prealigned) {
+    Graph graph;
+
+    std::vector<uint32_t> weights;
+    auto weights_of = [&](int32_t i) -> const uint32_t* {
+        weights.assign(lens[i], 1);
+        if (quals[i] != nullptr) {
+            for (int32_t j = 0; j < lens[i]; ++j) {
+                weights[j] = quals[i][j] >= 33 ? quals[i][j] - 33 : 0;
+            }
+        }
+        return weights.data();
+    };
+
+    // backbone
+    graph.add_alignment(Alignment(), seqs[0], lens[0], weights_of(0));
+
+    // layers sorted by begin position, stable (reference window.cpp:84-85)
+    std::vector<int32_t> rank;
+    rank.reserve(n_seqs - 1);
+    for (int32_t i = 1; i < n_seqs; ++i) {
+        rank.push_back(i);
+    }
+    std::stable_sort(rank.begin(), rank.end(), [&](int32_t a, int32_t b) {
+        return begins[a] < begins[b];
+    });
+
+    const int32_t backbone_len = lens[0];
+    const int32_t offset = static_cast<int32_t>(0.01 * backbone_len);
+    for (int32_t i : rank) {
+        Alignment aln;
+        if (prealigned != nullptr) {
+            aln = prealigned[i];
+        } else if (begins[i] < offset && ends[i] > backbone_len - offset) {
+            aln = graph.align_nw(seqs[i], lens[i], match, mismatch, gap);
+        } else {
+            std::vector<int32_t> mapping;
+            Graph sub = graph.subgraph(begins[i], ends[i], mapping);
+            aln = sub.align_nw(seqs[i], lens[i], match, mismatch, gap);
+            Graph::update_alignment(aln, mapping);
+        }
+        graph.add_alignment(aln, seqs[i], lens[i], weights_of(i));
+    }
+
+    return graph.consensus(coverages);
+}
+
+}  // namespace racon_host

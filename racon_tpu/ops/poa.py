@@ -1,0 +1,85 @@
+"""Batched POA consensus over windows.
+
+The consensus role spoa (CPU) and GenomeWorks cudapoa (GPU) play in the
+reference. Two engines:
+
+  - host: the native C++ POA graph engine (racon_tpu/native), threaded over
+    windows — the spoa-equivalent path (reference src/polisher.cpp:491-504).
+  - device (`device_batches > 0`): the alignment hot loop moves to the TPU —
+    every layer is globally aligned against its window backbone as one
+    batched fixed-shape XLA program (ops/align kernel), and the resulting
+    paths are fed to the native graph builder as prealigned inputs (backbone
+    node ids are 0..L-1 by construction). This mirrors cudapoa's batched
+    window processing (src/cuda/cudabatch.cpp:77-270) while keeping the
+    irregular graph bookkeeping on the host where it is cheap.
+
+Windows with fewer than 3 sequences keep their backbone (reference
+window.cpp:68-71); TGS windows are coverage-trimmed (window.cpp:118-139).
+"""
+
+from __future__ import annotations
+
+from ..native import poa_batch
+from ..utils.logger import Logger
+
+
+class BatchPOA:
+    def __init__(self, match: int, mismatch: int, gap: int,
+                 window_length: int, num_threads: int = 1,
+                 device_batches: int = 0, band_width: int = 0,
+                 logger: Logger | None = None):
+        self.match = match
+        self.mismatch = mismatch
+        self.gap = gap
+        self.window_length = window_length
+        self.num_threads = num_threads
+        self.device_batches = device_batches
+        self.band_width = band_width
+        self.logger = logger
+
+    #: windows per host batch call (bounds peak packed-buffer memory)
+    HOST_CHUNK = 4096
+
+    def generate_consensus(self, windows, trim: bool) -> None:
+        """Fill `window.consensus` / `window.polished` for every window."""
+        todo = []
+        for w in windows:
+            if len(w.sequences) < 3:
+                w.backbone_fallback()
+            else:
+                todo.append(w)
+        if not todo:
+            return
+
+        if self.device_batches > 0:
+            try:
+                from .poa_device import device_prealign
+            except ImportError as exc:  # pragma: no cover
+                raise RuntimeError(
+                    "tpu_poa_batches > 0 requires the device POA path "
+                    "(racon_tpu/ops/poa_device.py)") from exc
+            prealign = device_prealign(
+                todo, self.match, self.mismatch, self.gap,
+                self.device_batches, self.band_width, logger=self.logger)
+        else:
+            prealign = None
+
+        bar = self.logger.bar if self.logger is not None else None
+        if self.logger is not None:
+            self.logger.bar_total(len(todo))
+        for s in range(0, len(todo), self.HOST_CHUNK):
+            chunk = todo[s:s + self.HOST_CHUNK]
+            packed = [
+                [(w.sequences[i], w.qualities[i], w.positions[i][0],
+                  w.positions[i][1])
+                 for i in range(len(w.sequences))]
+                for w in chunk
+            ]
+            pre = prealign[s:s + self.HOST_CHUNK] if prealign is not None else None
+            results = poa_batch(packed, self.match, self.mismatch, self.gap,
+                                n_threads=self.num_threads, prealigned=pre)
+            for w, (cons, cov) in zip(chunk, results):
+                w.apply_trim(cons, cov, trim)
+            if bar is not None:
+                for _ in chunk:
+                    bar("[racon_tpu::Polisher.polish] generating consensus")

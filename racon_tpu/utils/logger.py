@@ -15,6 +15,8 @@ class Logger:
     def __init__(self):
         self._time = 0.0
         self._bar = 0
+        self._bar_count = 0
+        self._bar_total = 0
         self._total = 0.0
 
     def log(self, msg: str | None = None) -> None:
@@ -27,15 +29,26 @@ class Logger:
         print(f"{msg} {elapsed:.5f} s", file=sys.stderr)
         self._time = now
 
+    def bar_total(self, total: int) -> None:
+        """Arm the 20-bin progress bar for `total` upcoming bar() calls."""
+        self._bar_total = max(total, 1)
+        self._bar_count = 0
+        self._bar = 0
+
     def bar(self, msg: str) -> None:
-        self._bar = min(self._bar + 1, 20)
-        filled = "=" * self._bar + (">" if self._bar < 20 else "")
-        sys.stderr.write(f"{msg} [{filled:<20}] {self._bar * 5}%")
-        if self._bar == 20:
+        self._bar_count += 1
+        bins = min(20 * self._bar_count // self._bar_total, 20)
+        if bins == self._bar and bins < 20:
+            return
+        self._bar = bins
+        filled = "=" * bins + (">" if bins < 20 else "")
+        sys.stderr.write(f"{msg} [{filled:<20}] {bins * 5}%")
+        if bins == 20 and self._bar_count >= self._bar_total:
             elapsed = time.perf_counter() - self._time
             self._total += elapsed
             sys.stderr.write(f" {elapsed:.5f} s\n")
             self._bar = 0
+            self._bar_count = 0
             self._time = time.perf_counter()
         else:
             sys.stderr.write("\r")
