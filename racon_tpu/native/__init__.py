@@ -20,7 +20,7 @@ import numpy as np
 _DIR = pathlib.Path(__file__).resolve().parent
 _SRC = _DIR / "src"
 _LIB = _DIR / "libracon_host.so"
-_SOURCES = ("poa.cpp", "nw.cpp", "api.cpp")
+_SOURCES = ("poa.cpp", "myers.cpp", "api.cpp")
 
 _lock = threading.Lock()
 _lib: ctypes.CDLL | None = None
@@ -42,7 +42,10 @@ def build(force: bool = False) -> pathlib.Path:
                 "-O3", "-std=c++17", "-fPIC", "-shared", "-pthread",
                 "-o", str(_LIB),
             ] + [str(_SRC / s) for s in _SOURCES]
-            subprocess.run(cmd, check=True, capture_output=True, text=True)
+            proc = subprocess.run(cmd, capture_output=True, text=True)
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    f"native build failed ({' '.join(cmd)}):\n{proc.stderr}")
     return _LIB
 
 
@@ -50,7 +53,12 @@ def get_lib() -> ctypes.CDLL:
     global _lib
     if _lib is None:
         build()
-        lib = ctypes.CDLL(str(_LIB))
+        try:
+            lib = ctypes.CDLL(str(_LIB))
+        except OSError:
+            # stale/foreign binary (e.g. copied between machines) — rebuild
+            build(force=True)
+            lib = ctypes.CDLL(str(_LIB))
         i64, i32, u8p = ctypes.c_int64, ctypes.c_int32, ctypes.POINTER(ctypes.c_uint8)
         i64p, i32p = ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int32)
         u32p = ctypes.POINTER(ctypes.c_uint32)
@@ -80,8 +88,8 @@ def _u8(data: bytes | np.ndarray):
 
 
 def edit_distance(a: bytes, b: bytes) -> int:
-    """Exact edit distance (adaptive-band NW) — the metric role edlib plays
-    in reference test/racon_test.cpp:16-25."""
+    """Exact edit distance (Myers bit-parallel NW) — the metric role edlib
+    plays in reference test/racon_test.cpp:16-25."""
     lib = get_lib()
     pa, ka = _u8(a)
     pb, kb = _u8(b)
@@ -125,21 +133,21 @@ def nw_cigar_batch(pairs, n_threads: int = 1, progress=None,
         slot = 4 * int(max(q_off[-1] // max(len(part), 1),
                            t_off[-1] // max(len(part), 1)) + 1) + 64
         lens = np.empty(len(part), dtype=np.int64)
-        while True:
-            buf = ctypes.create_string_buffer(slot * len(part))
-            lib.rh_nw_cigar_batch(
-                q_data.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
-                q_off.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
-                t_data.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
-                t_off.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
-                len(part), n_threads, buf, slot,
-                lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
-            if (lens >= 0).all():
-                break
-            slot = int(-lens[lens < 0].min()) + 64
+        buf = ctypes.create_string_buffer(slot * len(part))
+        lib.rh_nw_cigar_batch(
+            q_data.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            q_off.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            t_data.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            t_off.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            len(part), n_threads, buf, slot,
+            lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
         raw = buf.raw
         for i in range(len(part)):
-            out[s + i] = raw[i * slot:i * slot + int(lens[i])]
+            if lens[i] >= 0:
+                out[s + i] = raw[i * slot:i * slot + int(lens[i])]
+            else:
+                # slot overflow for this pair only: re-align it singly
+                out[s + i] = nw_cigar(*part[i])
         if progress is not None:
             progress(len(part))
     return out

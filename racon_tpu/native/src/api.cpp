@@ -15,10 +15,8 @@
 #include "poa.hpp"
 
 namespace racon_host {
-int64_t nw_align(const uint8_t* q, int64_t m, const uint8_t* t, int64_t n,
+int64_t myers_nw(const uint8_t* q, int64_t m, const uint8_t* t, int64_t n,
                  std::vector<char>* cigar);
-int64_t edit_distance(const uint8_t* a, int64_t m, const uint8_t* b,
-                      int64_t n);
 }  // namespace racon_host
 
 using racon_host::Alignment;
@@ -28,7 +26,7 @@ extern "C" {
 
 int64_t rh_edit_distance(const uint8_t* a, int64_t m, const uint8_t* b,
                          int64_t n) {
-    return racon_host::edit_distance(a, m, b, n);
+    return racon_host::myers_nw(a, m, b, n, nullptr);
 }
 
 // Globally align query q against target t (unit costs). Writes the CIGAR
@@ -37,7 +35,7 @@ int64_t rh_edit_distance(const uint8_t* a, int64_t m, const uint8_t* b,
 int64_t rh_nw_cigar(const uint8_t* q, int64_t m, const uint8_t* t, int64_t n,
                     char* out, int64_t cap) {
     std::vector<char> cigar;
-    const int64_t d = racon_host::nw_align(q, m, t, n, &cigar);
+    const int64_t d = racon_host::myers_nw(q, m, t, n, &cigar);
     if (d < 0) {
         return -1;
     }
@@ -178,7 +176,7 @@ void rh_nw_cigar_batch(const uint8_t* q_data, const int64_t* q_off,
             }
             const int64_t m = q_off[i + 1] - q_off[i];
             const int64_t n = t_off[i + 1] - t_off[i];
-            const int64_t d = racon_host::nw_align(
+            const int64_t d = racon_host::myers_nw(
                 q_data + q_off[i], m, t_data + t_off[i], n, &cigar);
             if (d < 0) {
                 out_lens[i] = -1;

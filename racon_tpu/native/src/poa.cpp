@@ -50,7 +50,8 @@ void Graph::add_edge(int32_t tail, int32_t head, int64_t weight) {
 }
 
 void Graph::add_alignment(const Alignment& aln, const uint8_t* seq,
-                          int32_t len, const uint32_t* weights) {
+                          int32_t len, const uint32_t* weights,
+                          bool anchored) {
     if (len <= 0) {
         return;
     }
@@ -79,14 +80,44 @@ void Graph::add_alignment(const Alignment& aln, const uint8_t* seq,
         // aligned middle
         int32_t col_bpos = 0;  // bpos of the last visited column
         bool col_seen = false;
+        int32_t ins_offset = 0;  // consecutive insertions since last column
         for (const auto& p : aln) {
-            if (p.pos < 0) continue;
+            if (p.pos < 0) {
+                continue;
+            }
             const uint8_t code = kBaseCode[seq[p.pos]];
             int32_t cur;
             if (p.node < 0) {
-                // insertion relative to the graph
-                cur = add_node(code, col_seen ? col_bpos : -1);
+                if (anchored) {
+                    // merge with identical insertions from earlier layers:
+                    // key = (anchor column, run offset, base code)
+                    const int64_t col_key =
+                        ((static_cast<int64_t>(col_seen ? col_bpos : -1)
+                          << 20) |
+                         static_cast<int64_t>(ins_offset));
+                    const int64_t key = (col_key << 8) | code;
+                    auto it = ins_node_.find(key);
+                    if (it != ins_node_.end()) {
+                        cur = it->second;
+                    } else {
+                        cur = add_node(code, col_seen ? col_bpos : -1);
+                        ins_node_.emplace(key, cur);
+                        // register same-anchor different-code nodes as one
+                        // column so coverage counting sees them together
+                        std::vector<int32_t>& col = ins_col_[col_key];
+                        for (int32_t a : col) {
+                            nodes[a].aligned.push_back(cur);
+                            nodes[cur].aligned.push_back(a);
+                        }
+                        col.push_back(cur);
+                    }
+                    ++ins_offset;
+                } else {
+                    // insertion relative to the graph
+                    cur = add_node(code, col_seen ? col_bpos : -1);
+                }
             } else {
+                ins_offset = 0;
                 Node& q = nodes[p.node];
                 col_bpos = q.bpos;
                 col_seen = true;
@@ -112,7 +143,6 @@ void Graph::add_alignment(const Alignment& aln, const uint8_t* seq,
                         }
                     }
                 }
-                nodes[cur].bpos = nodes[cur].bpos;  // keep column bpos
             }
             path[p.pos] = cur;
         }
@@ -453,9 +483,10 @@ std::vector<uint8_t> window_consensus(
 
     const int32_t backbone_len = lens[0];
     const int32_t offset = static_cast<int32_t>(0.01 * backbone_len);
+    const bool anchored = prealigned != nullptr;
     for (int32_t i : rank) {
         Alignment aln;
-        if (prealigned != nullptr) {
+        if (anchored) {
             aln = prealigned[i];
         } else if (begins[i] < offset && ends[i] > backbone_len - offset) {
             aln = graph.align_nw(seqs[i], lens[i], match, mismatch, gap);
@@ -465,7 +496,7 @@ std::vector<uint8_t> window_consensus(
             aln = sub.align_nw(seqs[i], lens[i], match, mismatch, gap);
             Graph::update_alignment(aln, mapping);
         }
-        graph.add_alignment(aln, seqs[i], lens[i], weights_of(i));
+        graph.add_alignment(aln, seqs[i], lens[i], weights_of(i), anchored);
     }
 
     return graph.consensus(coverages);

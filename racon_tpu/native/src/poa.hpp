@@ -22,6 +22,7 @@
 #pragma once
 
 #include <cstdint>
+#include <unordered_map>
 #include <vector>
 
 namespace racon_host {
@@ -64,8 +65,16 @@ public:
     // (Phred quality - 33, or 1 when no quality). When the graph is empty the
     // sequence is the backbone and node bpos = base position; otherwise new
     // nodes inherit the bpos of their column / predecessor.
+    //
+    // `anchored`: the alignment's node ids refer to BACKBONE positions only
+    // (the batched device prealign path, which cannot see nodes other layers
+    // created). Insertions are then merged across layers by their anchor
+    // (backbone column, offset within the insertion run, base code) so that
+    // repeated insertions accumulate edge weight exactly as they would had
+    // each layer been aligned against the evolving graph — without this,
+    // backbone deletions could never win the heaviest-bundle consensus.
     void add_alignment(const Alignment& aln, const uint8_t* seq, int32_t len,
-                       const uint32_t* weights);
+                       const uint32_t* weights, bool anchored = false);
 
     // Topological order of node ids (deterministic: Kahn's algorithm, FIFO
     // seeded in id order).
@@ -95,6 +104,11 @@ public:
 private:
     int32_t add_node(uint8_t code, int32_t bpos);
     void add_edge(int32_t tail, int32_t head, int64_t weight);
+
+    // anchored-insertion registry: (bpos, offset, code) -> node id and
+    // (bpos, offset) -> column members, used only by anchored additions
+    std::unordered_map<int64_t, int32_t> ins_node_;
+    std::unordered_map<int64_t, std::vector<int32_t>> ins_col_;
 };
 
 // Full per-window consensus: backbone + layers, mirroring the orchestration

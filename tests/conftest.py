@@ -3,14 +3,42 @@
 Force the CPU backend with 8 virtual devices BEFORE jax initializes, so
 sharding/mesh tests exercise the multi-chip code paths without TPU hardware
 (the driver separately dry-runs the multi-chip path the same way).
+
+The axon TPU shim (PYTHONPATH=/root/.axon_site on this image) monkeypatches
+jax at import and initializes its remote client even when JAX_PLATFORMS
+selects cpu — and that client blocks indefinitely when the TPU tunnel is
+unreachable. Tests are CPU-only by design, so the shim is stripped from the
+import path before jax loads.
 """
 
 import os
+import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path = [p for p in sys.path if "axon_site" not in p]
+os.environ["PYTHONPATH"] = os.pathsep.join(
+    p for p in os.environ.get("PYTHONPATH", "").split(os.pathsep)
+    if p and "axon_site" not in p)
+
+# pytest plugins may have imported jax already (registration is done, but
+# backend init is lazy) — deregister the axon backend factory so jax can
+# never try to initialize the remote client, and pin the platform to cpu.
+if "jax" in sys.modules:
+    import jax
+
+    try:
+        from jax._src import xla_bridge as _xb
+
+        _xb._backend_factories.pop("axon", None)
+        _xb._platform_aliases.pop("axon", None)
+    except Exception:
+        pass
+    jax.config.update("jax_platforms", "cpu")
 
 import pathlib
 

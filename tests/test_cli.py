@@ -1,0 +1,88 @@
+"""CLI tests (reference src/main.cpp:47-169 option surface)."""
+
+import io
+import os
+import sys
+
+import pytest
+
+from racon_tpu.cli import parse_args, main
+
+DATA = "/root/reference/test/data/"
+
+
+def test_defaults_and_positionals():
+    o = parse_args(["reads.fq", "ovl.paf", "tgt.fa"])
+    assert o["window_length"] == 500
+    assert o["quality_threshold"] == 10.0
+    assert o["error_threshold"] == 0.3
+    assert o["match"] == 3 and o["mismatch"] == -5 and o["gap"] == -4
+    assert o["trim"] and o["drop_unpolished_sequences"]
+    assert not o["fragment_correction"]
+    assert o["paths"] == ["reads.fq", "ovl.paf", "tgt.fa"]
+
+
+def test_full_option_mix():
+    o = parse_args(["-w", "1000", "-q", "-1", "--no-trimming", "-m", "8",
+                    "-x", "-6", "-g", "-8", "-t", "4", "-c", "2",
+                    "--tpualigner-batches", "3", "--tpualigner-band-width=64",
+                    "reads.fq", "ovl.paf", "tgt.fa"])
+    assert o["window_length"] == 1000
+    assert o["quality_threshold"] == -1.0
+    assert not o["trim"]
+    assert o["match"] == 8 and o["mismatch"] == -6 and o["gap"] == -8
+    assert o["num_threads"] == 4
+    assert o["tpu_poa_batches"] == 2
+    assert o["tpu_aligner_batches"] == 3
+    assert o["tpu_aligner_band_width"] == 64
+
+
+def test_optional_c_argument():
+    # -c with no value defaults to 1 (reference main.cpp:113-125)
+    o = parse_args(["-ufc", "a.fq", "b.paf", "c.fa"])
+    assert not o["drop_unpolished_sequences"]
+    assert o["fragment_correction"]
+    assert o["tpu_poa_batches"] == 1
+    assert o["paths"] == ["a.fq", "b.paf", "c.fa"]
+
+
+def test_missing_inputs_exit_code():
+    assert main([]) == 1
+
+
+def test_version_and_help(capsys):
+    assert main(["--version"]) == 0
+    assert capsys.readouterr().out.startswith("v")
+    assert main(["--help"]) == 0
+    assert "usage: racon_tpu" in capsys.readouterr().out
+
+
+@pytest.mark.skipif(not os.path.isdir(DATA), reason="sample data missing")
+def test_cli_end_to_end_sam(capsys, monkeypatch):
+    # full pipeline through the CLI entry point, FASTA on stdout
+    buf = io.BytesIO()
+    buf.buffer = buf  # cli writes to sys.stdout.buffer
+
+    class _Out:
+        buffer = buf
+
+        @staticmethod
+        def write(s):
+            pass
+
+        @staticmethod
+        def flush():
+            pass
+
+    monkeypatch.setattr(sys, "stdout", _Out)
+    rc = main(["-t", "2", DATA + "sample_reads.fastq.gz",
+               DATA + "sample_overlaps.sam.gz",
+               DATA + "sample_layout.fasta.gz"])
+    assert rc == 0
+    out = buf.getvalue()
+    assert out.startswith(b">utg000001l")
+    assert b"LN:i:" in out and b"RC:i:" in out and b"XC:f:" in out
+    # one record: header + sequence
+    assert out.count(b">") == 1
+    seq = out.split(b"\n", 2)[1]
+    assert 45000 < len(seq) < 50000

@@ -1,0 +1,167 @@
+"""Unit tests for the native host library (racon_tpu/native).
+
+Covers the edlib-equivalent Myers bit-parallel NW (exact distance + CIGAR)
+and the spoa-equivalent POA consensus engine, the two compute roles the
+reference gets from vendored C++ (SURVEY.md §2b).
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from racon_tpu.native import edit_distance, nw_cigar, nw_cigar_batch, poa_batch
+from racon_tpu.utils.cigar import parse_cigar
+
+ACGT = b"ACGT"
+
+
+def lev_reference(a: bytes, b: bytes) -> int:
+    """Independent O(n^2) Levenshtein (vectorized rows + prefix-min scan)."""
+    a = np.frombuffer(a, dtype=np.uint8)
+    b = np.frombuffer(b, dtype=np.uint8)
+    n = len(b)
+    prev = np.arange(n + 1, dtype=np.int32)
+    idx = np.arange(n + 1, dtype=np.int32)
+    for i in range(1, len(a) + 1):
+        cost = (a[i - 1] != b).astype(np.int32)
+        tmp = np.empty(n + 1, dtype=np.int32)
+        tmp[0] = i
+        tmp[1:] = np.minimum(prev[1:] + 1, prev[:-1] + cost)
+        prev = np.minimum.accumulate(tmp - idx) + idx
+    return int(prev[n])
+
+
+def mutate(rng: random.Random, s: bytes, rate: float) -> bytes:
+    out = bytearray()
+    for c in s:
+        r = rng.random()
+        if r < rate / 3:
+            continue
+        if r < 2 * rate / 3:
+            out.append(rng.choice(ACGT))
+            out.append(c)
+            continue
+        if r < rate:
+            out.append(rng.choice(ACGT))
+            continue
+        out.append(c)
+    return bytes(out)
+
+
+def assert_cigar_consistent(q: bytes, t: bytes, cigar: bytes, dist: int):
+    """The CIGAR must consume exactly q and t and cost exactly `dist`."""
+    ops, lens = parse_cigar(cigar)
+    qi = ti = cost = 0
+    for op, length in zip(ops, lens):
+        ch = chr(op)
+        if ch == "M":
+            for _ in range(length):
+                cost += 1 if q[qi] != t[ti] else 0
+                qi += 1
+                ti += 1
+        elif ch == "I":
+            qi += length
+            cost += length
+        elif ch == "D":
+            ti += length
+            cost += length
+        else:  # pragma: no cover
+            pytest.fail(f"unexpected op {ch}")
+    assert qi == len(q) and ti == len(t)
+    assert cost == dist
+
+
+def test_myers_matches_reference_dp_fuzz():
+    rng = random.Random(11)
+    # sizes straddling the 64-bit block and 128-column checkpoint boundaries
+    for size in [1, 5, 63, 64, 65, 127, 128, 129, 200, 513, 2000]:
+        t = bytes(rng.choice(ACGT) for _ in range(size))
+        q = mutate(rng, t, rng.choice([0.0, 0.05, 0.3, 0.8])) or b"A"
+        d = edit_distance(q, t)
+        assert d == lev_reference(q, t)
+        assert_cigar_consistent(q, t, nw_cigar(q, t), d)
+
+
+def test_myers_empty_and_degenerate():
+    assert edit_distance(b"", b"ACGT") == 4
+    assert edit_distance(b"ACGT", b"") == 4
+    assert nw_cigar(b"", b"ACGT") == b"4D"
+    assert nw_cigar(b"ACGT", b"") == b"4I"
+    assert edit_distance(b"ACGT", b"ACGT") == 0
+    assert nw_cigar(b"ACGT", b"ACGT") == b"4M"
+
+
+def test_myers_non_acgt_bytes_match_exactly():
+    # raw byte equality, like edlib: N matches N, case is distinct
+    assert edit_distance(b"ANNA", b"ANNA") == 0
+    assert edit_distance(b"ANRA", b"ANNA") == 1
+
+
+def test_nw_cigar_batch_matches_single():
+    rng = random.Random(5)
+    pairs = []
+    for _ in range(20):
+        t = bytes(rng.choice(ACGT) for _ in range(rng.randrange(1, 400)))
+        q = mutate(rng, t, 0.2) or b"C"
+        pairs.append((q, t))
+    batch = nw_cigar_batch(pairs, n_threads=3)
+    for (q, t), cig in zip(pairs, batch):
+        assert cig == nw_cigar(q, t)
+
+
+def test_poa_consensus_recovers_truth():
+    """20 noisy copies + a noisy backbone must reconstruct the truth almost
+    exactly (the spoa role, reference window.cpp:65-142)."""
+    rng = random.Random(7)
+    truth = bytes(rng.choice(ACGT) for _ in range(500))
+    backbone = mutate(rng, truth, 0.10)
+    layers = [mutate(rng, truth, 0.10) for _ in range(20)]
+    window = [(backbone, None, 0, len(backbone) - 1)] + \
+             [(l, None, 0, len(l) - 1) for l in layers]
+    cons, cov = poa_batch([window], 3, -5, -4)[0]
+    assert edit_distance(backbone, truth) > 30     # the draft is noisy
+    assert edit_distance(cons, truth) <= 12        # the consensus is not
+    assert len(cov) == len(cons)
+    assert cov[len(cov) // 2] >= 15                # mid-window coverage
+
+
+def test_poa_quality_weights_respected():
+    """A high-quality minority base should win over low-quality majority."""
+    backbone = b"ACGTACGTACGTACGTACGT"
+    variant = b"ACGTACGTATGTACGTACGT"  # C->T at position 9
+    lo = bytes([33 + 2]) * 20    # Phred 2
+    hi = bytes([33 + 60]) * 20   # Phred 60
+    window = [(backbone, b"!" * 20, 0, 19),
+              (variant, hi, 0, 19), (variant, hi, 0, 19),
+              (backbone, lo, 0, 19), (backbone, lo, 0, 19),
+              (backbone, lo, 0, 19)]
+    cons, _ = poa_batch([window], 3, -5, -4)[0]
+    assert cons == variant
+
+
+def test_poa_subwindow_layers():
+    """Layers covering only part of the window align against the matching
+    subgraph (reference window.cpp:87-103)."""
+    rng = random.Random(3)
+    bb = bytes(rng.choice(ACGT) for _ in range(300))
+    lay = bb[100:200]
+    window = [(bb, None, 0, 299)] + [(lay, None, 100, 199)] * 3
+    cons, cov = poa_batch([window], 3, -5, -4)[0]
+    assert cons == bb
+    assert cov[150] == 4 and cov[50] == 1
+
+
+def test_poa_batch_threads_deterministic():
+    rng = random.Random(9)
+    windows = []
+    for _ in range(8):
+        truth = bytes(rng.choice(ACGT) for _ in range(200))
+        win = [(mutate(rng, truth, 0.1), None, 0, 199)]
+        win += [(mutate(rng, truth, 0.1), None, 0, 199) for _ in range(6)]
+        windows.append([(s, q, b, min(e, len(win[0][0]) - 1))
+                        for (s, q, b, e) in win])
+    a = poa_batch(windows, 3, -5, -4, n_threads=1)
+    b = poa_batch(windows, 3, -5, -4, n_threads=4)
+    for (ca, _), (cb, _) in zip(a, b):
+        assert ca == cb
