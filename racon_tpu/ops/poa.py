@@ -52,30 +52,28 @@ class BatchPOA:
             return
 
         if self.device_batches > 0:
-            try:
-                from .poa_device import device_prealign
-            except ImportError as exc:  # pragma: no cover
-                raise RuntimeError(
-                    "tpu_poa_batches > 0 requires the device POA path "
-                    "(racon_tpu/ops/poa_device.py)") from exc
+            from .poa_device import device_prealign
             prealign = device_prealign(
                 todo, self.match, self.mismatch, self.gap,
                 self.device_batches, self.band_width, logger=self.logger)
+            dev = [(w, prealign[i]) for i, w in enumerate(todo)
+                   if prealign[i] is not None]
+            host = [w for i, w in enumerate(todo) if prealign[i] is None]
         else:
-            prealign = None
+            dev = []
+            host = todo
 
         bar = self.logger.bar if self.logger is not None else None
         if self.logger is not None:
             self.logger.bar_total(len(todo))
-        for s in range(0, len(todo), self.HOST_CHUNK):
-            chunk = todo[s:s + self.HOST_CHUNK]
+
+        def consume(chunk, pre):
             packed = [
                 [(w.sequences[i], w.qualities[i], w.positions[i][0],
                   w.positions[i][1])
                  for i in range(len(w.sequences))]
                 for w in chunk
             ]
-            pre = prealign[s:s + self.HOST_CHUNK] if prealign is not None else None
             results = poa_batch(packed, self.match, self.mismatch, self.gap,
                                 n_threads=self.num_threads, prealigned=pre)
             for w, (cons, cov) in zip(chunk, results):
@@ -83,3 +81,9 @@ class BatchPOA:
             if bar is not None:
                 for _ in chunk:
                     bar("[racon_tpu::Polisher.polish] generating consensus")
+
+        for s in range(0, len(dev), self.HOST_CHUNK):
+            part = dev[s:s + self.HOST_CHUNK]
+            consume([w for w, _ in part], [p for _, p in part])
+        for s in range(0, len(host), self.HOST_CHUNK):
+            consume(host[s:s + self.HOST_CHUNK], None)

@@ -1,0 +1,189 @@
+"""Batched device prealignment for POA consensus — the cudapoa role.
+
+GenomeWorks cudapoa (reference src/cuda/cudabatch.cpp) runs the whole POA —
+graph-banded DP plus consensus — inside one CUDA block per window. That
+design is pointer-heavy and irregular: a poor fit for the TPU's dense
+vector/matrix units and XLA's static-shape compilation model. The TPU-first
+split used here keeps the *regular* 95% of the work on device and the
+irregular 5% on the host:
+
+  - device: every layer is globally aligned (NW, linear gap) against its
+    window's backbone slice as one fixed-shape batched XLA program —
+    dense int8 code tensors, a `lax.scan` over DP rows, and a second
+    `lax.scan` for the traceback, all vectorized over the batch. This is
+    where the O(len^2 * depth) FLOPs live.
+  - host: the POA graph builder (native/src/poa.cpp) ingests the resulting
+    paths as *anchored* alignments. Because every path is expressed in
+    backbone coordinates, identical insertions from different layers are
+    merged by (backbone column, run offset, base code) — preserving the
+    evolving-graph property that repeated insertions accumulate consensus
+    weight (see Graph::add_alignment(anchored=true)).
+
+Batches are padded to a small set of static (Q, T) shape buckets so XLA
+compiles a handful of programs, and the batch axis is sharded across every
+available device through parallel/mesh.py — the TPU analogue of cudapoa's
+multi-GPU batch loop (src/cuda/cudapolisher.cpp:228-345). Layers that
+exceed the largest bucket (beyond the cudapoa contract of ~1023 bp,
+cudabatch.cpp:56-59) are returned as None and the caller host-aligns those
+windows — the same device->host fallback the reference uses for oversized
+windows (cudapolisher.cpp:354-383).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+from .encode import encode_padded
+from ..utils.logger import Logger
+
+# (Q, T) shape buckets: Q = padded layer length, T = padded backbone span.
+# w=500 windows fill the first two buckets; w=1000 the last.
+_BUCKETS = ((320, 512), (640, 512), (1280, 1024))
+#: elements budget per batch (bp tensor is B*Q*(T+1) int8)
+_BATCH_BUDGET = 48 * 1024 * 1024
+
+
+def _batch_size(q: int, t: int) -> int:
+    b = _BATCH_BUDGET // (q * (t + 1))
+    return max(8, 1 << (int(b).bit_length() - 1))
+
+
+@functools.lru_cache(maxsize=None)
+def _aligner(q_len: int, t_len: int, match: int, mismatch: int, gap: int):
+    """Build the jitted batched NW align+traceback program for one shape."""
+    import jax
+    import jax.numpy as jnp
+
+    K = q_len + t_len  # max path length
+
+    def align(q, ql, t, tl):
+        # q: [B, Q] int8 codes, ql: [B] int32; t: [B, T], tl: [B]
+        B = q.shape[0]
+        idx = jnp.arange(t_len + 1, dtype=jnp.int32)
+
+        h0 = idx * gap  # row 0: D[0][j] = j*gap
+        h0 = jnp.broadcast_to(h0, (B, t_len + 1)).astype(jnp.int32)
+
+        def row_step(h_prev, qi_i):
+            qi, i = qi_i  # qi: [B] this row's base codes; i: row number
+            sub = jnp.where(t == qi[:, None], match, mismatch)  # [B, T]
+            diag = h_prev[:, :-1] + sub
+            up = h_prev[:, 1:] + gap
+            tmp = jnp.maximum(diag, up)
+            lead = jnp.full((B, 1), i * gap, dtype=jnp.int32)
+            full = jnp.concatenate([lead, tmp], axis=1)  # [B, T+1]
+            # resolve the left-gap dependency with a running max:
+            # H[j] = max_k<=j full[k] + (j-k)*gap
+            h_row = jax.lax.cummax(full - idx * gap, axis=1) + idx * gap
+            # backpointers; tie priority matches the host graph traceback
+            # (poa.cpp align_nw): diagonal > backbone-consume > layer-consume
+            diag_ok = h_row[:, 1:] == diag
+            left_ok = h_row[:, 1:] == h_row[:, :-1] + gap
+            bp_tail = jnp.where(diag_ok, 0, jnp.where(left_ok, 2, 1))
+            bp = jnp.concatenate(
+                [jnp.ones((B, 1), dtype=jnp.int8), bp_tail.astype(jnp.int8)],
+                axis=1)
+            return h_row, bp
+
+        rows_i = jnp.arange(1, q_len + 1, dtype=jnp.int32)
+        _, bp = jax.lax.scan(row_step, h0, (q.T, rows_i))
+        # bp: [Q, B, T+1] -> flat per-batch for gathered traceback reads
+        bp_flat = bp.transpose(1, 0, 2).reshape(B, q_len * (t_len + 1))
+
+        def tb_step(state, _):
+            i, j = state
+            on_q = i > 0
+            on_t = j > 0
+            lin = jnp.clip(i - 1, 0, q_len - 1) * (t_len + 1) + j
+            code = jnp.take_along_axis(bp_flat, lin[:, None], axis=1)[:, 0]
+            code = jnp.where(on_q & on_t, code, jnp.where(on_q, 1, 2))
+            done = ~on_q & ~on_t
+            take_q = ~done & (code != 2)   # diag or up consume a layer base
+            take_t = ~done & (code != 1)   # diag or left consume a backbone col
+            node = jnp.where(take_t, j - 1, -1)
+            pos = jnp.where(take_q, i - 1, -1)
+            node = jnp.where(done, -2, node)
+            pos = jnp.where(done, -2, pos)
+            return ((i - take_q.astype(jnp.int32),
+                     j - take_t.astype(jnp.int32)),
+                    (node.astype(jnp.int32), pos.astype(jnp.int32)))
+
+        _, (nodes, poss) = jax.lax.scan(
+            tb_step, (ql.astype(jnp.int32), tl.astype(jnp.int32)), None,
+            length=K)
+        # emitted back-to-front: [K, B] -> [B, K]
+        return nodes.T, poss.T
+
+    return jax.jit(align)
+
+
+def device_prealign(windows, match: int, mismatch: int, gap: int,
+                    device_batches: int = 1, band_width: int = 0,
+                    logger: Logger | None = None):
+    """Align every layer of every window against its backbone slice on
+    device.
+
+    Returns a list parallel to `windows`; each entry is either a list
+    (parallel to window.sequences, [0] = None) of (nodes, poss) int32 array
+    pairs, or None when any layer of that window exceeded the largest shape
+    bucket (caller falls back to host alignment for the whole window, like
+    the reference's GPU->CPU window fallback, cudapolisher.cpp:354-383).
+    """
+    from ..parallel.mesh import BatchRunner
+
+    max_q, max_t = _BUCKETS[-1]
+    jobs: dict[tuple[int, int], list] = {}
+    results: list = []
+    for w_idx, w in enumerate(windows):
+        spans = [(w.sequences[i],) + w.positions[i]
+                 for i in range(1, len(w.sequences))]
+        if any(len(s) > max_q or e - b + 1 > max_t for s, b, e in spans):
+            results.append(None)  # whole window falls back to host
+            continue
+        results.append([None] * len(w.sequences))
+        for l_idx, (seq, b, e) in enumerate(spans, start=1):
+            t_span = e - b + 1
+            bucket = next(qt for qt in _BUCKETS
+                          if len(seq) <= qt[0] and t_span <= qt[1])
+            jobs.setdefault(bucket, []).append((w_idx, l_idx, seq, b, e))
+
+    runner = BatchRunner()
+    total = sum(len(v) for v in jobs.values())
+    if logger is not None and total:
+        logger.bar_total(total)
+
+    for (q_len, t_len), items in sorted(jobs.items()):
+        fn = _aligner(q_len, t_len, match, mismatch, gap)
+        batch = _batch_size(q_len, t_len)
+        batch = runner.round_batch(batch)
+        for s in range(0, len(items), batch):
+            part = items[s:s + batch]
+            q_codes, q_lens = encode_padded([it[2] for it in part], q_len)
+            t_codes, t_lens = encode_padded(
+                [windows[it[0]].sequences[0][it[3]:it[4] + 1] for it in part],
+                t_len)
+            pad = batch - len(part)
+            if pad:
+                q_codes = np.pad(q_codes, ((0, pad), (0, 0)),
+                                 constant_values=5)
+                t_codes = np.pad(t_codes, ((0, pad), (0, 0)),
+                                 constant_values=5)
+                q_lens = np.pad(q_lens, (0, pad), constant_values=1)
+                t_lens = np.pad(t_lens, (0, pad), constant_values=1)
+            nodes, poss = runner.run(fn, q_codes, q_lens, t_codes, t_lens)
+            nodes = np.asarray(nodes)
+            poss = np.asarray(poss)
+            for k, (w_idx, l_idx, _seq, b, _e) in enumerate(part):
+                nd, ps = nodes[k], poss[k]
+                keep = ps >= 0  # drop pads and backbone-skip steps
+                nd = nd[keep][::-1].copy()
+                ps = ps[keep][::-1].copy()
+                nd[nd >= 0] += b  # slice -> window backbone coordinates
+                results[w_idx][l_idx] = (nd.astype(np.int32),
+                                         ps.astype(np.int32))
+                if logger is not None:
+                    logger.bar("[racon_tpu::Polisher.polish] "
+                               "aligning layers on device")
+    return results

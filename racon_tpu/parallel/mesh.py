@@ -1,0 +1,59 @@
+"""Multi-chip batch sharding.
+
+The reference scales across GPUs by instantiating batch objects per device
+and pulling work from a shared index — no inter-GPU communication at all
+(src/cuda/cudapolisher.cpp:165-199,228-345; SURVEY.md §2c-5). The TPU
+equivalent is simpler and declarative: one `jax.sharding.Mesh` over all
+chips with a single 'batch' axis, inputs placed with a batch-sharded
+`NamedSharding`, and XLA partitions the jitted kernel across chips over
+ICI. The workload needs no collectives (every window/overlap is
+independent), so sharding the leading axis is the complete distribution
+story; multi-host runs add only file-level scatter/gather (SURVEY.md §5).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class BatchRunner:
+    """Runs batched kernels with the leading axis sharded over all devices.
+
+    On a single device this degrades to plain dispatch with zero overhead;
+    on N devices each chip receives B/N rows of every operand.
+    """
+
+    def __init__(self, devices=None):
+        import jax
+
+        self.devices = list(devices) if devices is not None else jax.devices()
+        if len(self.devices) > 1:
+            from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+            self.mesh = Mesh(np.array(self.devices), ("batch",))
+            self.sharding = NamedSharding(self.mesh, PartitionSpec("batch"))
+        else:
+            self.mesh = None
+            self.sharding = None
+
+    @property
+    def n_devices(self) -> int:
+        return len(self.devices)
+
+    def round_batch(self, batch: int) -> int:
+        """Smallest multiple of n_devices >= batch (so shards are equal)."""
+        n = self.n_devices
+        return ((batch + n - 1) // n) * n
+
+    def run(self, fn, *arrays):
+        """Invoke jitted `fn` on operands whose leading dim is the batch.
+
+        All operands must share the same leading dimension, divisible by
+        the device count (use round_batch + padding).
+        """
+        import jax
+
+        if self.sharding is None:
+            return fn(*arrays)
+        placed = [jax.device_put(a, self.sharding) for a in arrays]
+        return fn(*placed)

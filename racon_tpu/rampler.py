@@ -1,0 +1,137 @@
+"""Sequence subsampling and splitting — the rampler role.
+
+The reference wrapper shells out to the vendored `rampler` binary for two
+operations (scripts/racon_wrapper.py:62-63,87-88; SURVEY.md §2b):
+
+  subsample <sequences> <reference_length> <coverage>
+      randomly sample reads until their total length reaches
+      reference_length * coverage; written once per requested coverage as
+      `<base>_<coverage>x.<ext>`.
+  split <sequences> <chunk_size>
+      partition the sequences into consecutive chunks of at most
+      `chunk_size` bytes of sequence data, written as `<base>_<i>.<ext>`.
+
+This implementation uses the framework's own parsers (gzip-transparent)
+and writes plain FASTA/FASTQ, matching rampler's output naming so the
+wrapper's file discovery works identically.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import sys
+
+from .errors import RaconError
+from .io.parsers import create_sequence_parser
+
+
+def _load(path: str):
+    seqs: list = []
+    create_sequence_parser(path, "rampler").parse(seqs, -1)
+    return seqs
+
+
+def _base_and_ext(path: str) -> tuple[str, str]:
+    base = os.path.basename(path).split(".")[0]
+    is_fasta = any(path.endswith(e) for e in
+                   (".fasta", ".fasta.gz", ".fa", ".fa.gz",
+                    ".fna", ".fna.gz"))
+    return base, (".fasta" if is_fasta else ".fastq")
+
+
+def _write(path: str, seqs, ext: str) -> None:
+    with open(path, "wb") as f:
+        for s in seqs:
+            if ext == ".fastq" and s.quality:
+                f.write(b"@" + s.name.encode() + b"\n" + s.data + b"\n+\n"
+                        + s.quality + b"\n")
+            else:
+                f.write(b">" + s.name.encode() + b"\n" + s.data + b"\n")
+
+
+def subsample(sequences_path: str, reference_length: int, coverage: int,
+              out_directory: str = ".", seed: int = 17) -> str:
+    """Random subsample to ~reference_length * coverage total bases.
+    Returns the output path `<base>_<coverage>x.<ext>`."""
+    seqs = _load(sequences_path)
+    base, ext = _base_and_ext(sequences_path)
+    if ext == ".fastq" and not all(s.quality for s in seqs):
+        ext = ".fasta"
+
+    target = reference_length * coverage
+    order = list(range(len(seqs)))
+    random.Random(seed).shuffle(order)
+    picked = []
+    total = 0
+    for i in order:
+        if total >= target:
+            break
+        picked.append(i)
+        total += len(seqs[i].data)
+    picked.sort()  # keep input order, like a streaming sampler would
+
+    out = os.path.join(out_directory, f"{base}_{coverage}x{ext}")
+    _write(out, [seqs[i] for i in picked], ext)
+    return out
+
+
+def split(sequences_path: str, chunk_size: int,
+          out_directory: str = ".") -> list[str]:
+    """Partition into consecutive chunks of <= chunk_size sequence bytes
+    (any sequence longer than chunk_size gets its own chunk). Returns the
+    output paths `<base>_<i>.<ext>`."""
+    if chunk_size <= 0:
+        raise RaconError("rampler.split", "invalid chunk size!")
+    seqs = _load(sequences_path)
+    base, ext = _base_and_ext(sequences_path)
+
+    outs: list[str] = []
+    chunk: list = []
+    chunk_bytes = 0
+    for s in seqs:
+        if chunk and chunk_bytes + len(s.data) > chunk_size:
+            out = os.path.join(out_directory, f"{base}_{len(outs)}{ext}")
+            _write(out, chunk, ext)
+            outs.append(out)
+            chunk, chunk_bytes = [], 0
+        chunk.append(s)
+        chunk_bytes += len(s.data)
+    if chunk:
+        out = os.path.join(out_directory, f"{base}_{len(outs)}{ext}")
+        _write(out, chunk, ext)
+        outs.append(out)
+    return outs
+
+
+def main(argv: list[str] | None = None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="racon_tpu_rampler",
+        description="sequence subsampling/splitting (rampler equivalent)")
+    parser.add_argument("-o", "--out-directory", default=".")
+    sub = parser.add_subparsers(dest="mode", required=True)
+    p_sub = sub.add_parser("subsample")
+    p_sub.add_argument("sequences")
+    p_sub.add_argument("reference_length", type=int)
+    p_sub.add_argument("coverage", type=int)
+    p_spl = sub.add_parser("split")
+    p_spl.add_argument("sequences")
+    p_spl.add_argument("chunk_size", type=int)
+
+    args = parser.parse_args(argv)
+    try:
+        if args.mode == "subsample":
+            subsample(args.sequences, args.reference_length, args.coverage,
+                      args.out_directory)
+        else:
+            split(args.sequences, args.chunk_size, args.out_directory)
+    except RaconError as exc:
+        print(str(exc), file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,84 @@
+"""Tests for the wrapper / rampler / preprocess tooling (the reference's
+scripts/ layer, SURVEY.md §2a Wrapper/Preprocess + §2b rampler)."""
+
+import io
+import os
+
+import pytest
+
+from racon_tpu import preprocess, rampler
+from racon_tpu.io.parsers import create_sequence_parser
+
+DATA = "/root/reference/test/data/"
+
+needs_data = pytest.mark.skipif(not os.path.isdir(DATA),
+                                reason="sample data missing")
+
+
+def _load(path):
+    seqs = []
+    create_sequence_parser(path, "test").parse(seqs, -1)
+    return seqs
+
+
+def write_fasta(path, records):
+    with open(path, "wb") as f:
+        for name, data in records:
+            f.write(b">" + name + b"\n" + data + b"\n")
+
+
+def test_rampler_split(tmp_path):
+    src = tmp_path / "tgt.fasta"
+    write_fasta(src, [(b"a", b"A" * 600), (b"b", b"C" * 600),
+                      (b"c", b"G" * 600), (b"d", b"T" * 100)])
+    parts = rampler.split(str(src), 1000, str(tmp_path))
+    assert [os.path.basename(p) for p in parts] == \
+        ["tgt_0.fasta", "tgt_1.fasta", "tgt_2.fasta"]
+    sizes = [[len(s.data) for s in _load(p)] for p in parts]
+    assert sizes == [[600], [600], [600, 100]]
+
+
+def test_rampler_split_rejects_bad_chunk(tmp_path):
+    src = tmp_path / "tgt.fasta"
+    write_fasta(src, [(b"a", b"ACGT")])
+    from racon_tpu.errors import RaconError
+    with pytest.raises(RaconError):
+        rampler.split(str(src), 0, str(tmp_path))
+
+
+def test_rampler_subsample(tmp_path):
+    src = tmp_path / "reads.fasta"
+    write_fasta(src, [(str(i).encode(), b"ACGT" * 100) for i in range(50)])
+    out = rampler.subsample(str(src), 1000, 4, str(tmp_path))
+    assert os.path.basename(out) == "reads_4x.fasta"
+    seqs = _load(out)
+    total = sum(len(s.data) for s in seqs)
+    # stops once >= ref_len * coverage
+    assert 4000 <= total < 4000 + 400
+    # no duplicates
+    assert len({s.name for s in seqs}) == len(seqs)
+
+
+def test_preprocess_uniquifies_pairs(tmp_path):
+    fq = tmp_path / "pairs.fastq"
+    fq.write_bytes(b"@r1 x\nACGT\n+\nIIII\n@r1 y\nTTTT\n+\nIIII\n"
+                   b"@r2\nGGGG\n+\nIIII\n")
+    buf = io.BytesIO()
+    preprocess.process([str(fq)], out=buf)
+    lines = buf.getvalue().split(b"\n")
+    assert lines[0] == b"@r11" and lines[4] == b"@r12" and lines[8] == b"@r21"
+
+
+@needs_data
+def test_wrapper_split_run_matches_whole(tmp_path):
+    """Polishing through the wrapper with --split must produce the same
+    single contig as the plain CLI (one target => one chunk per split of
+    its bytes; sample layout is one contig so split larger than it)."""
+    out = io.BytesIO()
+    from racon_tpu.wrapper import run
+    run(DATA + "sample_reads.fastq.gz", DATA + "sample_overlaps.sam.gz",
+        DATA + "sample_layout.fasta.gz", split=10_000_000, threads=2,
+        out=out)
+    seqs = out.getvalue()
+    assert seqs.count(b">") == 1
+    assert seqs.startswith(b">utg000001l")
