@@ -360,16 +360,41 @@ class Polisher:
 
     # ---------------------------------------------------------------- polish
     def polish(self, drop_unpolished_sequences: bool = True) -> list[Sequence]:
+        """Per-window consensus + stitch (reference polisher.cpp:486-548).
+
+        Set RACON_TPU_PROFILE=<dir> to capture a jax.profiler trace of the
+        consensus phase (the TPU analogue of the reference's nvprof
+        `-lineinfo` support, CMakeLists.txt:26); per-phase windows/sec is
+        reported on stderr either way.
+        """
+        import contextlib
+        import os
+        import time as _time
+
         from ..ops.poa import BatchPOA
 
         self.logger.log()
+
+        profile_dir = os.environ.get("RACON_TPU_PROFILE")
+        if profile_dir and self.tpu_poa_batches > 0:
+            import jax
+
+            profile_ctx = jax.profiler.trace(profile_dir)
+        else:
+            profile_ctx = contextlib.nullcontext()
 
         engine = BatchPOA(self.match, self.mismatch, self.gap,
                           self.window_length, num_threads=self.num_threads,
                           device_batches=self.tpu_poa_batches,
                           band_width=self.tpu_aligner_band_width,
                           logger=self.logger)
-        engine.generate_consensus(self.windows, self.trim)
+        t_consensus = _time.perf_counter()
+        with profile_ctx:
+            engine.generate_consensus(self.windows, self.trim)
+        dt = _time.perf_counter() - t_consensus
+        if dt > 0 and self.windows:
+            print(f"[racon_tpu::Polisher.polish] consensus throughput: "
+                  f"{len(self.windows) / dt:.1f} windows/s", file=sys.stderr)
 
         dst: list[Sequence] = []
         polished_data = bytearray()

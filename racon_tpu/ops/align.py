@@ -30,9 +30,6 @@ import functools
 
 import numpy as np
 
-import jax
-import jax.numpy as jnp
-
 INF = np.int32(1 << 28)
 
 # backpointer codes
@@ -63,7 +60,16 @@ def band_offsets(q_len: int, t_len: int, band: int, n_waves: int) -> np.ndarray:
     return off.astype(np.int32)
 
 
-@functools.partial(jax.jit, static_argnames=("band", "n_waves"))
+@functools.lru_cache(maxsize=None)
+def _kernel_for(band: int, n_waves: int):
+    """jitted banded DP for one static (band, n_waves) shape; jax is
+    imported lazily so the module loads without a device runtime."""
+    import jax
+
+    return jax.jit(functools.partial(_banded_nw_kernel, band=band,
+                                     n_waves=n_waves))
+
+
 def _banded_nw_kernel(q, t, q_len, t_len, offsets, band: int, n_waves: int):
     """Batched banded edit-distance DP.
 
@@ -78,6 +84,9 @@ def _banded_nw_kernel(q, t, q_len, t_len, offsets, band: int, n_waves: int):
       bp_packed: [n_waves, B, band // 4] uint8 — 2-bit backpointers.
       distance: [B] int32 edit distance at (M, N).
     """
+    import jax
+    import jax.numpy as jnp
+
     batch = q.shape[0]
     ks = jnp.arange(band, dtype=jnp.int32)
 
@@ -158,12 +167,16 @@ def _unpack_bp(bp_packed: np.ndarray) -> np.ndarray:
 
 
 def _traceback(bp: np.ndarray, offsets: np.ndarray, q_lens: np.ndarray,
-               t_lens: np.ndarray) -> list[list[tuple[int, str]]]:
+               t_lens: np.ndarray):
     """Vectorized-across-lanes traceback.
 
     Walks all lanes simultaneously from (M, N) to (0, 0); each numpy step
-    advances every unfinished lane by one op. Returns per-lane op runs
-    (length, op) in forward order.
+    advances every unfinished lane by one op. Returns (per-lane op runs in
+    forward order, per-lane touched_edge flags). A lane whose optimal
+    in-band path rides the band boundary may have been clipped away from
+    the true optimum — the caller treats those as rejections and re-aligns
+    on the host (the cudaaligner status -> CPU fallback pattern,
+    src/cuda/cudaaligner.cpp:63-71).
     """
     n_lanes = bp.shape[1]
     band = bp.shape[2]
@@ -174,12 +187,22 @@ def _traceback(bp: np.ndarray, offsets: np.ndarray, q_lens: np.ndarray,
 
     ops = np.zeros((n_lanes, max_steps), dtype=np.uint8)
     counts = np.zeros(n_lanes, dtype=np.int64)
+    touched = np.zeros(n_lanes, dtype=bool)
 
     lanes = np.arange(n_lanes)
+    ql = q_lens.astype(np.int64)
+    tl = t_lens.astype(np.int64)
     step = 0
     while active.any() and step < max_steps:
         d = i + j
-        k = i - offsets[lanes, np.minimum(d, offsets.shape[1] - 1)]
+        off = offsets[lanes, np.minimum(d, offsets.shape[1] - 1)].astype(np.int64)
+        k = i - off
+        # a band-boundary cell marks possible clipping, but only when the
+        # matrix actually continues past the boundary on that side
+        row_lo = np.maximum(0, d - tl)
+        row_hi = np.minimum(d, ql)
+        touched |= active & (k <= 0) & (off > row_lo)
+        touched |= active & (k >= band - 1) & (off + band - 1 < row_hi)
         k = np.clip(k, 0, band - 1)
         code = bp[np.minimum(d, bp.shape[0] - 1), lanes, k]
         # boundary overrides: on i==0 only D possible; on j==0 only I
@@ -206,7 +229,7 @@ def _traceback(bp: np.ndarray, offsets: np.ndarray, q_lens: np.ndarray,
             ends = np.concatenate((change + 1, [len(seq)]))
             runs = [(int(e - s), code_to_op[int(seq[s])]) for s, e in zip(starts, ends)]
         out.append(runs)
-    return out
+    return out, touched
 
 
 class BatchAligner:
@@ -214,9 +237,16 @@ class BatchAligner:
     on the device — the orchestration analogue of CUDABatchAligner
     (src/cuda/cudaaligner.cpp) with XLA instead of CUDA streams.
 
-    band_width=0 means auto: 10% of the bucket's max length (even), matching
-    the reference's auto band (src/cuda/cudapolisher.cpp:158-174), with a
-    floor that also covers the length difference of each pair.
+    band_width=0 means auto: 10% of the mean pair length, forced even —
+    the reference's auto band rule (src/cuda/cudapolisher.cpp:158-174) —
+    quantized up to a multiple of 128 so each bucket compiles exactly once.
+
+    Rejection statuses mirror cudaaligner (src/cuda/cudaaligner.cpp:63-71):
+    pairs beyond the largest bucket, pairs whose traceback rode the band
+    boundary, and pairs whose in-band cost is beyond what a <=30%-error
+    overlap can produce (both signs of band clipping) return None, and the
+    caller host-aligns them (the GPU->CPU fallback,
+    cudapolisher.cpp:203-213) — no overlap is ever dropped.
     """
 
     #: length bucket edges (sequences are padded to the bucket edge)
@@ -224,9 +254,14 @@ class BatchAligner:
     #: target bytes of packed backpointers per device batch
     MAX_BP_BYTES = 192 * 1024 * 1024
 
-    def __init__(self, band_width: int = 0, max_length: int = 65536):
+    def __init__(self, band_width: int = 0, max_length: int = 65536,
+                 runner=None):
         self.band_width = band_width
         self.max_length = max_length
+        self.runner = runner
+        #: pairs whose banded distance hit the band-adequacy limit and were
+        #: sent back for exact host alignment (observability, SURVEY.md §5)
+        self.n_band_rejects = 0
 
     def _bucket_of(self, length: int) -> int | None:
         for edge in self.BUCKETS:
@@ -234,54 +269,80 @@ class BatchAligner:
                 return edge
         return None
 
+    def _band_for(self, pairs, idxs) -> int:
+        if self.band_width > 0:
+            band = self.band_width
+        else:
+            mean_len = sum(max(len(pairs[i][0]), len(pairs[i][1]))
+                           for i in idxs) / len(idxs)
+            band = int(mean_len * 0.1)
+        # quantizing up to a multiple of 128 (which subsumes the
+        # reference's force-even rule) keeps compiled shapes to one per
+        # bucket
+        return max(128, (band + 127) // 128 * 128)
+
     def align(self, pairs: list[tuple[bytes, bytes]],
               progress=None) -> list[list[tuple[int, str]] | None]:
         """Globally align each (query, target) pair. Returns per-pair op runs,
-        or None for pairs rejected by capacity limits (those fall back to the
-        caller's exact host aligner, mirroring the reference's GPU->CPU
-        fallback, src/cuda/cudapolisher.cpp:203-213)."""
-        from .encode import encode_padded
+        or None for rejected pairs (see class docstring)."""
+        import jax
 
+        from .encode import encode_padded
+        from ..parallel.mesh import BatchRunner
+
+        runner = self.runner if self.runner is not None else BatchRunner()
         results: list[list[tuple[int, str]] | None] = [None] * len(pairs)
-        # group by bucket
         groups: dict[int, list[int]] = {}
         for idx, (qs, ts) in enumerate(pairs):
             edge = self._bucket_of(max(len(qs), len(ts)))
             if edge is None or not qs or not ts:
-                continue
+                continue  # host aligner handles these
             groups.setdefault(edge, []).append(idx)
 
+        # one band for the whole run, from the global mean (reference rule)
+        all_idxs = [i for idxs in groups.values() for i in idxs]
+        if not all_idxs:
+            return results
+        band = self._band_for(pairs, all_idxs)
+
         for edge, idxs in sorted(groups.items()):
-            band = self.band_width
-            if band <= 0:
-                band = max(128, int(edge * 0.1))
-            # band must cover worst length difference in this bucket
-            worst_dl = max(abs(len(pairs[i][0]) - len(pairs[i][1])) for i in idxs)
-            band = max(band, worst_dl + 32)
-            band = (band + 3) // 4 * 4
             n_waves = 2 * edge + 1
+            kernel = _kernel_for(band, n_waves)
 
             lane_bytes = n_waves * (band // 4)
-            max_lanes = max(1, self.MAX_BP_BYTES // lane_bytes)
+            max_lanes = max(runner.n_devices,
+                            self.MAX_BP_BYTES // lane_bytes)
 
             for s in range(0, len(idxs), max_lanes):
                 chunk = idxs[s:s + max_lanes]
                 qs = [pairs[i][0] for i in chunk]
                 ts = [pairs[i][1] for i in chunk]
-                q_arr, q_lens = encode_padded(qs, edge)
-                t_arr, t_lens = encode_padded(ts, edge)
+                lanes = runner.round_batch(len(chunk))
+                q_arr, q_lens = encode_padded(qs + [b"A"] * (lanes - len(chunk)), edge)
+                t_arr, t_lens = encode_padded(ts + [b"A"] * (lanes - len(chunk)), edge)
                 offs = np.stack([band_offsets(int(ql), int(tl), band, n_waves)
                                  for ql, tl in zip(q_lens, t_lens)])
-                bp_packed, _dist = _banded_nw_kernel(
-                    jnp.asarray(q_arr), jnp.asarray(t_arr),
-                    jnp.asarray(q_lens), jnp.asarray(t_lens),
-                    jnp.asarray(offs), band=band, n_waves=n_waves)
+                bp_packed, dist = runner.run(
+                    kernel, q_arr, t_arr, q_lens.astype(np.int32),
+                    t_lens.astype(np.int32), offs)
+                dist = np.asarray(dist).astype(np.int64)
                 bp = _unpack_bp(np.asarray(jax.device_get(bp_packed)))
-                runs = _traceback(bp, offs, q_lens, t_lens)
+                runs, touched = _traceback(bp, offs, q_lens, t_lens)
+                # second clipping signal: an in-band cost far above what a
+                # <=30%-error overlap can produce means the true (off-band)
+                # path was clipped — e.g. a large balanced indel whose
+                # in-band "alignment" is a run of mismatches
+                suspicious = dist > 0.4 * np.maximum(q_lens, t_lens)
+                accepted = 0
                 for lane, i_pair in enumerate(chunk):
-                    results[i_pair] = runs[lane]
+                    if touched[lane] or suspicious[lane]:
+                        self.n_band_rejects += 1  # clipped: host re-aligns
+                    else:
+                        results[i_pair] = runs[lane]
+                        accepted += 1
                 if progress is not None:
-                    progress(len(chunk))
+                    # rejected pairs tick when the host fallback aligns them
+                    progress(accepted)
         return results
 
 

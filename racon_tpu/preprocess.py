@@ -19,17 +19,18 @@ from .io.parsers import create_sequence_parser
 
 def process(paths: list[str], out=None) -> None:
     out = out if out is not None else sys.stdout.buffer
-    seen: set[str] = set()
+    seen: dict[str, int] = {}
     for path in paths:
         seqs: list = []
         create_sequence_parser(path, "preprocess").parse(seqs, -1)
         for s in seqs:
             name = s.name.split(" ")[0]
-            if name in seen:
-                name += "2"
-            else:
-                seen.add(name)
-                name += "1"
+            # occurrence index: mate 1 -> "1", mate 2 -> "2" (like the
+            # reference); further repeats keep counting up so names stay
+            # unique even on malformed triplicated input
+            count = seen.get(name, 0) + 1
+            seen[name] = count
+            name += str(count)
             qual = s.quality if s.quality else b"!" * len(s.data)
             out.write(b"@" + name.encode() + b"\n" + s.data + b"\n+\n"
                       + qual + b"\n")

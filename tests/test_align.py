@@ -106,3 +106,44 @@ def test_determinism():
     r1 = BatchAligner().align([(q, t)])
     r2 = BatchAligner().align([(q, t)])
     assert r1 == r2
+
+
+def test_pathological_indel_rejected_not_wrong():
+    """A large balanced indel forces the optimal path far off the ideal
+    diagonal; the banded kernel must flag it for exact host realignment
+    (reference pattern: cudaaligner status -> CPU, cudaaligner.cpp:63-71)
+    instead of returning a silently clipped alignment."""
+    rng = np.random.default_rng(11)
+    t = _random_seq(rng, 2000)
+    # rotation: the optimal path runs ~1000 rows off the ideal diagonal,
+    # far outside a 128-wide band; the in-band "alignment" is mismatch soup
+    q = t[1000:] + t[:1000]
+    al = BatchAligner(band_width=128)
+    res = al.align([(q, t)])
+    assert res == [None]
+    assert al.n_band_rejects == 1
+
+
+def test_device_aligner_through_polisher(reference_data):
+    """tpu_aligner_batches=1 routes PAF overlaps through the device kernel
+    with host fallback; windows/layers must match the host-only path."""
+    from racon_tpu.core.polisher import create_polisher, PolisherType
+
+    def build(dev):
+        p = create_polisher(
+            str(reference_data / "sample_reads.fastq.gz"),
+            str(reference_data / "sample_overlaps.paf.gz"),
+            str(reference_data / "sample_layout.fasta.gz"),
+            PolisherType.kC, 500, 10.0, 0.3, num_threads=2,
+            tpu_aligner_batches=dev)
+        p.initialize()
+        return p
+
+    host = build(0)
+    dev = build(1)
+    assert len(host.windows) == len(dev.windows)
+    n_equal = sum(hw.num_layers == dw.num_layers
+                  for hw, dw in zip(host.windows, dev.windows))
+    # banded device CIGARs may shift a few window boundaries (the reference
+    # accepts the same CPU-vs-GPU divergence); structure must agree broadly
+    assert n_equal >= int(0.9 * len(host.windows))
