@@ -333,7 +333,16 @@ class Polisher:
             if self.tpu_aligner_batches > 0:
                 from ..ops.align import BatchAligner
                 aligner = BatchAligner(band_width=self.tpu_aligner_band_width)
-                runs = aligner.align(pairs, progress=bar_n)
+                try:
+                    runs = aligner.align(pairs, progress=bar_n)
+                except Exception as exc:  # device init/OOM: host completes
+                    # the cudautils-style device error check with graceful
+                    # degradation instead of exit (cudautils.hpp:10-18)
+                    print("[racon_tpu::Polisher.initialize] warning: device "
+                          f"alignment failed ({type(exc).__name__}: {exc}); "
+                          "falling back to host aligner", file=sys.stderr)
+                    runs = [None] * len(pairs)
+                    self.logger.bar_total(len(pairs))  # restart progress
 
             # host exact aligner for everything the device didn't take —
             # the reference's GPU->CPU fallback (cudapolisher.cpp:203-213)

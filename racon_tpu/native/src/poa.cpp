@@ -2,6 +2,7 @@
 
 #include <algorithm>
 #include <cassert>
+#include <cstdlib>
 #include <cstring>
 #include <deque>
 #include <limits>
@@ -206,7 +207,8 @@ std::vector<int32_t> Graph::topo_order() const {
 static constexpr int32_t kNegInf = std::numeric_limits<int32_t>::min() / 4;
 
 Alignment Graph::align_nw(const uint8_t* seq, int32_t len, int32_t match,
-                          int32_t mismatch, int32_t gap) const {
+                          int32_t mismatch, int32_t gap, int32_t band,
+                          int32_t bpos_origin) const {
     Alignment out;
     const int32_t n = static_cast<int32_t>(nodes.size());
     if (n == 0 || len <= 0) {
@@ -226,10 +228,34 @@ Alignment Graph::align_nw(const uint8_t* seq, int32_t len, int32_t match,
         H[j] = j * gap;
     }
 
+    // per-code substitution profiles hoisted out of the DP loops (the
+    // striped-profile idea SIMD POA engines use): profile[c][j] is the
+    // diagonal score delta for aligning seq[j-1] to a code-c node, so the
+    // inner loops below are branchless and auto-vectorize.
+    std::vector<int32_t> profile(static_cast<size_t>(5) * stride);
+    for (int32_t c = 0; c < 5; ++c) {
+        int32_t* p = &profile[static_cast<size_t>(c) * stride];
+        for (int32_t j = 1; j <= len; ++j) {
+            p[j] = (kBaseCode[seq[j - 1]] == c) ? match : mismatch;
+        }
+    }
+
     std::vector<int32_t> pred_rows;  // predecessor row indices, reused
     for (int32_t r = 1; r <= n; ++r) {
         const Node& node = nodes[order[r - 1]];
         int32_t* row = &H[static_cast<size_t>(r) * stride];
+        const int32_t* prof =
+            &profile[static_cast<size_t>(node.code) * stride];
+
+        // banded: compute only columns near the node's expected diagonal;
+        // everything else scores -inf (cheap vector fill vs DP compute)
+        int32_t jlo = 1, jhi = len;
+        if (band > 0) {
+            const int32_t center = node.bpos - bpos_origin + 1;
+            jlo = std::max<int32_t>(1, center - band / 2);
+            jhi = std::min<int32_t>(len, center + band / 2);
+            std::fill(row, row + stride, kNegInf);
+        }
 
         pred_rows.clear();
         for (int32_t ei : node.in) {
@@ -243,29 +269,24 @@ Alignment Graph::align_nw(const uint8_t* seq, int32_t len, int32_t match,
         {
             const int32_t* prow = &H[static_cast<size_t>(pred_rows[0]) * stride];
             row[0] = prow[0] + gap;
-            for (int32_t j = 1; j <= len; ++j) {
-                const int32_t sub =
-                    (kBaseCode[seq[j - 1]] == node.code) ? match : mismatch;
-                int32_t best = prow[j - 1] + sub;           // diagonal
-                const int32_t vert = prow[j] + gap;          // graph gap
-                if (vert > best) best = vert;
-                row[j] = best;
+            for (int32_t j = jlo; j <= jhi; ++j) {
+                const int32_t diag = prow[j - 1] + prof[j];
+                const int32_t vert = prow[j] + gap;
+                row[j] = diag > vert ? diag : vert;
             }
         }
         for (size_t pi = 1; pi < pred_rows.size(); ++pi) {
             const int32_t* prow = &H[static_cast<size_t>(pred_rows[pi]) * stride];
             if (prow[0] + gap > row[0]) row[0] = prow[0] + gap;
-            for (int32_t j = 1; j <= len; ++j) {
-                const int32_t sub =
-                    (kBaseCode[seq[j - 1]] == node.code) ? match : mismatch;
-                int32_t best = prow[j - 1] + sub;
+            for (int32_t j = jlo; j <= jhi; ++j) {
+                const int32_t diag = prow[j - 1] + prof[j];
                 const int32_t vert = prow[j] + gap;
-                if (vert > best) best = vert;
+                const int32_t best = diag > vert ? diag : vert;
                 if (best > row[j]) row[j] = best;
             }
         }
         // horizontal pass (sequence gap) — must run after all predecessors
-        for (int32_t j = 1; j <= len; ++j) {
+        for (int32_t j = jlo; j <= jhi; ++j) {
             const int32_t horiz = row[j - 1] + gap;
             if (horiz > row[j]) row[j] = horiz;
         }
@@ -484,16 +505,46 @@ std::vector<uint8_t> window_consensus(
     const int32_t backbone_len = lens[0];
     const int32_t offset = static_cast<int32_t>(0.01 * backbone_len);
     const bool anchored = prealigned != nullptr;
+    // static band (the cudapoa band-256 contract, cudabatch.cpp:56-59);
+    // a layer whose length diverges from its graph span by close to the
+    // half-band cannot fit the band and gets the exact full DP instead
+    constexpr int32_t kBand = 256;
+    // banded-result sanity: if fewer than half the aligned columns match,
+    // the in-band path is mismatch soup from band clipping (e.g. balanced
+    // indels with small net length change) — redo with the exact full DP,
+    // the same accept/reject discipline the device aligner applies
+    auto band_clipped = [&](const Alignment& aln, const uint8_t* s,
+                            const Graph& g) -> bool {
+        int32_t aligned = 0, matched = 0;
+        for (const auto& p : aln) {
+            if (p.node >= 0 && p.pos >= 0) {
+                ++aligned;
+                matched += g.nodes[p.node].code == kBaseCode[s[p.pos]];
+            }
+        }
+        return aligned == 0 || 2 * matched < aligned;
+    };
     for (int32_t i : rank) {
         Alignment aln;
         if (anchored) {
             aln = prealigned[i];
         } else if (begins[i] < offset && ends[i] > backbone_len - offset) {
-            aln = graph.align_nw(seqs[i], lens[i], match, mismatch, gap);
+            const bool fits = std::abs(lens[i] - backbone_len) < kBand / 2 - 16;
+            aln = graph.align_nw(seqs[i], lens[i], match, mismatch, gap,
+                                 fits ? kBand : 0, 0);
+            if (fits && band_clipped(aln, seqs[i], graph)) {
+                aln = graph.align_nw(seqs[i], lens[i], match, mismatch, gap);
+            }
         } else {
+            const int32_t span = ends[i] - begins[i] + 1;
+            const bool fits = std::abs(lens[i] - span) < kBand / 2 - 16;
             std::vector<int32_t> mapping;
             Graph sub = graph.subgraph(begins[i], ends[i], mapping);
-            aln = sub.align_nw(seqs[i], lens[i], match, mismatch, gap);
+            aln = sub.align_nw(seqs[i], lens[i], match, mismatch, gap,
+                               fits ? kBand : 0, begins[i]);
+            if (fits && band_clipped(aln, seqs[i], sub)) {
+                aln = sub.align_nw(seqs[i], lens[i], match, mismatch, gap);
+            }
             Graph::update_alignment(aln, mapping);
         }
         graph.add_alignment(aln, seqs[i], lens[i], weights_of(i), anchored);

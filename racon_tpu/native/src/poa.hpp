@@ -83,8 +83,16 @@ public:
     // Global (NW) alignment of seq against the whole graph with linear gap
     // scoring; maximizes score; alignment ends in a sink node column.
     // Tie order on traceback: diagonal > vertical (graph gap) > horizontal.
+    //
+    // band > 0 restricts each node row's DP to sequence columns within
+    // band/2 of the node's expected diagonal (bpos - bpos_origin), the
+    // static-band idea of cudapoa (src/cuda/cudabatch.cpp:56-59 band 256);
+    // cells outside score -inf. band 0 = exact full DP. Callers pass
+    // band 0 whenever |len - graph span| approaches band/2 (the band
+    // cannot contain the path then).
     Alignment align_nw(const uint8_t* seq, int32_t len, int32_t match,
-                       int32_t mismatch, int32_t gap) const;
+                       int32_t mismatch, int32_t gap, int32_t band = 0,
+                       int32_t bpos_origin = 0) const;
 
     // Subgraph induced by nodes with begin <= bpos <= end (backbone column
     // range, inclusive — reference window.cpp:97-102 contract). `mapping`

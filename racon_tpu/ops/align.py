@@ -270,15 +270,20 @@ class BatchAligner:
         return None
 
     def _band_for(self, pairs, idxs) -> int:
+        """Auto band for one bucket: 10% of the bucket's mean pair length
+        (the reference's auto rule, cudapolisher.cpp:158-174) with a floor
+        covering the bucket's worst length difference (the endpoint must be
+        reachable without riding the band edge), quantized up to a multiple
+        of 128 — one compiled shape per bucket, cached across runs. An
+        explicit band_width is honored as given (rounded up to a multiple
+        of 4 for backpointer packing)."""
         if self.band_width > 0:
-            band = self.band_width
-        else:
-            mean_len = sum(max(len(pairs[i][0]), len(pairs[i][1]))
-                           for i in idxs) / len(idxs)
-            band = int(mean_len * 0.1)
-        # quantizing up to a multiple of 128 (which subsumes the
-        # reference's force-even rule) keeps compiled shapes to one per
-        # bucket
+            return (self.band_width + 3) // 4 * 4
+        mean_len = sum(max(len(pairs[i][0]), len(pairs[i][1]))
+                       for i in idxs) / len(idxs)
+        worst_dl = max(abs(len(pairs[i][0]) - len(pairs[i][1]))
+                       for i in idxs)
+        band = max(int(mean_len * 0.1), worst_dl + 32)
         return max(128, (band + 127) // 128 * 128)
 
     def align(self, pairs: list[tuple[bytes, bytes]],
@@ -299,13 +304,8 @@ class BatchAligner:
                 continue  # host aligner handles these
             groups.setdefault(edge, []).append(idx)
 
-        # one band for the whole run, from the global mean (reference rule)
-        all_idxs = [i for idxs in groups.values() for i in idxs]
-        if not all_idxs:
-            return results
-        band = self._band_for(pairs, all_idxs)
-
         for edge, idxs in sorted(groups.items()):
+            band = self._band_for(pairs, idxs)
             n_waves = 2 * edge + 1
             kernel = _kernel_for(band, n_waves)
 

@@ -60,7 +60,15 @@ class BatchPOA:
             return
 
         if self.device_batches > 0:
-            host = self._device_consensus(todo, trim)
+            import sys
+
+            try:
+                host = self._device_consensus(todo, trim)
+            except Exception as exc:  # device init/OOM: host completes all
+                print("[racon_tpu::BatchPOA] warning: device consensus "
+                      f"failed ({type(exc).__name__}: {exc}); falling back "
+                      "to host engine", file=sys.stderr)
+                host = [w for w in todo if not w.polished]
         else:
             host = todo
 
@@ -154,7 +162,11 @@ class _Rewindow:
         # backbone itself (reference polisher.cpp:393 dummy quality)
         self.qualities = [b"!" * len(consensus)] + list(w.qualities[1:])
         self.positions = [(0, end)]
+        # linear rescale can misplace a span by up to the total indel count
+        # when indels are unevenly distributed — widen by that bound so the
+        # true region is always inside the aligned slice
+        slack = 16 + abs(len(consensus) - backbone_len)
         for b, e in w.positions[1:]:
-            nb = max(0, int(b * scale) - 16)
-            ne = min(end, int(e * scale) + 17)
+            nb = max(0, int(b * scale) - slack)
+            ne = min(end, int(e * scale) + slack + 1)
             self.positions.append((nb, max(ne, nb + 1)))
