@@ -429,6 +429,8 @@ class Polisher:
                 polished_data = bytearray()
 
         self.logger.log("[racon_tpu::Polisher.polish] generated consensus")
+        # cumulative wall-clock, mirroring ~Polisher (polisher.cpp:189)
+        self.logger.total("[racon_tpu::Polisher.] total =")
         self.windows = []
         self.sequences = []
         return dst

@@ -195,20 +195,64 @@ class SamParser(_StreamingParser):
             yield o, len(line)
 
 
+class _NativeSequenceParser(_StreamingParser):
+    """FASTA/FASTQ via the native zlib loader (native/src/parse.cpp) —
+    tokenization and IO in C++, Python only wraps the record slices. Same
+    streaming contract as the pure-Python parsers above."""
+
+    def __init__(self, path: str, fastq: bool):
+        super().__init__(path)
+        self._fastq = fastq
+        self._sf = None
+
+    def reset(self) -> None:
+        from ..native import SequenceFile
+
+        if self._sf is not None:
+            self._sf.close()
+        self._sf = SequenceFile(self.path, self._fastq)
+
+    def parse(self, dst: list, max_bytes: int = -1) -> bool:
+        if self._sf is None:
+            self.reset()
+        try:
+            records, more = self._sf.chunk(max_bytes)
+        except ValueError:
+            if self._fastq:
+                raise RaconError("FastqParser",
+                                 f"malformed FASTQ file {self.path}!") from None
+            raise RaconError("FastaParser",
+                             f"malformed FASTA file {self.path}!") from None
+        for name, seq, qual in records:
+            dst.append(Sequence(name.decode(), seq, qual or b""))
+        return more
+
+
 _SEQUENCE_EXTENSIONS_FASTA = (".fasta", ".fasta.gz", ".fna", ".fna.gz", ".fa", ".fa.gz")
 _SEQUENCE_EXTENSIONS_FASTQ = (".fastq", ".fastq.gz", ".fq", ".fq.gz")
 
 
 def create_sequence_parser(path: str, scope: str) -> _StreamingParser:
-    """Extension-sniffed sequence parser (reference polisher.cpp:83-99,117-133)."""
+    """Extension-sniffed sequence parser (reference polisher.cpp:83-99,117-133).
+
+    Prefers the native loader; falls back to the pure-Python parsers when
+    the native library is unavailable (e.g. no compiler)."""
     if path.endswith(_SEQUENCE_EXTENSIONS_FASTA):
-        return FastaParser(path)
-    if path.endswith(_SEQUENCE_EXTENSIONS_FASTQ):
-        return FastqParser(path)
-    raise RaconError(scope,
-        f"file {path} has unsupported format extension (valid extensions: "
-        ".fasta, .fasta.gz, .fna, .fna.gz, .fa, .fa.gz, .fastq, .fastq.gz, "
-        ".fq, .fq.gz)!")
+        fastq = False
+    elif path.endswith(_SEQUENCE_EXTENSIONS_FASTQ):
+        fastq = True
+    else:
+        raise RaconError(scope,
+            f"file {path} has unsupported format extension (valid extensions: "
+            ".fasta, .fasta.gz, .fna, .fna.gz, .fa, .fa.gz, .fastq, .fastq.gz, "
+            ".fq, .fq.gz)!")
+    try:
+        from ..native import get_lib
+
+        get_lib()
+        return _NativeSequenceParser(path, fastq)
+    except Exception:  # pragma: no cover - no toolchain
+        return FastqParser(path) if fastq else FastaParser(path)
 
 
 def create_overlap_parser(path: str, scope: str) -> _StreamingParser:

@@ -20,7 +20,7 @@ import numpy as np
 _DIR = pathlib.Path(__file__).resolve().parent
 _SRC = _DIR / "src"
 _LIB = _DIR / "libracon_host.so"
-_SOURCES = ("poa.cpp", "myers.cpp", "api.cpp")
+_SOURCES = ("poa.cpp", "myers.cpp", "parse.cpp", "api.cpp")
 
 _lock = threading.Lock()
 _lib: ctypes.CDLL | None = None
@@ -41,7 +41,7 @@ def build(force: bool = False) -> pathlib.Path:
                 os.environ.get("CXX", "g++"),
                 "-O3", "-std=c++17", "-fPIC", "-shared", "-pthread",
                 "-o", str(_LIB),
-            ] + [str(_SRC / s) for s in _SOURCES]
+            ] + [str(_SRC / s) for s in _SOURCES] + ["-lz"]
             proc = subprocess.run(cmd, capture_output=True, text=True)
             if proc.returncode != 0:
                 raise RuntimeError(
@@ -78,8 +78,76 @@ def get_lib() -> ctypes.CDLL:
             i32, i32, i32, i32,
             u8p, u32p, i64, i64p,
         ]
+        vp = ctypes.c_void_p
+        u8pp = ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8))
+        i64pp = ctypes.POINTER(ctypes.POINTER(ctypes.c_int64))
+        lib.rh_sf_open.restype = vp
+        lib.rh_sf_open.argtypes = [ctypes.c_char_p, i32]
+        lib.rh_sf_chunk.restype = i64
+        lib.rh_sf_chunk.argtypes = [vp, i64, ctypes.POINTER(i32),
+                                    u8pp, i64pp, u8pp, i64pp, u8pp, i64pp]
+        lib.rh_sf_close.restype = None
+        lib.rh_sf_close.argtypes = [vp]
         _lib = lib
     return _lib
+
+
+class SequenceFile:
+    """Streaming native FASTA/FASTQ reader (the bioparser role). Yields
+    per-chunk flat buffers; see io/parsers.py for the record wrapper."""
+
+    def __init__(self, path: str, fastq: bool):
+        self._lib = get_lib()
+        self._path = path
+        self._fastq = fastq
+        self._handle = self._lib.rh_sf_open(path.encode(), 1 if fastq else 0)
+        if not self._handle:
+            raise OSError(f"cannot open {path}")
+
+    def chunk(self, max_bytes: int = -1):
+        """Returns (records, more) where records is a list of
+        (name_bytes, seq_bytes, qual_bytes|None). Raises ValueError on
+        malformed input."""
+        i32 = ctypes.c_int32
+        more = i32(0)
+        names = ctypes.POINTER(ctypes.c_uint8)()
+        seqs = ctypes.POINTER(ctypes.c_uint8)()
+        quals = ctypes.POINTER(ctypes.c_uint8)()
+        name_offs = ctypes.POINTER(ctypes.c_int64)()
+        seq_offs = ctypes.POINTER(ctypes.c_int64)()
+        qual_offs = ctypes.POINTER(ctypes.c_int64)()
+        n = self._lib.rh_sf_chunk(
+            self._handle, max_bytes, ctypes.byref(more),
+            ctypes.byref(names), ctypes.byref(name_offs),
+            ctypes.byref(seqs), ctypes.byref(seq_offs),
+            ctypes.byref(quals), ctypes.byref(qual_offs))
+        if n < 0:
+            raise ValueError(f"malformed input {self._path}")
+        records = []
+        for i in range(n):
+            name = ctypes.string_at(
+                ctypes.addressof(names.contents) + name_offs[i],
+                name_offs[i + 1] - name_offs[i])
+            seq = ctypes.string_at(
+                ctypes.addressof(seqs.contents) + seq_offs[i],
+                seq_offs[i + 1] - seq_offs[i])
+            qlen = qual_offs[i + 1] - qual_offs[i]
+            qual = (ctypes.string_at(
+                ctypes.addressof(quals.contents) + qual_offs[i], qlen)
+                if qlen else None)
+            records.append((name, seq, qual))
+        return records, bool(more.value)
+
+    def close(self):
+        if self._handle:
+            self._lib.rh_sf_close(self._handle)
+            self._handle = None
+
+    def __del__(self):  # pragma: no cover
+        try:
+            self.close()
+        except Exception:
+            pass
 
 
 def _u8(data: bytes | np.ndarray):

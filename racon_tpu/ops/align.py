@@ -271,20 +271,22 @@ class BatchAligner:
 
     def _band_for(self, pairs, idxs) -> int:
         """Auto band for one bucket: 10% of the bucket's mean pair length
-        (the reference's auto rule, cudapolisher.cpp:158-174) with a floor
-        covering the bucket's worst length difference (the endpoint must be
-        reachable without riding the band edge), quantized up to a multiple
-        of 128 — one compiled shape per bucket, cached across runs. An
-        explicit band_width is honored as given (rounded up to a multiple
-        of 4 for backpointer packing)."""
+        (the reference's auto rule, cudapolisher.cpp:158-174), quantized up
+        to a multiple of 128 — one compiled shape per bucket, cached across
+        runs. An explicit band_width is honored as given (rounded up to a
+        multiple of 4 for backpointer packing).
+
+        Length differences need no band floor: band_offsets tracks the
+        (0,0)->(M,N) ideal line, so a uniformly-stretched skewed pair fits
+        a narrow band, and a pair with concentrated indels is caught by the
+        edge-touch/cost signals and host-realigned. A floor keyed to the
+        bucket's worst pair would let one chimeric outlier balloon the
+        whole bucket's backpointer memory."""
         if self.band_width > 0:
             return (self.band_width + 3) // 4 * 4
         mean_len = sum(max(len(pairs[i][0]), len(pairs[i][1]))
                        for i in idxs) / len(idxs)
-        worst_dl = max(abs(len(pairs[i][0]) - len(pairs[i][1]))
-                       for i in idxs)
-        band = max(int(mean_len * 0.1), worst_dl + 32)
-        return max(128, (band + 127) // 128 * 128)
+        return max(128, (int(mean_len * 0.1) + 127) // 128 * 128)
 
     def align(self, pairs: list[tuple[bytes, bytes]],
               progress=None) -> list[list[tuple[int, str]] | None]:

@@ -1,0 +1,46 @@
+"""Determinism contract.
+
+The reference's whole-genome CI test requires byte-identical output across
+runs (ci/gpu/cuda_test.sh:30-44 diffs a 5.2 MB golden FASTA exactly). The
+same property must hold here: same inputs => byte-identical polished FASTA,
+regardless of thread count or repeated runs, for both engines.
+"""
+
+import os
+
+import pytest
+
+from racon_tpu.core.polisher import create_polisher, PolisherType
+
+DATA = "/root/reference/test/data/"
+
+pytestmark = pytest.mark.skipif(
+    not os.path.isdir(DATA), reason="reference sample data not available")
+
+
+def polish_bytes(threads: int, device: int = 0) -> bytes:
+    p = create_polisher(DATA + "sample_reads.fastq.gz",
+                        DATA + "sample_overlaps.sam.gz",
+                        DATA + "sample_layout.fasta.gz",
+                        PolisherType.kC, 500, 10.0, 0.3,
+                        match=5, mismatch=-4, gap=-8, num_threads=threads,
+                        tpu_poa_batches=device)
+    p.initialize()
+    out = b""
+    for seq in p.polish():
+        out += b">" + seq.name.encode() + b"\n" + seq.data + b"\n"
+    return out
+
+
+def test_host_output_bit_stable_across_runs_and_threads():
+    a = polish_bytes(threads=1)
+    b = polish_bytes(threads=4)
+    c = polish_bytes(threads=4)
+    assert a == b == c
+    assert a.startswith(b">utg000001l")
+
+
+def test_device_output_bit_stable():
+    a = polish_bytes(threads=2, device=1)
+    b = polish_bytes(threads=2, device=1)
+    assert a == b

@@ -108,3 +108,21 @@ def test_reference_sample_data_parses(reference_data):
     mhap = []
     MhapParser(str(reference_data / "sample_ava_overlaps.mhap.gz")).parse(mhap)
     assert len(mhap) > 0
+
+
+def test_truncated_gzip_raises(tmp_path):
+    """A gzip stream cut mid-file must raise, not silently yield a shorter
+    read set (interrupted downloads are common; the native loader checks
+    gzeof before treating a short read as EOF)."""
+    import gzip as _gzip
+
+    p = tmp_path / "reads.fastq.gz"
+    with _gzip.open(p, "wb") as f:
+        for i in range(200):
+            f.write(b"@r%d\nACGTACGTAC\n+\nIIIIIIIIII\n" % i)
+    data = p.read_bytes()
+    trunc = tmp_path / "trunc.fastq.gz"
+    trunc.write_bytes(data[:len(data) // 2])
+    with pytest.raises(RaconError, match="malformed FASTQ"):
+        out = []
+        create_sequence_parser(str(trunc), "test").parse(out, -1)

@@ -1,0 +1,81 @@
+"""Short-read (kNGS) polishing path.
+
+BASELINE.md lists Illumina short-read polishing of an ONT draft (SAM
+input, small windows) as a target config. Mean read length <= 1000 selects
+WindowType.kNGS (reference polisher.cpp:276-277), which skips the TGS
+coverage trim (window.cpp:118-127). Synthetic end-to-end: accurate 150 bp
+reads over a noisy 3 kb draft must repair most draft errors.
+"""
+
+import gzip
+import random
+
+import pytest
+
+from racon_tpu.core.polisher import create_polisher, PolisherType
+from racon_tpu.core.window import WindowType
+from racon_tpu.native import edit_distance
+
+ACGT = b"ACGT"
+
+
+def mutate(rng, s, rate):
+    out = bytearray()
+    for c in s:
+        r = rng.random()
+        if r < rate / 3:
+            continue
+        if r < 2 * rate / 3:
+            out.append(rng.choice(ACGT))
+            out.append(c)
+            continue
+        if r < rate:
+            out.append(rng.choice(ACGT))
+            continue
+        out.append(c)
+    return bytes(out)
+
+
+@pytest.fixture
+def ngs_dataset(tmp_path):
+    rng = random.Random(23)
+    truth = bytes(rng.choice(ACGT) for _ in range(3000))
+    draft = mutate(rng, truth, 0.04)
+
+    reads, paf = [], []
+    read_len, step = 150, 50
+    for start in range(0, len(truth) - read_len, step):
+        read = mutate(rng, truth[start:start + read_len], 0.005)
+        name = f"r{start}"
+        reads.append((name, read))
+        # approximate mapping onto the draft (same scale; NW fixes details)
+        t_begin = min(start, len(draft) - 1)
+        t_end = min(start + read_len, len(draft))
+        paf.append(f"{name}\t{len(read)}\t0\t{len(read)}\t+\tdraft\t"
+                   f"{len(draft)}\t{t_begin}\t{t_end}\t{read_len}\t"
+                   f"{read_len}\t60")
+
+    reads_path = tmp_path / "reads.fasta.gz"
+    with gzip.open(reads_path, "wb") as f:
+        for name, read in reads:
+            f.write(b">" + name.encode() + b"\n" + read + b"\n")
+    paf_path = tmp_path / "ovl.paf.gz"
+    with gzip.open(paf_path, "wb") as f:
+        f.write(("\n".join(paf) + "\n").encode())
+    draft_path = tmp_path / "draft.fasta.gz"
+    with gzip.open(draft_path, "wb") as f:
+        f.write(b">draft\n" + draft + b"\n")
+    return reads_path, paf_path, draft_path, truth, draft
+
+
+def test_short_read_polishing_selects_ngs_and_repairs(ngs_dataset):
+    reads_path, paf_path, draft_path, truth, draft = ngs_dataset
+    p = create_polisher(str(reads_path), str(paf_path), str(draft_path),
+                        PolisherType.kC, 200, -1.0, 0.3, num_threads=2)
+    p.initialize()
+    assert p.windows and p.windows[0].type == WindowType.kNGS
+    polished = p.polish()
+    assert len(polished) == 1
+    d_draft = edit_distance(draft, truth)
+    d_polished = edit_distance(polished[0].data, truth)
+    assert d_polished < d_draft * 0.25  # most draft errors repaired
