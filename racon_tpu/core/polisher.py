@@ -395,6 +395,7 @@ class Polisher:
         engine = BatchPOA(self.match, self.mismatch, self.gap,
                           self.window_length, num_threads=self.num_threads,
                           device_batches=self.tpu_poa_batches,
+                          banded=self.tpu_banded_alignment,
                           band_width=self.tpu_aligner_band_width,
                           logger=self.logger)
         t_consensus = _time.perf_counter()

@@ -26,15 +26,17 @@ from ..utils.logger import Logger
 class BatchPOA:
     def __init__(self, match: int, mismatch: int, gap: int,
                  window_length: int, num_threads: int = 1,
-                 device_batches: int = 0, band_width: int = 0,
-                 logger: Logger | None = None):
+                 device_batches: int = 0, banded: bool = False,
+                 band_width: int = 0, logger: Logger | None = None):
         self.match = match
         self.mismatch = mismatch
         self.gap = gap
         self.window_length = window_length
         self.num_threads = num_threads
         self.device_batches = device_batches
-        self.band_width = band_width
+        # the reference's -b / cuda-banded-alignment: static-band device
+        # DP (band 256 unless overridden), trading accuracy for speed
+        self.band = (band_width or 256) if banded else 0
         self.logger = logger
 
     #: windows per host batch call (bounds peak packed-buffer memory)
@@ -107,7 +109,7 @@ class BatchPOA:
         from .poa_device import device_prealign
 
         pre1 = device_prealign(todo, self.match, self.mismatch, self.gap,
-                               self.device_batches, self.band_width,
+                               self.device_batches, self.band,
                                logger=self.logger)
         dev = [(i, w) for i, w in enumerate(todo) if pre1[i] is not None]
         fallback = [w for i, w in enumerate(todo) if pre1[i] is None]
@@ -125,7 +127,7 @@ class BatchPOA:
                       for (_, w), (cons, _cov) in zip(dev, best)]
             pre = device_prealign(rewins, self.match, self.mismatch,
                                   self.gap, self.device_batches,
-                                  self.band_width, logger=self.logger)
+                                  self.band, logger=self.logger)
             idx = [k for k in range(len(rewins)) if pre[k] is not None]
             if not idx:
                 break

@@ -51,14 +51,24 @@ def _batch_size(q: int, t: int) -> int:
 
 
 @functools.lru_cache(maxsize=None)
-def _aligner(q_len: int, t_len: int, match: int, mismatch: int, gap: int):
-    """Build the jitted batched NW align+traceback program for one shape."""
+def _aligner(q_len: int, t_len: int, match: int, mismatch: int, gap: int,
+             band: int = 0):
+    """Build the jitted batched NW align+traceback program for one shape.
+
+    band == 0: full Q x T DP (cudapoa full_band mode). band > 0: each layer
+    row computes only `band` target columns centered on the lane's own
+    ideal diagonal (cudapoa static_band mode, cudabatch.cpp:56-59 band 256
+    — the `-b/--tpu-banded-alignment` flag) — ~T/band less compute and
+    backpointer memory; out-of-band cells score -inf, so a clipped path
+    shows up as poor consensus the same way cudapoa's banded mode does.
+    """
     import jax
     import jax.numpy as jnp
 
     K = q_len + t_len  # max path length
+    NEG = jnp.int32(-(1 << 28))
 
-    def align(q, ql, t, tl):
+    def full_align(q, ql, t, tl):
         # q: [B, Q] int8 codes, ql: [B] int32; t: [B, T], tl: [B]
         B = q.shape[0]
         idx = jnp.arange(t_len + 1, dtype=jnp.int32)
@@ -116,14 +126,96 @@ def _aligner(q_len: int, t_len: int, match: int, mismatch: int, gap: int):
         # emitted back-to-front: [K, B] -> [B, K]
         return nodes.T, poss.T
 
-    return jax.jit(align)
+    def band_start(i, ql, tl):
+        # leftmost target column of row i's band (integer, replicated by
+        # the traceback so DP and walk can never disagree)
+        center = (i * tl) // jnp.maximum(ql, 1)
+        return jnp.clip(center - band // 2, 0,
+                        jnp.maximum(0, tl + 1 - band))
+
+    def banded_align(q, ql, t, tl):
+        B = q.shape[0]
+        ks = jnp.arange(band, dtype=jnp.int32)
+        ql32 = ql.astype(jnp.int32)
+        tl32 = tl.astype(jnp.int32)
+
+        # row 0: band starts at column 0 (band_start(0) == 0), D[0][j]=j*gap
+        h0 = jnp.broadcast_to(ks * gap, (B, band)).astype(jnp.int32)
+
+        def row_step(carry, qi_i):
+            h_prev, s_prev = carry   # [B, band], [B]
+            qi, i = qi_i
+            s = band_start(jnp.full((B,), i, jnp.int32), ql32, tl32)  # [B]
+            j = s[:, None] + ks[None, :]        # [B, band] target col of cell
+            # gather this row's target codes
+            tj = jnp.take_along_axis(
+                t, jnp.clip(j - 1, 0, t_len - 1).astype(jnp.int32), axis=1)
+            sub = jnp.where(tj == qi[:, None], match, mismatch)
+            # neighbors live in h_prev at shifted positions
+            shift = (s - s_prev)[:, None]
+            k_up = ks[None, :] + shift          # (i-1, j)
+            k_diag = k_up - 1                   # (i-1, j-1)
+
+            def gather(h, kk):
+                ok = (kk >= 0) & (kk < band)
+                return jnp.where(
+                    ok, jnp.take_along_axis(h, jnp.clip(kk, 0, band - 1),
+                                            axis=1), NEG)
+
+            valid_j = j <= tl32[:, None]
+            diag = jnp.where(j >= 1, gather(h_prev, k_diag), NEG) + sub
+            up = gather(h_prev, k_up) + gap
+            # j == 0 boundary: D[i][0] = i*gap
+            tmp = jnp.maximum(diag, up)
+            tmp = jnp.where(j == 0, i * gap, tmp)
+            tmp = jnp.where(valid_j, tmp, NEG)
+            # left-gap within the band via running max
+            h_row = jax.lax.cummax(tmp - ks * gap, axis=1) + ks * gap
+            diag_ok = (h_row == diag) & (j >= 1)
+            left_shift = jnp.concatenate(
+                [jnp.full((B, 1), NEG), h_row[:, :-1] + gap], axis=1)
+            left_ok = h_row == left_shift
+            bp = jnp.where(diag_ok, 0, jnp.where(left_ok, 2, 1)).astype(
+                jnp.int8)
+            return (h_row, s), bp
+
+        rows_i = jnp.arange(1, q_len + 1, dtype=jnp.int32)
+        s0 = jnp.zeros((B,), dtype=jnp.int32)
+        _, bp = jax.lax.scan(row_step, (h0, s0), (q.T, rows_i))
+        bp_flat = bp.transpose(1, 0, 2).reshape(B, q_len * band)
+
+        def tb_step(state, _):
+            i, j = state
+            on_q = i > 0
+            on_t = j > 0
+            s = band_start(jnp.maximum(i, 1), ql32, tl32)
+            k = jnp.clip(j - s, 0, band - 1)
+            lin = jnp.clip(i - 1, 0, q_len - 1) * band + k
+            code = jnp.take_along_axis(bp_flat, lin[:, None], axis=1)[:, 0]
+            code = jnp.where(on_q & on_t, code, jnp.where(on_q, 1, 2))
+            done = ~on_q & ~on_t
+            take_q = ~done & (code != 2)
+            take_t = ~done & (code != 1)
+            node = jnp.where(take_t, j - 1, -1)
+            pos = jnp.where(take_q, i - 1, -1)
+            node = jnp.where(done, -2, node)
+            pos = jnp.where(done, -2, pos)
+            return ((i - take_q.astype(jnp.int32),
+                     j - take_t.astype(jnp.int32)),
+                    (node.astype(jnp.int32), pos.astype(jnp.int32)))
+
+        _, (nodes, poss) = jax.lax.scan(
+            tb_step, (ql32, tl32), None, length=K)
+        return nodes.T, poss.T
+
+    return jax.jit(banded_align if band > 0 else full_align)
 
 
 def device_prealign(windows, match: int, mismatch: int, gap: int,
-                    device_batches: int = 1, band_width: int = 0,
+                    device_batches: int = 1, band: int = 0,
                     logger: Logger | None = None):
     """Align every layer of every window against its backbone slice on
-    device.
+    device. band > 0 selects the static-band kernel (see _aligner).
 
     Returns a list parallel to `windows`; each entry is either a list
     (parallel to window.sequences, [0] = None) of (nodes, poss) int32 array
@@ -132,6 +224,8 @@ def device_prealign(windows, match: int, mismatch: int, gap: int,
     the reference's GPU->CPU window fallback, cudapolisher.cpp:354-383).
     """
     from ..parallel.mesh import BatchRunner
+
+    band = max(0, (band + 7) // 8 * 8)
 
     max_q, max_t = _BUCKETS[-1]
     jobs: dict[tuple[int, int], list] = {}
@@ -155,8 +249,9 @@ def device_prealign(windows, match: int, mismatch: int, gap: int,
         logger.bar_total(total)
 
     for (q_len, t_len), items in sorted(jobs.items()):
-        fn = _aligner(q_len, t_len, match, mismatch, gap)
-        batch = _batch_size(q_len, t_len)
+        eff_band = band if 0 < band < t_len else 0
+        fn = _aligner(q_len, t_len, match, mismatch, gap, eff_band)
+        batch = _batch_size(q_len, eff_band if eff_band else t_len)
         batch = runner.round_batch(batch)
         for s in range(0, len(items), batch):
             part = items[s:s + batch]

@@ -170,3 +170,43 @@ def test_graft_entry_dryrun():
     nodes, poss = fn(*args)
     assert np.asarray(nodes).shape[0] == args[0].shape[0]
     __graft_entry__.dryrun_multichip(8)
+
+
+def test_banded_device_aligner_matches_full_on_diagonal_pairs():
+    """Static-band kernel (the -b flag, cudapoa static_band mode) must
+    agree with the full kernel whenever the path stays near the diagonal."""
+    rng = random.Random(13)
+    full = poa_device._aligner(96, 96, 3, -5, -4)
+    banded = poa_device._aligner(96, 96, 3, -5, -4, 32)
+    ts = [bytes(rng.choice(ACGT) for _ in range(80)) for _ in range(8)]
+    qs = [mutate(rng, t, 0.08) or b"A" for t in ts]
+    q_codes, q_lens = encode_padded(qs, 96)
+    t_codes, t_lens = encode_padded(ts, 96)
+    nf, pf = map(np.asarray, full(q_codes, q_lens, t_codes, t_lens))
+    nb, pb = map(np.asarray, banded(q_codes, q_lens, t_codes, t_lens))
+    for k in range(len(qs)):
+        # both must consume exactly the pair
+        for nodes, poss in ((nf[k], pf[k]), (nb[k], pb[k])):
+            sel = nodes != -2
+            nd, ps = nodes[sel][::-1], poss[sel][::-1]
+            assert list(ps[ps >= 0]) == list(range(len(qs[k]))), k
+            assert list(nd[nd >= 0]) == list(range(len(ts[k]))), k
+        # near-diagonal pairs: identical path scores
+        sf = path_score(nf[k][nf[k] != -2][::-1], pf[k][pf[k] != -2][::-1],
+                        qs[k], ts[k], 3, -5, -4)
+        sb = path_score(nb[k][nb[k] != -2][::-1], pb[k][pb[k] != -2][::-1],
+                        qs[k], ts[k], 3, -5, -4)
+        assert sb == sf, (k, sb, sf)
+
+
+def test_banded_batchpoa_end_to_end(monkeypatch):
+    monkeypatch.setattr(poa_device, "_BUCKETS", ((96, 96),))
+    rng = random.Random(17)
+    windows, truths = _make_windows(rng, 4)
+    engine = BatchPOA(3, -5, -4, 60, device_batches=1, banded=True,
+                      band_width=32)
+    engine.generate_consensus(windows, trim=False)
+    for w, truth in zip(windows, truths):
+        assert w.polished
+        assert edit_distance(w.consensus, truth) <= \
+            edit_distance(w.sequences[0], truth) + 2

@@ -1,0 +1,138 @@
+"""Synthetic scale benchmark: ONT-style polishing at arbitrary genome size.
+
+The BASELINE.md north star is E. coli 30x ONT polishing throughput; the
+packaged sample is only 48.5 kb. This tool simulates the same shape of
+workload at any scale — a random genome, a noisy draft, long reads with
+ONT-like errors, and PAF overlaps derived from the simulation's true
+coordinates — then polishes it and reports wall-clock, windows/sec, and
+polished identity vs the simulated truth.
+
+    python tools/synthbench.py --genome-kb 200 --coverage 30 [-c 1]
+
+Unlike bench.py (the driver's one-line contract on the reference sample),
+this is an engineering tool for scale/perf work.
+"""
+
+from __future__ import annotations
+
+import argparse
+import gzip
+import os
+import random
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+ACGT = b"ACGT"
+
+
+def mutate(rng, s, rate):
+    out = bytearray()
+    for c in s:
+        r = rng.random()
+        if r < rate / 3:
+            continue
+        if r < 2 * rate / 3:
+            out.append(rng.choice(ACGT))
+            out.append(c)
+            continue
+        if r < rate:
+            out.append(rng.choice(ACGT))
+            continue
+        out.append(c)
+    return bytes(out)
+
+
+def simulate(rng, genome_len, coverage, read_len, read_err, draft_err):
+    truth = bytes(rng.choice(ACGT) for _ in range(genome_len))
+    draft = mutate(rng, truth, draft_err)
+
+    reads, paf = [], []
+    n_reads = genome_len * coverage // read_len
+    scale = len(draft) / len(truth)
+    for i in range(n_reads):
+        start = rng.randrange(0, max(1, genome_len - read_len // 2))
+        end = min(genome_len, start + read_len)
+        fwd = mutate(rng, truth[start:end], read_err)
+        strand = rng.random() < 0.5
+        if strand:
+            comp = bytes.maketrans(b"ACGT", b"TGCA")
+            read = fwd.translate(comp)[::-1]
+        else:
+            read = fwd
+        name = f"read{i}"
+        t_begin = int(start * scale)
+        t_end = min(len(draft), int(end * scale))
+        reads.append((name, read))
+        paf.append(f"{name}\t{len(read)}\t0\t{len(read)}\t"
+                   f"{'-' if strand else '+'}\tdraft\t{len(draft)}\t"
+                   f"{t_begin}\t{t_end}\t{end - start}\t{end - start}\t60")
+    return truth, draft, reads, paf
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--genome-kb", type=int, default=200)
+    ap.add_argument("--coverage", type=int, default=30)
+    ap.add_argument("--read-len", type=int, default=8000)
+    ap.add_argument("--read-err", type=float, default=0.12)
+    ap.add_argument("--draft-err", type=float, default=0.10)
+    ap.add_argument("-w", "--window-length", type=int, default=500)
+    ap.add_argument("-t", "--threads", type=int, default=os.cpu_count() or 1)
+    ap.add_argument("-c", "--tpupoa-batches", type=int, default=0)
+    ap.add_argument("--tpualigner-batches", type=int, default=0)
+    ap.add_argument("--seed", type=int, default=42)
+    args = ap.parse_args(argv)
+
+    from racon_tpu.core.polisher import create_polisher, PolisherType
+    from racon_tpu.native import edit_distance
+
+    rng = random.Random(args.seed)
+    genome_len = args.genome_kb * 1000
+    print(f"[synthbench] simulating {args.genome_kb} kb genome at "
+          f"{args.coverage}x ...", file=sys.stderr)
+    truth, draft, reads, paf = simulate(rng, genome_len, args.coverage,
+                                        args.read_len, args.read_err,
+                                        args.draft_err)
+
+    with tempfile.TemporaryDirectory() as d:
+        reads_path = os.path.join(d, "reads.fasta.gz")
+        with gzip.open(reads_path, "wb", compresslevel=1) as f:
+            for name, read in reads:
+                f.write(b">" + name.encode() + b"\n" + read + b"\n")
+        paf_path = os.path.join(d, "ovl.paf.gz")
+        with gzip.open(paf_path, "wb", compresslevel=1) as f:
+            f.write(("\n".join(paf) + "\n").encode())
+        draft_path = os.path.join(d, "draft.fasta.gz")
+        with gzip.open(draft_path, "wb", compresslevel=1) as f:
+            f.write(b">draft\n" + draft + b"\n")
+
+        t0 = time.perf_counter()
+        polisher = create_polisher(
+            reads_path, paf_path, draft_path, PolisherType.kC,
+            args.window_length, 10.0, 0.3, True, 5, -4, -8,
+            num_threads=args.threads,
+            tpu_poa_batches=args.tpupoa_batches,
+            tpu_aligner_batches=args.tpualigner_batches)
+        polisher.initialize()
+        t1 = time.perf_counter()
+        n_windows = len(polisher.windows)
+        polished = polisher.polish()
+        t2 = time.perf_counter()
+
+    d_draft = edit_distance(draft, truth)
+    d_pol = edit_distance(polished[0].data, truth)
+    print(f"[synthbench] init {t1 - t0:.1f}s  polish {t2 - t1:.1f}s  "
+          f"({n_windows} windows, {n_windows / (t2 - t1):.1f} windows/s)",
+          file=sys.stderr)
+    print(f"[synthbench] draft error {d_draft / genome_len * 100:.2f}%  "
+          f"polished error {d_pol / genome_len * 100:.2f}%  "
+          f"(identity {100 - d_pol / genome_len * 100:.3f}%)",
+          file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
