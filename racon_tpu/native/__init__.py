@@ -20,7 +20,7 @@ import numpy as np
 _DIR = pathlib.Path(__file__).resolve().parent
 _SRC = _DIR / "src"
 _LIB = _DIR / "libracon_host.so"
-_SOURCES = ("poa.cpp", "myers.cpp", "parse.cpp", "api.cpp")
+_SOURCES = ("poa.cpp", "myers.cpp", "parse.cpp", "api.cpp", "session.cpp")
 
 _lock = threading.Lock()
 _lib: ctypes.CDLL | None = None
@@ -88,8 +88,173 @@ def get_lib() -> ctypes.CDLL:
                                     u8pp, i64pp, u8pp, i64pp, u8pp, i64pp]
         lib.rh_sf_close.restype = None
         lib.rh_sf_close.argtypes = [vp]
+        i8p = ctypes.POINTER(ctypes.c_int8)
+        lib.rh_poa_session_new.restype = i64
+        lib.rh_poa_session_new.argtypes = [
+            u8p, i64p, u8p, i64p, i32p, i32p, i64p, i64,
+            i32, i32, i32, i32, i32, i32,
+        ]
+        lib.rh_poa_session_prepare.restype = i32
+        lib.rh_poa_session_prepare.argtypes = [
+            i64, i32, i32p, i32p, i32p, i32p, i32p, i32p, i32p,
+            i8p, i32p, i32p, u8p, i8p,
+        ]
+        lib.rh_poa_session_commit.restype = None
+        lib.rh_poa_session_commit.argtypes = [i64, i32, i32p, i32p, i32p,
+                                              i32p]
+        lib.rh_poa_session_finish.restype = i64
+        lib.rh_poa_session_finish.argtypes = [i64, i32, u8p, u32p, i64,
+                                              i64p, i32p]
+        lib.rh_poa_session_free.restype = None
+        lib.rh_poa_session_free.argtypes = [i64]
         _lib = lib
     return _lib
+
+
+def _ptr(arr: np.ndarray, ctype):
+    return arr.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+def _pack_windows(windows):
+    """Flatten the poa_batch window layout into the native call arrays."""
+    seq_parts, qual_parts = [], []
+    seq_off = [0]
+    qual_off = [0]
+    begins, ends = [], []
+    win_off = [0]
+    for win in windows:
+        for seq, qual, b, e in win:
+            seq_parts.append(seq)
+            seq_off.append(seq_off[-1] + len(seq))
+            if qual is not None:
+                qual_parts.append(qual)
+                qual_off.append(qual_off[-1] + len(qual))
+            else:
+                qual_off.append(qual_off[-1])
+            begins.append(b)
+            ends.append(e)
+        win_off.append(win_off[-1] + len(win))
+    return (
+        np.frombuffer(b"".join(seq_parts) or b"\x00", dtype=np.uint8),
+        np.asarray(seq_off, dtype=np.int64),
+        np.frombuffer(b"".join(qual_parts) or b"\x00", dtype=np.uint8),
+        np.asarray(qual_off, dtype=np.int64),
+        np.asarray(begins, dtype=np.int32),
+        np.asarray(ends, dtype=np.int32),
+        np.asarray(win_off, dtype=np.int64),
+    )
+
+
+class PoaSession:
+    """Round-based evolving-graph POA session (the host half of the device
+    consensus engine — see native/src/session.cpp and ops/poa_graph.py).
+
+    Lifecycle: construct with the full window batch, then loop
+    `prepare()` -> run the returned jobs on device -> `commit()` until
+    prepare returns None, then `finish()`.
+    """
+
+    def __init__(self, windows, match: int, mismatch: int, gap: int,
+                 max_nodes: int, max_pred: int, max_len: int,
+                 max_jobs: int = 256):
+        self._lib = get_lib()
+        self.n_windows = len(windows)
+        self.max_nodes = max_nodes
+        self.max_pred = max_pred
+        self.max_len = max_len
+        self.max_jobs = max_jobs
+        packed = _pack_windows(windows)
+        self._total_seq_bytes = int(packed[1][-1])
+        i32, u8 = ctypes.c_int32, ctypes.c_uint8
+        self._handle = int(self._lib.rh_poa_session_new(
+            _ptr(packed[0], u8), _ptr(packed[1], ctypes.c_int64),
+            _ptr(packed[2], u8), _ptr(packed[3], ctypes.c_int64),
+            _ptr(packed[4], i32), _ptr(packed[5], i32),
+            _ptr(packed[6], ctypes.c_int64), self.n_windows,
+            match, mismatch, gap, max_nodes, max_pred, max_len))
+        J, N, P, L = max_jobs, max_nodes, max_pred, max_len
+        self._buf = {
+            "win": np.empty(J, dtype=np.int32),
+            "layer": np.empty(J, dtype=np.int32),
+            "band": np.empty(J, dtype=np.int32),
+            "nnodes": np.empty(J, dtype=np.int32),
+            "len": np.empty(J, dtype=np.int32),
+            "origin": np.empty(J, dtype=np.int32),
+            "maxpred": np.empty(J, dtype=np.int32),
+            "codes": np.empty((J, N), dtype=np.int8),
+            "preds": np.empty((J, N, P), dtype=np.int32),
+            "centers": np.empty((J, N), dtype=np.int32),
+            "sinks": np.empty((J, N), dtype=np.uint8),
+            "seqs": np.empty((J, L), dtype=np.int8),
+        }
+
+    def prepare(self):
+        """Returns a dict of job arrays (buffers reused across calls — the
+        caller must consume/copy before the next prepare) with key "n" =
+        job count, or None when every window is drained."""
+        b = self._buf
+        i32, i8, u8 = ctypes.c_int32, ctypes.c_int8, ctypes.c_uint8
+        n = int(self._lib.rh_poa_session_prepare(
+            self._handle, self.max_jobs,
+            _ptr(b["win"], i32), _ptr(b["layer"], i32), _ptr(b["band"], i32),
+            _ptr(b["nnodes"], i32), _ptr(b["len"], i32),
+            _ptr(b["origin"], i32), _ptr(b["maxpred"], i32),
+            _ptr(b["codes"], i8), _ptr(b["preds"], i32),
+            _ptr(b["centers"], i32), _ptr(b["sinks"], u8),
+            _ptr(b["seqs"], i8)))
+        if n <= 0:
+            return None
+        return dict(b, n=n)
+
+    def commit(self, jobs, part, ranks):
+        """Commit device results for job indices `part` of a prepare()
+        batch. ranks: [len(part), lb] int32 node ranks (-1 insertion)."""
+        sel = np.asarray(part, dtype=np.int64)
+        win = np.ascontiguousarray(jobs["win"][sel])
+        layer = np.ascontiguousarray(jobs["layer"][sel])
+        band = np.ascontiguousarray(jobs["band"][sel])
+        full = np.full((len(part), self.max_len), -2, dtype=np.int32)
+        full[:, :ranks.shape[1]] = ranks
+        i32 = ctypes.c_int32
+        self._lib.rh_poa_session_commit(
+            self._handle, len(part), _ptr(win, i32), _ptr(layer, i32),
+            _ptr(band, i32), _ptr(full, i32))
+
+    def finish(self, n_threads: int = 1):
+        """Generate consensus for every window. Returns (results, statuses):
+        results like poa_batch's [(consensus bytes, coverages array)];
+        statuses[w] = 0 device-built, 1 host fallback, 2 backbone-only."""
+        cons_cap = 2 * self._total_seq_bytes + 64 * self.n_windows
+        cons_off = np.empty(self.n_windows + 1, dtype=np.int64)
+        statuses = np.empty(self.n_windows, dtype=np.int32)
+        u8, u32 = ctypes.c_uint8, ctypes.c_uint32
+        while True:
+            cons_data = np.empty(cons_cap, dtype=np.uint8)
+            cov_data = np.empty(cons_cap, dtype=np.uint32)
+            total = int(self._lib.rh_poa_session_finish(
+                self._handle, n_threads, _ptr(cons_data, u8),
+                _ptr(cov_data, u32), cons_cap,
+                _ptr(cons_off, ctypes.c_int64),
+                _ptr(statuses, ctypes.c_int32)))
+            if total >= 0:
+                break
+            cons_cap = -total
+        out = []
+        for w in range(self.n_windows):
+            a, b = int(cons_off[w]), int(cons_off[w + 1])
+            out.append((cons_data[a:b].tobytes(), cov_data[a:b].copy()))
+        return out, statuses
+
+    def close(self):
+        if self._handle:
+            self._lib.rh_poa_session_free(self._handle)
+            self._handle = 0
+
+    def __del__(self):  # pragma: no cover
+        try:
+            self.close()
+        except Exception:
+            pass
 
 
 class SequenceFile:
@@ -242,31 +407,8 @@ def poa_batch(windows, match: int, mismatch: int, gap: int,
     if n_windows == 0:
         return []
 
-    seq_parts, qual_parts = [], []
-    seq_off = [0]
-    qual_off = [0]
-    begins, ends = [], []
-    win_off = [0]
-    for win in windows:
-        for seq, qual, b, e in win:
-            seq_parts.append(seq)
-            seq_off.append(seq_off[-1] + len(seq))
-            if qual is not None:
-                qual_parts.append(qual)
-                qual_off.append(qual_off[-1] + len(qual))
-            else:
-                qual_off.append(qual_off[-1])
-            begins.append(b)
-            ends.append(e)
-        win_off.append(win_off[-1] + len(win))
-
-    seq_data = np.frombuffer(b"".join(seq_parts), dtype=np.uint8)
-    qual_data = np.frombuffer(b"".join(qual_parts) or b"\x00", dtype=np.uint8)
-    seq_off_a = np.asarray(seq_off, dtype=np.int64)
-    qual_off_a = np.asarray(qual_off, dtype=np.int64)
-    begins_a = np.asarray(begins, dtype=np.int32)
-    ends_a = np.asarray(ends, dtype=np.int32)
-    win_off_a = np.asarray(win_off, dtype=np.int64)
+    (seq_data, seq_off_a, qual_data, qual_off_a, begins_a, ends_a,
+     win_off_a) = _pack_windows(windows)
 
     if prealigned is not None:
         nodes_parts, pos_parts = [], []

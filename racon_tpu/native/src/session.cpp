@@ -1,0 +1,495 @@
+// Round-based POA session: the host half of the evolving-graph device
+// consensus engine.
+//
+// The reference's GPU path (GenomeWorks cudapoa, src/cuda/cudabatch.cpp)
+// runs the whole POA — graph DP and consensus — inside one CUDA block per
+// window. The TPU engine splits it differently: the graph lives HERE (all
+// the irregular bookkeeping: node/edge insertion, aligned-column merging,
+// heaviest-bundle consensus), while the O(nodes x len) graph-banded NW DP
+// — the hot loop — runs on the TPU as a batched fixed-shape XLA program
+// (racon_tpu/ops/poa_graph.py). Each round, `prepare` densifies the
+// *current* graph of every ready window (topo-ordered codes, predecessor
+// rank lists, band centers, sink flags), the device aligns that window's
+// next layer against it, and `commit` ingests the returned path with the
+// exact same add_alignment the host engine uses. Because the layer is
+// aligned against the evolving graph — not just the backbone — the device
+// engine inherits the host engine's consensus quality by construction
+// (unlike an anchored prealign, which cannot see other layers' insertions
+// during alignment).
+//
+// Orchestration contracts mirror reference src/window.cpp:65-142 exactly:
+// layers sorted stable by begin; window-spanning layers (within a 1%
+// offset margin) align against the full graph, others against the
+// [begin, end] bpos-subgraph; banded DP (band 256) when the layer fits the
+// band, with a full-DP redo when the banded result is clipped. Windows the
+// device cannot take (too many nodes, in-degree over the predecessor cap,
+// layer too long, or a malformed device result) fall back to the host
+// engine at finish() — the same per-window GPU->CPU fallback discipline as
+// reference src/cuda/cudapolisher.cpp:354-383.
+
+#include <algorithm>
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <memory>
+#include <mutex>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+#include "poa.hpp"
+
+namespace racon_host {
+
+namespace {
+
+constexpr int32_t kBand = 256;  // cudapoa static-band contract (cudabatch.cpp:56-59)
+
+struct WindowState {
+    // inputs (copied; index 0 = backbone)
+    std::vector<std::vector<uint8_t>> seqs;
+    std::vector<std::vector<uint8_t>> quals;  // empty = no quality
+    std::vector<int32_t> begins, ends;
+
+    Graph graph;
+    std::vector<int32_t> layer_rank;  // layer visit order (begin-sorted)
+    size_t next_layer = 0;            // index into layer_rank
+    bool outstanding = false;         // a prepared job awaits commit
+    bool redo_full = false;           // banded result clipped: redo band=0
+    bool unfit = false;               // host fallback at finish()
+    bool backbone_only = false;       // < 3 sequences
+};
+
+struct Session {
+    std::vector<WindowState> windows;
+    int32_t match, mismatch, gap;
+    int32_t max_nodes, max_pred, max_len;
+    size_t cursor = 0;  // round-robin scan position for prepare()
+};
+
+std::mutex g_mutex;
+std::unordered_map<int64_t, std::unique_ptr<Session>> g_sessions;
+int64_t g_next_id = 1;
+
+Session* get_session(int64_t handle) {
+    std::lock_guard<std::mutex> lock(g_mutex);
+    auto it = g_sessions.find(handle);
+    return it == g_sessions.end() ? nullptr : it->second.get();
+}
+
+const uint32_t* weights_of(const WindowState& w, int32_t i,
+                           std::vector<uint32_t>& buf) {
+    const int32_t len = static_cast<int32_t>(w.seqs[i].size());
+    buf.assign(len, 1);
+    if (!w.quals[i].empty()) {
+        for (int32_t j = 0; j < len; ++j) {
+            buf[j] = w.quals[i][j] >= 33 ? w.quals[i][j] - 33 : 0;
+        }
+    }
+    return buf.data();
+}
+
+// Decide full-graph vs subgraph and banded vs exact for this layer —
+// the same rules as window_consensus (poa.cpp) / reference window.cpp:87-103.
+struct JobPlan {
+    bool spanning;
+    int32_t band;    // 0 = exact full DP
+    int32_t origin;  // bpos origin of the band centers
+};
+
+JobPlan plan_layer(const WindowState& w, int32_t i, bool redo_full) {
+    const int32_t backbone_len = static_cast<int32_t>(w.seqs[0].size());
+    const int32_t len = static_cast<int32_t>(w.seqs[i].size());
+    const int32_t offset = static_cast<int32_t>(0.01 * backbone_len);
+    JobPlan p;
+    p.spanning = w.begins[i] < offset && w.ends[i] > backbone_len - offset;
+    const int32_t span =
+        p.spanning ? backbone_len : w.ends[i] - w.begins[i] + 1;
+    const bool fits = std::abs(len - span) < kBand / 2 - 16;
+    p.band = (fits && !redo_full) ? kBand : 0;
+    p.origin = p.spanning ? 0 : w.begins[i];
+    return p;
+}
+
+// Same acceptance rule as the host engine's banded retry (poa.cpp
+// band_clipped): fewer than half the aligned columns matching means the
+// in-band path is clipping artifact, not signal.
+bool band_clipped(const Alignment& aln, const uint8_t* seq, const Graph& g) {
+    int32_t aligned = 0, matched = 0;
+    for (const auto& p : aln) {
+        if (p.node >= 0 && p.pos >= 0) {
+            ++aligned;
+            matched += g.nodes[p.node].code == kBaseCode[seq[p.pos]];
+        }
+    }
+    return aligned == 0 || 2 * matched < aligned;
+}
+
+}  // namespace
+}  // namespace racon_host
+
+using racon_host::Alignment;
+using racon_host::AlnPair;
+using racon_host::Graph;
+using racon_host::Session;
+using racon_host::WindowState;
+
+extern "C" {
+
+// Create a session over the same flat window layout rh_poa_batch takes
+// (all sequences concatenated, per-window spans via win_off, first
+// sequence of each window the backbone). max_nodes / max_pred / max_len
+// are the device kernel's shape envelope: windows that exceed any of them
+// fall back to the host engine at finish().
+int64_t rh_poa_session_new(
+    const uint8_t* seq_data, const int64_t* seq_off,
+    const uint8_t* qual_data, const int64_t* qual_off,
+    const int32_t* begins, const int32_t* ends,
+    const int64_t* win_off, int64_t n_windows,
+    int32_t match, int32_t mismatch, int32_t gap,
+    int32_t max_nodes, int32_t max_pred, int32_t max_len) {
+    auto session = std::make_unique<Session>();
+    session->match = match;
+    session->mismatch = mismatch;
+    session->gap = gap;
+    session->max_nodes = max_nodes;
+    session->max_pred = max_pred;
+    session->max_len = max_len;
+    session->windows.resize(n_windows);
+
+    std::vector<uint32_t> wbuf;
+    for (int64_t w = 0; w < n_windows; ++w) {
+        WindowState& ws = session->windows[w];
+        const int64_t s0 = win_off[w], s1 = win_off[w + 1];
+        const int64_t count = s1 - s0;
+        for (int64_t s = s0; s < s1; ++s) {
+            ws.seqs.emplace_back(seq_data + seq_off[s],
+                                 seq_data + seq_off[s + 1]);
+            ws.quals.emplace_back(qual_data + qual_off[s],
+                                  qual_data + qual_off[s + 1]);
+            ws.begins.push_back(begins[s]);
+            ws.ends.push_back(ends[s]);
+        }
+        if (count < 3) {
+            ws.backbone_only = true;
+            continue;
+        }
+        // backbone seeds the graph
+        ws.graph.add_alignment(Alignment(), ws.seqs[0].data(),
+                               static_cast<int32_t>(ws.seqs[0].size()),
+                               racon_host::weights_of(ws, 0, wbuf));
+        // layer order: stable sort by begin (reference window.cpp:84-85)
+        for (int64_t s = 1; s < count; ++s) {
+            ws.layer_rank.push_back(static_cast<int32_t>(s));
+        }
+        std::stable_sort(ws.layer_rank.begin(), ws.layer_rank.end(),
+                         [&](int32_t a, int32_t b) {
+                             return ws.begins[a] < ws.begins[b];
+                         });
+        // a layer longer than the kernel envelope sinks the whole window
+        for (int32_t i : ws.layer_rank) {
+            if (static_cast<int32_t>(ws.seqs[i].size()) > max_len) {
+                ws.unfit = true;
+                break;
+            }
+        }
+    }
+
+    std::lock_guard<std::mutex> lock(racon_host::g_mutex);
+    const int64_t id = racon_host::g_next_id++;
+    racon_host::g_sessions.emplace(id, std::move(session));
+    return id;
+}
+
+// Emit up to max_jobs ready jobs (windows with layers left and no
+// outstanding job). Dense per-job buffers, caller-allocated:
+//   job_win/job_layer/job_band/job_nnodes/job_len/job_origin: [max_jobs]
+//   codes:   [max_jobs * max_nodes] int8  (topo-ordered node codes; pad 5)
+//   preds:   [max_jobs * max_nodes * max_pred] int32 (H row index of each
+//            predecessor: rank+1, 0 = virtual source; pad -1)
+//   centers: [max_jobs * max_nodes] int32 (band center column per node)
+//   sinks:   [max_jobs * max_nodes] uint8 (1 = sink node)
+//   seqs:    [max_jobs * max_len] int8 (layer base codes; pad 5)
+// Returns the number of jobs written (0 = no window is ready; the round is
+// drained when this is 0 and no jobs are uncommitted).
+int32_t rh_poa_session_prepare(
+    int64_t handle, int32_t max_jobs,
+    int32_t* job_win, int32_t* job_layer, int32_t* job_band,
+    int32_t* job_nnodes, int32_t* job_len, int32_t* job_origin,
+    int32_t* job_maxpred,
+    int8_t* codes, int32_t* preds, int32_t* centers, uint8_t* sinks,
+    int8_t* seqs) {
+    Session* s = racon_host::get_session(handle);
+    if (s == nullptr || max_jobs <= 0) {
+        return 0;
+    }
+    const int32_t N = s->max_nodes, P = s->max_pred, L = s->max_len;
+
+    int32_t n_jobs = 0;
+    const size_t n_windows = s->windows.size();
+    std::vector<int32_t> order, rank_of, mapping;
+    for (size_t scanned = 0; scanned < n_windows && n_jobs < max_jobs;
+         ++scanned) {
+        const size_t w = (s->cursor + scanned) % n_windows;
+        WindowState& ws = s->windows[w];
+        if (ws.backbone_only || ws.unfit || ws.outstanding ||
+            ws.next_layer >= ws.layer_rank.size()) {
+            continue;
+        }
+        const int32_t li = ws.layer_rank[ws.next_layer];
+        const racon_host::JobPlan plan =
+            racon_host::plan_layer(ws, li, ws.redo_full);
+
+        // densify the graph this layer aligns against
+        const Graph* g = &ws.graph;
+        Graph sub;
+        if (!plan.spanning) {
+            sub = ws.graph.subgraph(ws.begins[li], ws.ends[li], mapping);
+            g = &sub;
+        }
+        const int32_t n = static_cast<int32_t>(g->nodes.size());
+        if (n > N || static_cast<int32_t>(ws.graph.nodes.size()) > N) {
+            // graph outgrew the kernel envelope (possibly mid-build):
+            // discard and host-polish the whole window at finish()
+            ws.unfit = true;
+            continue;
+        }
+        order = g->topo_order();
+        rank_of.assign(n, 0);
+        for (int32_t r = 0; r < n; ++r) {
+            rank_of[order[r]] = r;
+        }
+        int8_t* jc = codes + static_cast<int64_t>(n_jobs) * N;
+        int32_t* jp = preds + static_cast<int64_t>(n_jobs) * N * P;
+        int32_t* jcen = centers + static_cast<int64_t>(n_jobs) * N;
+        uint8_t* jsink = sinks + static_cast<int64_t>(n_jobs) * N;
+        std::memset(jc, 5, N);
+        std::fill(jp, jp + static_cast<int64_t>(N) * P, -1);
+        std::memset(jcen, 0, static_cast<int64_t>(N) * sizeof(int32_t));
+        std::memset(jsink, 0, N);
+        bool fits = true;
+        int32_t max_indeg = 1;  // the virtual source counts as one slot
+        for (int32_t r = 0; r < n && fits; ++r) {
+            const racon_host::Node& node = g->nodes[order[r]];
+            jc[r] = static_cast<int8_t>(node.code);
+            jcen[r] = node.bpos - plan.origin + 1;
+            jsink[r] = node.out.empty() ? 1 : 0;
+            if (node.in.empty()) {
+                jp[static_cast<int64_t>(r) * P] = 0;  // virtual source row
+            } else if (static_cast<int32_t>(node.in.size()) > P) {
+                fits = false;  // in-degree over the cap: host fallback
+            } else {
+                for (size_t e = 0; e < node.in.size(); ++e) {
+                    jp[static_cast<int64_t>(r) * P + e] =
+                        rank_of[g->edges[node.in[e]].tail] + 1;
+                }
+                if (static_cast<int32_t>(node.in.size()) > max_indeg) {
+                    max_indeg = static_cast<int32_t>(node.in.size());
+                }
+            }
+        }
+        if (!fits) {
+            ws.unfit = true;
+            continue;
+        }
+        const int32_t len = static_cast<int32_t>(ws.seqs[li].size());
+        int8_t* jq = seqs + static_cast<int64_t>(n_jobs) * L;
+        std::memset(jq, 5, L);
+        for (int32_t i = 0; i < len; ++i) {
+            jq[i] = static_cast<int8_t>(
+                racon_host::kBaseCode[ws.seqs[li][i]]);
+        }
+        job_win[n_jobs] = static_cast<int32_t>(w);
+        job_layer[n_jobs] = li;
+        job_band[n_jobs] = plan.band;
+        job_nnodes[n_jobs] = n;
+        job_len[n_jobs] = len;
+        job_origin[n_jobs] = plan.origin;
+        job_maxpred[n_jobs] = max_indeg;
+        ws.outstanding = true;
+        ++n_jobs;
+        if (scanned + 1 == n_windows) {
+            break;
+        }
+    }
+    s->cursor = (s->cursor + n_jobs) % (n_windows ? n_windows : 1);
+    return n_jobs;
+}
+
+// Ingest device alignments. ranks[j * max_len + i] is, for job j and layer
+// base i, the 0-based topo rank of the graph node base i aligned to, or
+// -1 for an insertion (every i < job_len must be covered — global
+// alignment consumes the whole layer). Banded jobs whose result is
+// clipped are NOT ingested; they are re-queued for a full-DP redo (the
+// band_clipped retry of the host engine). Malformed results mark the
+// window unfit (host fallback).
+void rh_poa_session_commit(
+    int64_t handle, int32_t n_jobs,
+    const int32_t* job_win, const int32_t* job_layer,
+    const int32_t* job_band, const int32_t* ranks) {
+    Session* s = racon_host::get_session(handle);
+    if (s == nullptr) {
+        return;
+    }
+    const int32_t L = s->max_len;
+
+    std::vector<int32_t> mapping;
+    std::vector<uint32_t> wbuf;
+    for (int32_t j = 0; j < n_jobs; ++j) {
+        WindowState& ws = s->windows[job_win[j]];
+        const int32_t li = job_layer[j];
+        ws.outstanding = false;
+        if (ws.unfit) {
+            continue;
+        }
+        const racon_host::JobPlan plan =
+            racon_host::plan_layer(ws, li, job_band[j] == 0);
+
+        // rank -> full-graph node id (re-deriving subgraph/topo order is
+        // deterministic and the graph is untouched while outstanding)
+        const Graph* g = &ws.graph;
+        Graph sub;
+        mapping.clear();
+        if (!plan.spanning) {
+            sub = ws.graph.subgraph(ws.begins[li], ws.ends[li], mapping);
+            g = &sub;
+        }
+        const std::vector<int32_t> order = g->topo_order();
+        const int32_t n = static_cast<int32_t>(order.size());
+
+        const int32_t len = static_cast<int32_t>(ws.seqs[li].size());
+        const int32_t* jr = ranks + static_cast<int64_t>(j) * L;
+        Alignment aln;
+        aln.reserve(len);
+        bool ok = true;
+        for (int32_t i = 0; i < len; ++i) {
+            int32_t node = -1;
+            if (jr[i] >= 0) {
+                if (jr[i] >= n) {
+                    ok = false;
+                    break;
+                }
+                node = order[jr[i]];
+                if (!plan.spanning) {
+                    node = mapping[node];
+                }
+            } else if (jr[i] != -1) {
+                ok = false;  // -2 pad inside the sequence span
+                break;
+            }
+            aln.push_back(AlnPair{node, i});
+        }
+        if (!ok) {
+            ws.unfit = true;
+            continue;
+        }
+        if (job_band[j] > 0 &&
+            racon_host::band_clipped(aln, ws.seqs[li].data(), ws.graph)) {
+            ws.redo_full = true;  // re-queue this layer with band 0
+            continue;
+        }
+        ws.graph.add_alignment(aln, ws.seqs[li].data(), len,
+                               racon_host::weights_of(ws, li, wbuf));
+        ws.redo_full = false;
+        ++ws.next_layer;
+    }
+}
+
+// Consensus for every window. Device-built graphs emit directly; unfit
+// windows (and any with layers still pending) are host-polished from
+// scratch; backbone-only windows copy their backbone (window.cpp:68-71).
+// Output layout identical to rh_poa_batch. win_status[w]: 0 device,
+// 1 host fallback, 2 backbone. Returns total bytes or -needed.
+int64_t rh_poa_session_finish(
+    int64_t handle, int32_t n_threads,
+    uint8_t* cons_data, uint32_t* cov_data, int64_t cons_cap,
+    int64_t* cons_off, int32_t* win_status) {
+    Session* s = racon_host::get_session(handle);
+    if (s == nullptr) {
+        return -1;
+    }
+    const int64_t n_windows = static_cast<int64_t>(s->windows.size());
+    std::vector<std::vector<uint8_t>> results(n_windows);
+    std::vector<std::vector<uint32_t>> coverages(n_windows);
+
+    std::atomic<int64_t> next(0);
+    auto worker = [&]() {
+        std::vector<const uint8_t*> seqs, quals;
+        std::vector<int32_t> lens;
+        while (true) {
+            const int64_t w = next.fetch_add(1);
+            if (w >= n_windows) {
+                return;
+            }
+            WindowState& ws = s->windows[w];
+            if (ws.backbone_only) {
+                results[w] = ws.seqs[0];
+                coverages[w].assign(ws.seqs[0].size(), 0);
+                win_status[w] = 2;
+            } else if (!ws.unfit &&
+                       ws.next_layer == ws.layer_rank.size()) {
+                results[w] = ws.graph.consensus(coverages[w]);
+                win_status[w] = 0;
+            } else {
+                // host fallback: full window_consensus from the inputs
+                const int32_t count = static_cast<int32_t>(ws.seqs.size());
+                seqs.clear();
+                quals.clear();
+                lens.clear();
+                for (int32_t i = 0; i < count; ++i) {
+                    seqs.push_back(ws.seqs[i].data());
+                    lens.push_back(static_cast<int32_t>(ws.seqs[i].size()));
+                    quals.push_back(ws.quals[i].empty()
+                                        ? nullptr
+                                        : ws.quals[i].data());
+                }
+                results[w] = racon_host::window_consensus(
+                    seqs.data(), lens.data(), quals.data(),
+                    ws.begins.data(), ws.ends.data(), count, s->match,
+                    s->mismatch, s->gap, coverages[w], nullptr);
+                win_status[w] = 1;
+            }
+        }
+    };
+    int32_t nt = n_threads > 0 ? n_threads : 1;
+    if (nt > n_windows) {
+        nt = static_cast<int32_t>(n_windows > 0 ? n_windows : 1);
+    }
+    if (nt == 1) {
+        worker();
+    } else {
+        std::vector<std::thread> pool;
+        pool.reserve(nt);
+        for (int32_t i = 0; i < nt; ++i) {
+            pool.emplace_back(worker);
+        }
+        for (auto& th : pool) {
+            th.join();
+        }
+    }
+
+    int64_t total = 0;
+    for (int64_t w = 0; w < n_windows; ++w) {
+        total += static_cast<int64_t>(results[w].size());
+    }
+    if (total > cons_cap) {
+        return -total;
+    }
+    int64_t at = 0;
+    for (int64_t w = 0; w < n_windows; ++w) {
+        cons_off[w] = at;
+        std::memcpy(cons_data + at, results[w].data(), results[w].size());
+        std::memcpy(cov_data + at, coverages[w].data(),
+                    coverages[w].size() * sizeof(uint32_t));
+        at += static_cast<int64_t>(results[w].size());
+    }
+    cons_off[n_windows] = at;
+    return total;
+}
+
+void rh_poa_session_free(int64_t handle) {
+    std::lock_guard<std::mutex> lock(racon_host::g_mutex);
+    racon_host::g_sessions.erase(handle);
+}
+
+}  // extern "C"

@@ -5,19 +5,24 @@ reference. Two engines:
 
   - host: the native C++ POA graph engine (racon_tpu/native), threaded over
     windows — the spoa-equivalent path (reference src/polisher.cpp:491-504).
-  - device (`device_batches > 0`): the alignment hot loop moves to the TPU —
-    every layer is globally aligned against its window backbone as one
-    batched fixed-shape XLA program (ops/align kernel), and the resulting
-    paths are fed to the native graph builder as prealigned inputs (backbone
-    node ids are 0..L-1 by construction). This mirrors cudapoa's batched
-    window processing (src/cuda/cudabatch.cpp:77-270) while keeping the
-    irregular graph bookkeeping on the host where it is cheap.
+  - device (`device_batches > 0`): the evolving-graph engine
+    (ops/poa_graph.py + native/src/session.cpp). The graph-NW DP — the hot
+    loop — runs on the TPU as batched fixed-shape XLA programs while the
+    graph bookkeeping stays in the C++ session; every layer is aligned
+    against the *evolving* graph with host-identical DP and tie-breaking,
+    so device consensus is byte-identical to the host engine (unlike the
+    reference, which pins diverging GPU numbers separately,
+    racon_test.cpp:292-496). Windows outside the kernel's shape envelope
+    fall back to the host engine per window, the reference's GPU->CPU
+    fallback discipline (cudapolisher.cpp:354-383).
 
 Windows with fewer than 3 sequences keep their backbone (reference
 window.cpp:68-71); TGS windows are coverage-trimmed (window.cpp:118-139).
 """
 
 from __future__ import annotations
+
+import os
 
 from ..native import poa_batch
 from ..utils.logger import Logger
@@ -34,21 +39,17 @@ class BatchPOA:
         self.window_length = window_length
         self.num_threads = num_threads
         self.device_batches = device_batches
-        # the reference's -b / cuda-banded-alignment: static-band device
-        # DP (band 256 unless overridden), trading accuracy for speed
+        # the reference's -b / cuda-banded-alignment flag selects cudapoa's
+        # static-band mode as an accuracy/speed trade. The evolving-graph
+        # engine always bands adaptively exactly like the host engine
+        # (band 256 where the layer fits, exact DP otherwise, clipped-band
+        # retry), so the flag is accepted for CLI parity but does not
+        # change results.
         self.band = (band_width or 256) if banded else 0
         self.logger = logger
 
     #: windows per host batch call (bounds peak packed-buffer memory)
     HOST_CHUNK = 4096
-    #: anchored-alignment passes on the device path (pass N re-anchors the
-    #: layers on pass N-1's consensus; see _device_consensus). Measured on
-    #: the sample data (PAF+qual w=500, truth distance; host engine 1352):
-    #: 1 pass 2370, 2 passes 1759, 3 passes 1642, 4 passes 1626 — the same
-    #: kind of backend divergence the reference pins separately for its GPU
-    #: engine (racon_test.cpp:312: GPU 1385 vs CPU 1312; 4168 vs 1289 at
-    #: w=1000).
-    device_passes = 3
 
     def generate_consensus(self, windows, trim: bool) -> None:
         """Fill `window.consensus` / `window.polished` for every window."""
@@ -61,24 +62,26 @@ class BatchPOA:
         if not todo:
             return
 
+        host = todo
         if self.device_batches > 0:
             import sys
 
             try:
-                host = self._device_consensus(todo, trim)
+                self._device_consensus(todo, trim)
+                host = []
             except Exception as exc:  # device init/OOM: host completes all
+                if os.environ.get("RACON_TPU_STRICT"):
+                    raise
                 print("[racon_tpu::BatchPOA] warning: device consensus "
                       f"failed ({type(exc).__name__}: {exc}); falling back "
                       "to host engine", file=sys.stderr)
                 host = [w for w in todo if not w.polished]
-        else:
-            host = todo
 
+        if not host:
+            return
         bar = self.logger.bar if self.logger is not None else None
         if self.logger is not None:
-            self.logger.bar_total(len(todo))
-            for _ in range(len(todo) - len(host)):
-                bar("[racon_tpu::Polisher.polish] generating consensus")
+            self.logger.bar_total(len(host))
 
         for s in range(0, len(host), self.HOST_CHUNK):
             chunk = host[s:s + self.HOST_CHUNK]
@@ -92,83 +95,26 @@ class BatchPOA:
                     bar("[racon_tpu::Polisher.polish] generating consensus")
 
     def _device_consensus(self, todo, trim):
-        """Multi-pass device consensus (`device_passes` rounds); returns
-        the windows that must fall back to the host engine.
+        """Evolving-graph device consensus over all of `todo`. The session
+        host-polishes unfit windows internally, so nothing is left over."""
+        import sys
 
-        Pass 1 aligns every layer against the raw window backbone on device
-        and builds an anchored POA consensus. Because anchored alignments
-        cannot see other layers' insertions during alignment (only at graph
-        ingest), pass-1 consensus underperforms evolving-graph alignment —
-        so pass 2 re-aligns all layers against the pass-1 consensus (which
-        already contains the recovered indels) and rebuilds. This converges
-        to within a few percent of the host engine while keeping all
-        O(len^2) DP work on device (cudapoa runs the whole graph algorithm
-        on device instead — see ops/poa_device.py for why that design does
-        not fit XLA).
-        """
-        from .poa_device import device_prealign
+        from .poa_graph import DeviceGraphPOA
 
-        pre1 = device_prealign(todo, self.match, self.mismatch, self.gap,
-                               self.device_batches, self.band,
-                               logger=self.logger)
-        dev = [(i, w) for i, w in enumerate(todo) if pre1[i] is not None]
-        fallback = [w for i, w in enumerate(todo) if pre1[i] is None]
-        if not dev:
-            return fallback
-
-        best = poa_batch([_pack(w) for _, w in dev],
-                         self.match, self.mismatch, self.gap,
-                         n_threads=self.num_threads,
-                         prealigned=[pre1[i] for i, _ in dev])
-
-        # later passes: same layers re-anchored on the previous consensus
-        for _ in range(self.device_passes - 1):
-            rewins = [_Rewindow(cons, w)
-                      for (_, w), (cons, _cov) in zip(dev, best)]
-            pre = device_prealign(rewins, self.match, self.mismatch,
-                                  self.gap, self.device_batches,
-                                  self.band, logger=self.logger)
-            idx = [k for k in range(len(rewins)) if pre[k] is not None]
-            if not idx:
-                break
-            redo = poa_batch([_pack(rewins[k]) for k in idx],
-                             self.match, self.mismatch, self.gap,
-                             n_threads=self.num_threads,
-                             prealigned=[pre[k] for k in idx])
-            for k, res in zip(idx, redo):
-                best[k] = res
-
-        for (_, w), (cons, cov) in zip(dev, best):
+        engine = DeviceGraphPOA(self.match, self.mismatch, self.gap,
+                                num_threads=self.num_threads,
+                                logger=self.logger)
+        results, statuses = engine.consensus([_pack(w) for w in todo])
+        for w, (cons, cov) in zip(todo, results):
             w.apply_trim(cons, cov, trim)
-        return fallback
+        n_fallback = int((statuses == 1).sum())
+        if n_fallback:
+            # the reference logs GPU-skipped work the same way
+            # (cudapolisher.cpp:204-206)
+            print(f"[racon_tpu::BatchPOA] {n_fallback} windows polished on "
+                  "host (outside device kernel envelope)", file=sys.stderr)
 
 
 def _pack(w):
     return [(w.sequences[i], w.qualities[i], w.positions[i][0],
              w.positions[i][1]) for i in range(len(w.sequences))]
-
-
-class _Rewindow:
-    """Pass-2 device-alignment view of a window: the pass-1 consensus as
-    backbone, original layers with positions rescaled (and slightly
-    widened) into consensus coordinates."""
-
-    __slots__ = ("sequences", "qualities", "positions")
-
-    def __init__(self, consensus: bytes, w):
-        backbone_len = len(w.sequences[0])
-        scale = len(consensus) / backbone_len if backbone_len else 1.0
-        end = len(consensus) - 1
-        self.sequences = [consensus] + w.sequences[1:]
-        # the new backbone keeps dummy weight-0 quality, like the window
-        # backbone itself (reference polisher.cpp:393 dummy quality)
-        self.qualities = [b"!" * len(consensus)] + list(w.qualities[1:])
-        self.positions = [(0, end)]
-        # linear rescale can misplace a span by up to the total indel count
-        # when indels are unevenly distributed — widen by that bound so the
-        # true region is always inside the aligned slice
-        slack = 16 + abs(len(consensus) - backbone_len)
-        for b, e in w.positions[1:]:
-            nb = max(0, int(b * scale) - slack)
-            ne = min(end, int(e * scale) + slack + 1)
-            self.positions.append((nb, max(ne, nb + 1)))

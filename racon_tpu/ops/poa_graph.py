@@ -1,0 +1,318 @@
+"""Evolving-graph POA consensus with the graph DP on device.
+
+The consensus role of GenomeWorks cudapoa (reference src/cuda/cudabatch.cpp)
+rebuilt TPU-first. cudapoa keeps the whole POA — graph storage, DP, and
+consensus — inside one CUDA block per window; that pointer-chasing design
+has no good mapping onto the TPU's dense vector units or XLA's static-shape
+model. The split here keeps the *irregular* graph bookkeeping on the host
+(C++ session, native/src/session.cpp) and moves the *regular* hot loop — the
+O(nodes x len) graph-banded NW DP plus traceback — onto the device as one
+batched fixed-shape XLA program:
+
+  - the host densifies each window's current graph into topo-ordered arrays
+    (node codes, predecessor rank lists, band centers, sink flags);
+  - the device kernel scans nodes in topological order (`lax.scan`), each
+    step computing one DP row for the whole batch: gather at most P
+    predecessor rows, diagonal/vertical maxima, then the in-row gap
+    recurrence as a running max (`lax.cummax`) — a formulation with no
+    sequential dependence along the row, so every step is a wide vector op
+    over [batch, len] lanes;
+  - backpointers are derived from score equalities with the same tie order
+    as the host engine (diagonal > vertical > horizontal, predecessors in
+    edge order), and the traceback runs on device as a `lax.while_loop`
+    (it exits as soon as every lane's path is complete rather than paying
+    the worst-case node-count bound);
+  - the resulting per-base node ranks are committed back into the C++
+    session, which ingests them with the exact evolving-graph add_alignment
+    the host engine uses.
+
+Because each layer is aligned against the *evolving* graph — seeing every
+earlier layer's insertions — and both DP and tie-breaking replicate the host
+engine bit-for-bit (including the static-band masking and the clipped-band
+full-DP retry), the device engine produces byte-identical consensus to the
+host engine. The reference accepts backend divergence and pins its GPU
+numbers separately (test/racon_test.cpp:292-496); this design does not have
+to.
+
+Batches are padded to a few static (nodes, len) shape buckets, and the batch
+axis is sharded across every device via parallel/mesh.py — the multi-chip
+analogue of cudapoa's batch-per-GPU loop (src/cuda/cudapolisher.cpp:228-345).
+Within each scheduling cycle, all bucket batches are dispatched before any
+result is fetched, so host graph ingest overlaps device compute through
+JAX's async dispatch (the stream-overlap role of cudapolisher.cpp:165-199).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+from ..utils.logger import Logger
+
+#: kernel shape envelope (the cudapoa BatchConfig role, cudabatch.cpp:56-59:
+#: max seq len 1023, band 256, depth 200 — here: max graph nodes, max layer
+#: len, max node in-degree)
+MAX_NODES = 4096
+MAX_LEN = 1280
+MAX_PRED = 8
+
+_BUCKETS_N = (512, 768, 1024, 1536, 2048, 3072, MAX_NODES)
+_BUCKETS_L = (384, 640, MAX_LEN)
+_BUCKETS_P = (2, 4, MAX_PRED)
+#: target bytes for the DP score tensor + backpointers per batch
+_BATCH_BUDGET = 512 * 1024 * 1024
+#: jobs requested from the session per scheduling cycle
+_CYCLE_JOBS = 256
+
+_NEG = -(1 << 29)  # matches the host engine's kNegInf (INT32_MIN / 4)
+
+
+def _batch_cap(n_nodes: int, seq_len: int) -> int:
+    b = _BATCH_BUDGET // (n_nodes * (seq_len + 1) * 5)
+    return max(4, min(128, 1 << (int(b).bit_length() - 1)))
+
+
+@functools.lru_cache(maxsize=None)
+def graph_aligner(n_nodes: int, seq_len: int, max_pred: int, match: int,
+                  mismatch: int, gap: int):
+    """Jitted batched graph-NW align + traceback for one shape bucket.
+
+    Args (all leading dim B = batch):
+      codes   [B, N] int8   topo-ordered node base codes (pad 5)
+      preds   [B, N, P] int32  predecessor DP-row indices (rank+1; 0 is the
+                               virtual source row; -1 pad)
+      centers [B, N] int32  band center column per node (bpos - origin + 1)
+      sinks   [B, N] uint8  1 = sink node
+      seq     [B, L] int8   layer base codes (pad 5)
+      lens    [B]    int32  layer lengths
+      band    [B]    int32  static band width (0 = exact full DP)
+
+    Returns ranks [B, L] int32: for layer base i, the 0-based topo rank of
+    the node it aligned to, or -1 for an insertion (-2 beyond lens).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    N, L, P = n_nodes, seq_len, max_pred
+    NEG = jnp.int32(_NEG)
+
+    def align(codes, preds, centers, sinks, seq, lens, band):
+        B = codes.shape[0]
+        jidx = jnp.arange(L + 1, dtype=jnp.int32)
+        l32 = lens.astype(jnp.int32)
+        band2 = (band // 2).astype(jnp.int32)
+
+        # virtual source row: D[0][j] = j*gap within the layer, NEG beyond
+        h0 = jnp.where(jidx[None, :] <= l32[:, None], jidx[None, :] * gap,
+                       NEG).astype(jnp.int32)
+        H = jnp.full((B, N + 1, L + 1), NEG, dtype=jnp.int32)
+        H = H.at[:, 0, :].set(h0)
+
+        def step(H, xs):
+            code_k, preds_k, center_k, k = xs  # [B], [B,P], [B], scalar
+            pk = jnp.clip(preds_k, 0, N)
+            rows = jnp.take_along_axis(H, pk[:, :, None], axis=1)
+            rows = jnp.where((preds_k >= 0)[:, :, None], rows, NEG)
+            sub = jnp.where(seq == code_k[:, None], match,
+                            mismatch).astype(jnp.int32)          # [B, L]
+            diag = rows[:, :, :-1] + sub[:, None, :]             # [B, P, L]
+            vert = rows[:, :, 1:] + gap                          # [B, P, L]
+            best = jnp.max(jnp.maximum(diag, vert), axis=1)      # [B, L]
+            row0 = jnp.max(rows[:, :, 0], axis=1) + gap          # [B]
+
+            # static-band masking, replicating the host engine exactly:
+            # out-of-band cells are NEG, and the in-row gap recurrence only
+            # propagates within the band (seeded from column 0 only when
+            # the band touches it)
+            use_band = band > 0
+            jlo = jnp.where(use_band, jnp.maximum(1, center_k - band2), 1)
+            jhi = jnp.where(use_band, jnp.minimum(l32, center_k + band2),
+                            l32)
+            inband = ((jidx[None, 1:] >= jlo[:, None]) &
+                      (jidx[None, 1:] <= jhi[:, None]))          # [B, L]
+            pre = jnp.where(inband, best, NEG)
+            seed0 = jnp.where(jlo == 1, row0, NEG)
+            cat = jnp.concatenate([seed0[:, None], pre], axis=1)
+            run = jax.lax.cummax(cat - jidx * gap, axis=1) + jidx * gap
+            hrow = jnp.where(inband, run[:, 1:], pre)
+            new_row = jnp.concatenate([row0[:, None], hrow], axis=1)
+
+            # backpointers from score equalities against the final row;
+            # tie order matches the host traceback (poa.cpp align_nw):
+            # diagonal first (predecessors in edge order), then vertical,
+            # then horizontal. Encoding: p = diag via pred p; P+p = vert
+            # via pred p; 2P = horizontal.
+            nr = new_row[:, 1:]
+            is_diag = nr[:, None, :] == diag
+            is_vert = nr[:, None, :] == vert
+            pd = jnp.argmax(is_diag, axis=1).astype(jnp.int32)
+            pv = jnp.argmax(is_vert, axis=1).astype(jnp.int32)
+            bpc = jnp.where(jnp.any(is_diag, axis=1), pd,
+                            jnp.where(jnp.any(is_vert, axis=1), P + pv,
+                                      2 * P))
+            is_v0 = row0[:, None] == rows[:, :, 0] + gap         # [B, P]
+            bp0 = P + jnp.argmax(is_v0, axis=1).astype(jnp.int32)
+            bp_row = jnp.concatenate([bp0[:, None], bpc],
+                                     axis=1).astype(jnp.int8)
+
+            H = jax.lax.dynamic_update_slice(
+                H, new_row[:, None, :], (jnp.int32(0), k, jnp.int32(0)))
+            return H, bp_row
+
+        ks = jnp.arange(1, N + 1, dtype=jnp.int32)
+        H, bps = jax.lax.scan(
+            step, H,
+            (codes.T, preds.transpose(1, 0, 2), centers.T, ks))
+        # bps: [N, B, L+1] int8
+
+        # best sink at the layer's final column; ties -> smallest rank
+        # (host: ascending scan keeping strict improvements)
+        flat_h = H.reshape(B, (N + 1) * (L + 1))
+        ridx = (jnp.arange(1, N + 1, dtype=jnp.int32)[None, :] * (L + 1)
+                + l32[:, None])
+        scores = jnp.take_along_axis(flat_h, ridx, axis=1)       # [B, N]
+        cand = jnp.where(sinks > 0, scores, NEG)
+        best_rank = jnp.argmax(cand, axis=1).astype(jnp.int32)
+
+        bp_flat = bps.transpose(1, 0, 2).reshape(B, N * (L + 1))
+        preds_flat = preds.reshape(B, N * P)
+        rows_b = jnp.arange(B)
+
+        def cond(st):
+            r, j, _ = st
+            return jnp.any((r > 0) | (j > 0))
+
+        def body(st):
+            r, j, out = st
+            active = (r > 0) | (j > 0)
+            lin = (jnp.clip(r - 1, 0, N - 1) * (L + 1)
+                   + jnp.clip(j, 0, L))
+            code = jnp.take_along_axis(
+                bp_flat, lin[:, None], axis=1)[:, 0].astype(jnp.int32)
+            code = jnp.where(r > 0, code, 2 * P)  # source row: horizontal
+            is_diag = code < P
+            is_vert = (code >= P) & (code < 2 * P)
+            p = jnp.where(is_diag, code, code - P)
+            plin = (jnp.clip(r - 1, 0, N - 1) * P
+                    + jnp.clip(p, 0, P - 1))
+            pr = jnp.take_along_axis(preds_flat, plin[:, None],
+                                     axis=1)[:, 0]
+            consume = active & ~is_vert                # diag or horizontal
+            jc = jnp.clip(j - 1, 0, L - 1)
+            cur = jnp.take_along_axis(out, jc[:, None], axis=1)[:, 0]
+            emit = jnp.where(is_diag, r - 1, -1)
+            out = out.at[rows_b, jc].set(jnp.where(consume, emit, cur))
+            r = jnp.where(active & (is_diag | is_vert), pr, r)
+            j = jnp.where(consume, j - 1, j)
+            return r, j, out
+
+        out0 = jnp.full((B, L), -2, dtype=jnp.int32)
+        _, _, ranks = jax.lax.while_loop(
+            cond, body, (best_rank + 1, l32, out0))
+        return ranks
+
+    return jax.jit(align)
+
+
+class DeviceGraphPOA:
+    """Orchestrates the session <-> device scheduling loop.
+
+    Each cycle: ask the C++ session for the next ready layer of up to
+    `_CYCLE_JOBS` windows, bucket the jobs by (graph size, layer length),
+    dispatch every bucket batch to the device (async), then fetch results
+    in dispatch order and commit them — so the host's graph ingest for
+    batch k overlaps the device's compute for batch k+1.
+    """
+
+    def __init__(self, match: int, mismatch: int, gap: int,
+                 num_threads: int = 1, logger: Logger | None = None):
+        from ..parallel.mesh import BatchRunner
+
+        self.match = match
+        self.mismatch = mismatch
+        self.gap = gap
+        self.num_threads = num_threads
+        self.logger = logger
+        self.runner = BatchRunner()
+
+    def _bucket(self, n_nodes: int, length: int,
+                maxpred: int) -> tuple[int, int, int]:
+        nb = next(b for b in _BUCKETS_N if n_nodes <= b)
+        lb = next(b for b in _BUCKETS_L if length <= b)
+        pb = next(b for b in _BUCKETS_P if maxpred <= b)
+        return nb, lb, pb
+
+    def consensus(self, windows):
+        """windows: list of lists of (seq, qual|None, begin, end), element 0
+        the backbone. Returns (results, statuses): results like poa_batch's
+        [(consensus bytes, coverages)], statuses int array (0 device,
+        1 host fallback, 2 backbone-only)."""
+        from ..native import PoaSession
+
+        session = PoaSession(windows, self.match, self.mismatch, self.gap,
+                             MAX_NODES, MAX_PRED, MAX_LEN,
+                             max_jobs=_CYCLE_JOBS)
+        bar = self.logger.bar if self.logger is not None else None
+        total_layers = sum(max(0, len(w) - 1) for w in windows)
+        if self.logger is not None and total_layers:
+            self.logger.bar_total(total_layers)
+
+        while True:
+            jobs = session.prepare()
+            if jobs is None:
+                break
+            n = jobs["n"]
+            groups: dict[tuple[int, int, int], list[int]] = {}
+            for i in range(n):
+                b = self._bucket(int(jobs["nnodes"][i]),
+                                 int(jobs["len"][i]),
+                                 int(jobs["maxpred"][i]))
+                groups.setdefault(b, []).append(i)
+
+            pending = []
+            for (nb, lb, pb), idx in sorted(groups.items()):
+                cap = _batch_cap(nb, lb)
+                for s in range(0, len(idx), cap):
+                    part = idx[s:s + cap]
+                    pending.append((lb, part,
+                                    self._dispatch(jobs, part, nb, lb, pb)))
+            for lb, part, out in pending:
+                ranks = np.asarray(out)[:len(part), :lb]
+                session.commit(jobs, part, ranks)
+                if bar is not None:
+                    for _ in part:
+                        bar("[racon_tpu::Polisher.polish] "
+                            "aligning layers to graphs on device")
+        return session.finish(self.num_threads)
+
+    def _dispatch(self, jobs, part, nb, lb, pb):
+        fn = graph_aligner(nb, lb, pb, self.match, self.mismatch,
+                           self.gap)
+        cap = _batch_cap(nb, lb)
+        # a handful of fixed batch sizes per bucket so XLA compiles few
+        # programs: powers of two up to the budget cap
+        b = max(4, 1 << (len(part) - 1).bit_length())
+        b = self.runner.round_batch(min(cap, b))
+        while b < len(part):
+            b *= 2
+        sel = np.asarray(part, dtype=np.int64)
+        pad = b - len(part)
+
+        def take(arr, fill):
+            out = arr[sel]
+            if pad:
+                out = np.concatenate(
+                    [out, np.full((pad,) + out.shape[1:], fill,
+                                  dtype=out.dtype)])
+            return out
+
+        codes = take(jobs["codes"][:, :nb], 5)
+        preds = take(jobs["preds"][:, :nb, :pb], -1)
+        centers = take(jobs["centers"][:, :nb], 0)
+        sinks = take(jobs["sinks"][:, :nb], 0)
+        seqs = take(jobs["seqs"][:, :lb], 5)
+        lens = take(jobs["len"], 0)
+        band = take(jobs["band"], 0)
+        return self.runner.run(fn, codes, preds, centers, sinks, seqs,
+                               lens, band)
