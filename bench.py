@@ -7,12 +7,24 @@ prints ONE JSON line:
 
     {"metric": ..., "value": N, "unit": "windows/sec", "vs_baseline": N}
 
-Device handling: the TPU path (batched layer prealignment,
-ops/poa_device.py) is used when an accelerator is reachable — probed in a
-subprocess with a hard timeout because the axon tunnel blocks forever when
-it is down — and warmed up (one untimed polish) so the reported number is
-steady-state throughput, not XLA compile time. With no reachable device
-the host engine is measured (RACON_TPU_POA_BATCHES=0/1 forces either).
+Failure discipline (round-3 lesson: a pathological device path must not
+eat the whole budget and lose the host number too): every measurement runs
+in a SUBPROCESS with a hard wall-clock cap. The device phase (evolving-
+graph engine, ops/poa_graph.py, RACON_TPU_STRICT so a device failure
+raises instead of silently reporting the host fallback as "device") gets
+_DEVICE_CAP seconds including its kernel precompile; the host phase gets
+_HOST_CAP. The final JSON line is the device number when that phase
+succeeded, else the host number, else an explicit zero — the line is
+emitted under every failure mode.
+
+Device warm-up is `DeviceGraphPOA.precompile()` — all four pinned
+(bucket, batch) programs compiled before the timed loop — instead of a
+second full pipeline run.
+
+An optional device-aligner smoke (the cudaaligner role, ops/align.py;
+enabled with the device phase) reports wall time and skipped-pair counts
+on stderr, mirroring the reference's "[CUDAPolisher] Aligned overlaps ...
+on GPU" accounting (cudapolisher.cpp:204-206). It never affects the JSON.
 
 vs_baseline compares against the reference CPU implementation's
 throughput on the same data: racon 1.4.x with 4 threads polishes this
@@ -21,9 +33,6 @@ sample's ~100 windows in about 2 s of consensus time on a modern x86 core
 i.e. ~50 windows/sec. The reference publishes no official throughput
 numbers (BASELINE.md), so this locally-grounded estimate is the
 comparison point until a like-for-like A100 cudapoa run is available.
-
-Side metrics (consensus identity vs the curated reference assembly, phase
-wall-clocks) go to stderr so the one-line stdout contract stays intact.
 """
 
 from __future__ import annotations
@@ -37,6 +46,10 @@ import time
 REFERENCE_CPU_WINDOWS_PER_SEC = 50.0
 
 DATA = "/root/reference/test/data/"
+
+_DEVICE_CAP = 900.0   # seconds, includes XLA precompile of 4 programs
+_HOST_CAP = 600.0
+_ALIGNER_CAP = 420.0
 
 
 def probe_device(timeout: float = 90.0) -> bool:
@@ -52,60 +65,137 @@ def probe_device(timeout: float = 90.0) -> bool:
         return False
 
 
-def build_polisher(device_batches: int):
+def build_polisher(device_batches: int, aligner_batches: int = 0):
     from racon_tpu.core.polisher import create_polisher, PolisherType
 
     polisher = create_polisher(
         DATA + "sample_reads.fastq.gz", DATA + "sample_overlaps.paf.gz",
         DATA + "sample_layout.fasta.gz", PolisherType.kC, 500, 10.0, 0.3,
         True, 5, -4, -8, num_threads=os.cpu_count() or 1,
-        tpu_poa_batches=device_batches)
-    polisher.initialize()
+        tpu_poa_batches=device_batches,
+        tpu_aligner_batches=aligner_batches)
     return polisher
 
 
-def main() -> int:
+def _identity(polished) -> tuple[int, float]:
     from racon_tpu.io.parsers import create_sequence_parser
     from racon_tpu.native import edit_distance
 
-    forced = os.environ.get("RACON_TPU_POA_BATCHES")
-    if forced is not None:
-        device_batches = int(forced)
-    else:
-        device_batches = 1 if probe_device() else 0
-    mode = "device" if device_batches else "host"
-    print(f"[bench] consensus engine: {mode}", file=sys.stderr)
+    ref: list = []
+    create_sequence_parser(DATA + "sample_reference.fasta.gz",
+                           "bench").parse(ref, -1)
+    dist = edit_distance(polished[0].reverse_complement, ref[0].data)
+    return dist, 1.0 - dist / len(ref[0].data)
 
+
+def phase_consensus(mode: str) -> int:
+    """Child process: measure one engine end-to-end; last stdout line is
+    the phase's JSON result."""
+    device = 1 if mode == "device" else 0
+    polisher = build_polisher(device)
     t0 = time.perf_counter()
-    polisher = build_polisher(device_batches)
+    polisher.initialize()
     init_time = time.perf_counter() - t0
 
-    if device_batches:
-        # warm-up run so XLA compiles don't count against throughput
-        build_polisher(device_batches).polish()
+    if device:
+        from racon_tpu.ops.poa_graph import DeviceGraphPOA
+
+        t = time.perf_counter()
+        DeviceGraphPOA(5, -4, -8).precompile()
+        print(f"[bench] device precompile: {time.perf_counter() - t:.2f}s",
+              file=sys.stderr)
 
     n_windows = len(polisher.windows)
     t1 = time.perf_counter()
     polished = polisher.polish()
     t2 = time.perf_counter()
 
-    ref: list = []
-    create_sequence_parser(DATA + "sample_reference.fasta.gz",
-                           "bench").parse(ref, -1)
-    dist = edit_distance(polished[0].reverse_complement, ref[0].data)
-    identity = 1.0 - dist / len(ref[0].data)
-
+    dist, identity = _identity(polished)
     polish_time = t2 - t1
     wps = n_windows / polish_time if polish_time > 0 else 0.0
-
     print(f"[bench] initialize: {init_time:.2f}s  polish: {polish_time:.2f}s "
           f"({n_windows} windows, {mode} engine)", file=sys.stderr)
     print(f"[bench] edit distance vs reference assembly: {dist} "
           f"(identity {identity * 100:.2f}%; reference CPU fixture: 1312)",
           file=sys.stderr)
+    print(json.dumps({"mode": mode, "wps": wps, "windows": n_windows,
+                      "dist": dist}))
+    return 0
 
+
+def phase_aligner() -> int:
+    """Child process: device-aligner smoke — overlap alignment phase only
+    (initialize), device kernel mandatory (STRICT)."""
+    polisher = build_polisher(0, aligner_batches=1)
+    t0 = time.perf_counter()
+    polisher.initialize()
+    t1 = time.perf_counter()
+    print(f"[bench] device aligner initialize: {t1 - t0:.2f}s",
+          file=sys.stderr)
+    print(json.dumps({"mode": "aligner", "seconds": t1 - t0}))
+    return 0
+
+
+def _run_phase(phase: str, cap: float, strict: bool):
+    """Run one phase in a subprocess under a wall-clock cap. Returns the
+    parsed JSON result dict or None."""
+    env = dict(os.environ)
+    if strict:
+        env["RACON_TPU_STRICT"] = "1"
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--phase", phase],
+            capture_output=True, text=True, timeout=cap, env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+    except subprocess.TimeoutExpired:
+        print(f"[bench] phase {phase}: TIMEOUT after {cap:.0f}s",
+              file=sys.stderr)
+        return None
+    sys.stderr.write(proc.stderr[-4000:])
+    if proc.returncode != 0:
+        print(f"[bench] phase {phase}: rc={proc.returncode}; stdout tail: "
+              f"{proc.stdout[-500:]!r}", file=sys.stderr)
+        return None
+    try:
+        return json.loads(proc.stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError):
+        print(f"[bench] phase {phase}: unparseable stdout "
+              f"{proc.stdout[-500:]!r}", file=sys.stderr)
+        return None
+
+
+def main() -> int:
+    if len(sys.argv) >= 3 and sys.argv[1] == "--phase":
+        if sys.argv[2] == "aligner":
+            return phase_aligner()
+        return phase_consensus(sys.argv[2])
+
+    forced = os.environ.get("RACON_TPU_POA_BATCHES")
+    if forced is not None:
+        want_device = int(forced) > 0
+    else:
+        want_device = probe_device()
+    print(f"[bench] device reachable: {want_device}", file=sys.stderr)
+
+    device_res = None
+    if want_device:
+        device_res = _run_phase("device", _DEVICE_CAP, strict=True)
+        if device_res is not None:
+            _run_phase("aligner", _ALIGNER_CAP, strict=True)
+
+    host_res = None
+    if device_res is None:
+        host_res = _run_phase("host", _HOST_CAP, strict=False)
+
+    res = device_res or host_res
+    if res is None:
+        print(json.dumps({
+            "metric": "sample_polish_consensus_throughput_failed",
+            "value": 0.0, "unit": "windows/sec", "vs_baseline": 0.0}))
+        return 1
+    wps = float(res["wps"])
     print(json.dumps({
-        "metric": f"sample_polish_consensus_throughput_{mode}",
+        "metric": f"sample_polish_consensus_throughput_{res['mode']}",
         "value": round(wps, 2),
         "unit": "windows/sec",
         "vs_baseline": round(wps / REFERENCE_CPU_WINDOWS_PER_SEC, 3),

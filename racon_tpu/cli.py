@@ -65,7 +65,9 @@ usage: racon_tpu [options ...] <sequences> <overlaps> <target sequences>
             default: 0
             number of device batches for TPU accelerated polishing
         -b, --tpu-banded-alignment
-            use banding approximation for alignment on TPU
+            use banding approximation for alignment on TPU: banded POA
+            results are trusted as-is (the clipped-result full-DP retry is
+            skipped), trading exact host-engine parity for speed
         --tpualigner-batches <int>
             default: 0
             number of device batches for TPU accelerated alignment

@@ -17,6 +17,7 @@ wherever JAX is pointed (TPU chip(s) or CPU), optionally sharded over a mesh
 from __future__ import annotations
 
 import enum
+import os
 import sys
 
 import numpy as np
@@ -338,6 +339,8 @@ class Polisher:
                 except Exception as exc:  # device init/OOM: host completes
                     # the cudautils-style device error check with graceful
                     # degradation instead of exit (cudautils.hpp:10-18)
+                    if os.environ.get("RACON_TPU_STRICT"):
+                        raise
                     print("[racon_tpu::Polisher.initialize] warning: device "
                           f"alignment failed ({type(exc).__name__}: {exc}); "
                           "falling back to host aligner", file=sys.stderr)

@@ -26,39 +26,52 @@ _lock = threading.Lock()
 _lib: ctypes.CDLL | None = None
 
 
-def _needs_build() -> bool:
-    if not _LIB.exists():
+def _needs_build(lib: pathlib.Path) -> bool:
+    if not lib.exists():
         return True
-    lib_mtime = _LIB.stat().st_mtime
+    lib_mtime = lib.stat().st_mtime
     return any((_SRC / s).stat().st_mtime > lib_mtime for s in _SOURCES)
 
 
-def build(force: bool = False) -> pathlib.Path:
-    """Compile the shared library if missing or stale."""
+def build(force: bool = False, debug: bool = False) -> pathlib.Path:
+    """Compile the shared library if missing or stale.
+
+    debug=True (or RACON_TPU_NATIVE_DEBUG=1 at import) is the analogue of
+    the reference's sanitizer build (`Makefile:23-25`,
+    `-Db_sanitize=address`): -O1 -g with ASan+UBSan, built to a separate
+    libracon_host_debug.so. ctypes-loading an ASan library requires the
+    runtime to be preloaded, e.g.:
+        LD_PRELOAD=$(g++ -print-file-name=libasan.so) \
+        RACON_TPU_NATIVE_DEBUG=1 python -m pytest tests/test_native.py
+    """
+    lib = _LIB.with_name("libracon_host_debug.so") if debug else _LIB
     with _lock:
-        if force or _needs_build():
+        if force or _needs_build(lib):
+            flags = (["-O1", "-g", "-fsanitize=address,undefined",
+                      "-fno-omit-frame-pointer"] if debug else ["-O3"])
             cmd = [
                 os.environ.get("CXX", "g++"),
-                "-O3", "-std=c++17", "-fPIC", "-shared", "-pthread",
-                "-o", str(_LIB),
+                *flags, "-std=c++17", "-fPIC", "-shared", "-pthread",
+                "-o", str(lib),
             ] + [str(_SRC / s) for s in _SOURCES] + ["-lz"]
             proc = subprocess.run(cmd, capture_output=True, text=True)
             if proc.returncode != 0:
                 raise RuntimeError(
                     f"native build failed ({' '.join(cmd)}):\n{proc.stderr}")
-    return _LIB
+    return lib
 
 
 def get_lib() -> ctypes.CDLL:
     global _lib
     if _lib is None:
-        build()
+        debug = bool(os.environ.get("RACON_TPU_NATIVE_DEBUG"))
+        path = build(debug=debug)
         try:
-            lib = ctypes.CDLL(str(_LIB))
+            lib = ctypes.CDLL(str(path))
         except OSError:
             # stale/foreign binary (e.g. copied between machines) — rebuild
-            build(force=True)
-            lib = ctypes.CDLL(str(_LIB))
+            path = build(force=True, debug=debug)
+            lib = ctypes.CDLL(str(path))
         i64, i32, u8p = ctypes.c_int64, ctypes.c_int32, ctypes.POINTER(ctypes.c_uint8)
         i64p, i32p = ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int32)
         u32p = ctypes.POINTER(ctypes.c_uint32)
@@ -92,7 +105,7 @@ def get_lib() -> ctypes.CDLL:
         lib.rh_poa_session_new.restype = i64
         lib.rh_poa_session_new.argtypes = [
             u8p, i64p, u8p, i64p, i32p, i32p, i64p, i64,
-            i32, i32, i32, i32, i32, i32,
+            i32, i32, i32, i32, i32, i32, i32,
         ]
         lib.rh_poa_session_prepare.restype = i32
         lib.rh_poa_session_prepare.argtypes = [
@@ -102,6 +115,8 @@ def get_lib() -> ctypes.CDLL:
         lib.rh_poa_session_commit.restype = None
         lib.rh_poa_session_commit.argtypes = [i64, i32, i32p, i32p, i32p,
                                               i32p]
+        lib.rh_poa_session_stats.restype = None
+        lib.rh_poa_session_stats.argtypes = [i64, i64p]
         lib.rh_poa_session_finish.restype = i64
         lib.rh_poa_session_finish.argtypes = [i64, i32, u8p, u32p, i64,
                                               i64p, i32p]
@@ -156,7 +171,7 @@ class PoaSession:
 
     def __init__(self, windows, match: int, mismatch: int, gap: int,
                  max_nodes: int, max_pred: int, max_len: int,
-                 max_jobs: int = 256):
+                 max_jobs: int = 256, banded_only: bool = False):
         self._lib = get_lib()
         self.n_windows = len(windows)
         self.max_nodes = max_nodes
@@ -171,7 +186,8 @@ class PoaSession:
             _ptr(packed[2], u8), _ptr(packed[3], ctypes.c_int64),
             _ptr(packed[4], i32), _ptr(packed[5], i32),
             _ptr(packed[6], ctypes.c_int64), self.n_windows,
-            match, mismatch, gap, max_nodes, max_pred, max_len))
+            match, mismatch, gap, max_nodes, max_pred, max_len,
+            1 if banded_only else 0))
         J, N, P, L = max_jobs, max_nodes, max_pred, max_len
         self._buf = {
             "win": np.empty(J, dtype=np.int32),
@@ -206,19 +222,29 @@ class PoaSession:
             return None
         return dict(b, n=n)
 
-    def commit(self, jobs, part, ranks):
-        """Commit device results for job indices `part` of a prepare()
-        batch. ranks: [len(part), lb] int32 node ranks (-1 insertion)."""
-        sel = np.asarray(part, dtype=np.int64)
-        win = np.ascontiguousarray(jobs["win"][sel])
-        layer = np.ascontiguousarray(jobs["layer"][sel])
-        band = np.ascontiguousarray(jobs["band"][sel])
-        full = np.full((len(part), self.max_len), -2, dtype=np.int32)
-        full[:, :ranks.shape[1]] = ranks
+    def commit(self, win, layer, band, ranks):
+        """Commit device results for one dispatched batch. win/layer/band:
+        int32 arrays snapshotted at dispatch; ranks: [n, lb] int32 node
+        ranks (-1 insertion)."""
+        n = len(win)
+        win = np.ascontiguousarray(win, dtype=np.int32)
+        layer = np.ascontiguousarray(layer, dtype=np.int32)
+        band = np.ascontiguousarray(band, dtype=np.int32)
+        full = np.full((n, self.max_len), -2, dtype=np.int32)
+        full[:, :ranks.shape[1]] = ranks[:n]
         i32 = ctypes.c_int32
         self._lib.rh_poa_session_commit(
-            self._handle, len(part), _ptr(win, i32), _ptr(layer, i32),
+            self._handle, n, _ptr(win, i32), _ptr(layer, i32),
             _ptr(band, i32), _ptr(full, i32))
+
+    def stats(self) -> dict:
+        """Session counters: jobs prepared, layers committed, banded
+        clipped->full-DP redos, unfit (host-fallback) windows."""
+        out = np.zeros(4, dtype=np.int64)
+        self._lib.rh_poa_session_stats(self._handle,
+                                       _ptr(out, ctypes.c_int64))
+        return {"prepared": int(out[0]), "committed": int(out[1]),
+                "redos": int(out[2]), "unfit": int(out[3])}
 
     def finish(self, n_threads: int = 1):
         """Generate consensus for every window. Returns (results, statuses):

@@ -58,13 +58,30 @@ struct WindowState {
     bool redo_full = false;           // banded result clipped: redo band=0
     bool unfit = false;               // host fallback at finish()
     bool backbone_only = false;       // < 3 sequences
+
+    // densification cached from prepare() for the matching commit() —
+    // the graph is untouched while a job is outstanding, so the topo
+    // order and subgraph mapping stay valid and are never re-derived
+    bool pending_spanning = false;
+    std::vector<int32_t> pending_order;    // topo rank -> (sub)graph node id
+    std::vector<int32_t> pending_mapping;  // sub node id -> full node id
 };
 
 struct Session {
     std::vector<WindowState> windows;
     int32_t match, mismatch, gap;
     int32_t max_nodes, max_pred, max_len;
+    // -b / banded-only mode: trust banded results (skip the clipped ->
+    // full-DP retry) — the speed/accuracy trade the reference's
+    // --cuda-banded-alignment flag selects via cudapoa's static_band mode
+    // (cudabatch.cpp:56-59). Off by default, which keeps device output
+    // byte-identical to the host engine.
+    bool banded_only = false;
     size_t cursor = 0;  // round-robin scan position for prepare()
+    // observability counters (SURVEY.md §5 metrics discipline)
+    int64_t n_prepared = 0;   // jobs handed to the device
+    int64_t n_committed = 0;  // layer alignments ingested
+    int64_t n_redo = 0;       // banded results clipped -> full-DP requeue
 };
 
 std::mutex g_mutex;
@@ -147,7 +164,8 @@ int64_t rh_poa_session_new(
     const int32_t* begins, const int32_t* ends,
     const int64_t* win_off, int64_t n_windows,
     int32_t match, int32_t mismatch, int32_t gap,
-    int32_t max_nodes, int32_t max_pred, int32_t max_len) {
+    int32_t max_nodes, int32_t max_pred, int32_t max_len,
+    int32_t banded_only) {
     auto session = std::make_unique<Session>();
     session->match = match;
     session->mismatch = mismatch;
@@ -155,6 +173,7 @@ int64_t rh_poa_session_new(
     session->max_nodes = max_nodes;
     session->max_pred = max_pred;
     session->max_len = max_len;
+    session->banded_only = banded_only != 0;
     session->windows.resize(n_windows);
 
     std::vector<uint32_t> wbuf;
@@ -243,6 +262,7 @@ int32_t rh_poa_session_prepare(
         // densify the graph this layer aligns against
         const Graph* g = &ws.graph;
         Graph sub;
+        mapping.clear();
         if (!plan.spanning) {
             sub = ws.graph.subgraph(ws.begins[li], ws.ends[li], mapping);
             g = &sub;
@@ -306,6 +326,9 @@ int32_t rh_poa_session_prepare(
         job_len[n_jobs] = len;
         job_origin[n_jobs] = plan.origin;
         job_maxpred[n_jobs] = max_indeg;
+        ws.pending_spanning = plan.spanning;
+        ws.pending_order = order;
+        ws.pending_mapping = mapping;
         ws.outstanding = true;
         ++n_jobs;
         if (scanned + 1 == n_windows) {
@@ -313,6 +336,7 @@ int32_t rh_poa_session_prepare(
         }
     }
     s->cursor = (s->cursor + n_jobs) % (n_windows ? n_windows : 1);
+    s->n_prepared += n_jobs;
     return n_jobs;
 }
 
@@ -333,28 +357,21 @@ void rh_poa_session_commit(
     }
     const int32_t L = s->max_len;
 
-    std::vector<int32_t> mapping;
     std::vector<uint32_t> wbuf;
     for (int32_t j = 0; j < n_jobs; ++j) {
         WindowState& ws = s->windows[job_win[j]];
         const int32_t li = job_layer[j];
         ws.outstanding = false;
+        // rank -> full-graph node id via the densification cached at
+        // prepare() (the graph is untouched while the job is outstanding)
+        const std::vector<int32_t> order = std::move(ws.pending_order);
+        const std::vector<int32_t> mapping = std::move(ws.pending_mapping);
+        const bool spanning = ws.pending_spanning;
+        ws.pending_order.clear();
+        ws.pending_mapping.clear();
         if (ws.unfit) {
             continue;
         }
-        const racon_host::JobPlan plan =
-            racon_host::plan_layer(ws, li, job_band[j] == 0);
-
-        // rank -> full-graph node id (re-deriving subgraph/topo order is
-        // deterministic and the graph is untouched while outstanding)
-        const Graph* g = &ws.graph;
-        Graph sub;
-        mapping.clear();
-        if (!plan.spanning) {
-            sub = ws.graph.subgraph(ws.begins[li], ws.ends[li], mapping);
-            g = &sub;
-        }
-        const std::vector<int32_t> order = g->topo_order();
         const int32_t n = static_cast<int32_t>(order.size());
 
         const int32_t len = static_cast<int32_t>(ws.seqs[li].size());
@@ -370,7 +387,7 @@ void rh_poa_session_commit(
                     break;
                 }
                 node = order[jr[i]];
-                if (!plan.spanning) {
+                if (!spanning) {
                     node = mapping[node];
                 }
             } else if (jr[i] != -1) {
@@ -383,16 +400,36 @@ void rh_poa_session_commit(
             ws.unfit = true;
             continue;
         }
-        if (job_band[j] > 0 &&
+        if (job_band[j] > 0 && !s->banded_only &&
             racon_host::band_clipped(aln, ws.seqs[li].data(), ws.graph)) {
             ws.redo_full = true;  // re-queue this layer with band 0
+            ++s->n_redo;
             continue;
         }
         ws.graph.add_alignment(aln, ws.seqs[li].data(), len,
                                racon_host::weights_of(ws, li, wbuf));
         ws.redo_full = false;
         ++ws.next_layer;
+        ++s->n_committed;
     }
+}
+
+// Counters: out[0] jobs prepared, out[1] layers committed, out[2] banded
+// clipped->full-DP redos, out[3] unfit (host-fallback) windows so far.
+void rh_poa_session_stats(int64_t handle, int64_t* out) {
+    Session* s = racon_host::get_session(handle);
+    if (s == nullptr) {
+        out[0] = out[1] = out[2] = out[3] = 0;
+        return;
+    }
+    out[0] = s->n_prepared;
+    out[1] = s->n_committed;
+    out[2] = s->n_redo;
+    int64_t unfit = 0;
+    for (const WindowState& ws : s->windows) {
+        unfit += ws.unfit ? 1 : 0;
+    }
+    out[3] = unfit;
 }
 
 // Consensus for every window. Device-built graphs emit directly; unfit

@@ -40,12 +40,13 @@ class BatchPOA:
         self.num_threads = num_threads
         self.device_batches = device_batches
         # the reference's -b / cuda-banded-alignment flag selects cudapoa's
-        # static-band mode as an accuracy/speed trade. The evolving-graph
-        # engine always bands adaptively exactly like the host engine
-        # (band 256 where the layer fits, exact DP otherwise, clipped-band
-        # retry), so the flag is accepted for CLI parity but does not
-        # change results.
-        self.band = (band_width or 256) if banded else 0
+        # static-band mode as a speed/accuracy trade (cudabatch.cpp:56-59)
+        # that only affects the GPU path. Mirrored here: with -b the device
+        # session trusts banded DP results (skips the clipped -> full-DP
+        # retry), trading the byte-identity-with-host guarantee for fewer
+        # device round trips — exactly the reference's GPU-only divergence
+        # pattern (racon_test.cpp:292-496 pins GPU numbers separately).
+        self.banded_only = banded
         self.logger = logger
 
     #: windows per host batch call (bounds peak packed-buffer memory)
@@ -103,10 +104,16 @@ class BatchPOA:
 
         engine = DeviceGraphPOA(self.match, self.mismatch, self.gap,
                                 num_threads=self.num_threads,
-                                logger=self.logger)
+                                logger=self.logger,
+                                banded_only=self.banded_only)
         results, statuses = engine.consensus([_pack(w) for w in todo])
         for w, (cons, cov) in zip(todo, results):
             w.apply_trim(cons, cov, trim)
+        stats = getattr(engine, "last_stats", {})
+        if stats:
+            print(f"[racon_tpu::BatchPOA] device layer alignments: "
+                  f"{stats['committed']} committed, {stats['redos']} "
+                  "banded-clip full-DP retries", file=sys.stderr)
         n_fallback = int((statuses == 1).sum())
         if n_fallback:
             # the reference logs GPU-skipped work the same way

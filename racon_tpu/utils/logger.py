@@ -56,4 +56,4 @@ class Logger:
 
     def total(self, msg: str) -> None:
         elapsed = self._total + (time.perf_counter() - self._time if self._bar else 0)
-        print(f"{msg} {self._total:.5f} s", file=sys.stderr)
+        print(f"{msg} {elapsed:.5f} s", file=sys.stderr)

@@ -166,10 +166,47 @@ def test_fragment_correction_with_qualities_full():
     assert abs(total_length(polished) - 1658216) <= 17000
 
 
+# -- whole-output golden diff (ci/gpu/cuda_test.sh:30-44 analogue) --------
+# the committed file is regenerated only by tools/make_golden.py; both
+# engines must reproduce it byte-for-byte
+
+GOLDEN = os.path.join(os.path.dirname(__file__), "data",
+                      "sample_golden.fasta")
+
+
+def polished_fasta_bytes(device_batches=0):
+    polisher = create_polisher(
+        DATA + "sample_reads.fastq.gz", DATA + "sample_overlaps.paf.gz",
+        DATA + "sample_layout.fasta.gz", PolisherType.kC, 500, 10.0, 0.3,
+        True, 5, -4, -8, num_threads=4, tpu_poa_batches=device_batches)
+    polisher.initialize()
+    out = bytearray()
+    for seq in polisher.polish():
+        out += b">" + seq.name.encode() + b"\n" + seq.data + b"\n"
+    return bytes(out)
+
+
+def test_golden_output_exact_diff_host():
+    with open(GOLDEN, "rb") as fh:
+        golden = fh.read()
+    assert polished_fasta_bytes() == golden
+
+
 full_goldens = pytest.mark.skipif(
     not os.environ.get("RACON_TPU_FULL_GOLDENS"),
     reason="several-minute fixture; set RACON_TPU_FULL_GOLDENS=1 to run "
            "(verified passing; kept out of the default suite for speed)")
+
+
+@full_goldens
+def test_golden_output_exact_diff_device():
+    # the device engine must hit the SAME golden (byte-identity design);
+    # the default suite covers this via
+    # test_determinism.py::test_device_output_matches_host_bytes — this
+    # variant additionally diffs the PAF path against the committed file
+    with open(GOLDEN, "rb") as fh:
+        golden = fh.read()
+    assert polished_fasta_bytes(device_batches=1) == golden
 
 
 @full_goldens

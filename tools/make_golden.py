@@ -1,0 +1,53 @@
+"""Regenerate the committed golden polished FASTA (tests/data/).
+
+The reference's GPU CI pins a whole-run golden output and requires an exact
+byte diff (/root/reference/ci/gpu/cuda_test.sh:30-44, ci/gpu/golden-output.txt,
+5.2 MB). This repo's analogue: the host engine's full polished FASTA for the
+lambda sample, which BOTH engines must reproduce byte-for-byte
+(tests/test_golden.py::test_golden_output_exact_diff*) — the device engine
+is byte-identical to host by design (ops/poa_graph.py).
+
+Run from the repo root after an intentional algorithm change:
+    python tools/make_golden.py
+and commit the updated file with the change that caused it.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from racon_tpu.core.polisher import create_polisher, PolisherType  # noqa: E402
+
+DATA = "/root/reference/test/data/"
+OUT = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                   "tests", "data", "sample_golden.fasta")
+
+
+def polish_fasta(device_batches: int = 0) -> bytes:
+    """The canonical sample polish (the configuration of the reference's
+    first golden fixture, racon_test.cpp:88-109) as FASTA bytes."""
+    p = create_polisher(
+        DATA + "sample_reads.fastq.gz", DATA + "sample_overlaps.paf.gz",
+        DATA + "sample_layout.fasta.gz", PolisherType.kC, 500, 10.0, 0.3,
+        True, 5, -4, -8, num_threads=4, tpu_poa_batches=device_batches)
+    p.initialize()
+    out = bytearray()
+    for seq in p.polish():
+        out += b">" + seq.name.encode() + b"\n" + seq.data + b"\n"
+    return bytes(out)
+
+
+def main() -> int:
+    data = polish_fasta()
+    os.makedirs(os.path.dirname(OUT), exist_ok=True)
+    with open(OUT, "wb") as fh:
+        fh.write(data)
+    print(f"wrote {OUT} ({len(data)} bytes)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
