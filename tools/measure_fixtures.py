@@ -1,0 +1,81 @@
+"""Print this implementation's measured value for every golden fixture.
+
+Used to (re)pin tests/test_golden.py exactly, the way the reference pins
+each backend's numbers (test/racon_test.cpp:107,312 etc.). Run after an
+intentional algorithm change, then update the pins together with it.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tests"))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from test_golden import (  # noqa: E402
+    run_pipeline, reference_distance, total_length, PolisherType)
+
+
+def main() -> int:
+    fixtures = [
+        ("consensus_with_qualities",
+         dict(reads="sample_reads.fastq.gz", overlaps="sample_overlaps.paf.gz",
+              target="sample_layout.fasta.gz")),
+        ("consensus_without_qualities",
+         dict(reads="sample_reads.fasta.gz", overlaps="sample_overlaps.paf.gz",
+              target="sample_layout.fasta.gz")),
+        ("consensus_with_qualities_and_alignments",
+         dict(reads="sample_reads.fastq.gz", overlaps="sample_overlaps.sam.gz",
+              target="sample_layout.fasta.gz")),
+        ("consensus_without_qualities_and_with_alignments",
+         dict(reads="sample_reads.fasta.gz", overlaps="sample_overlaps.sam.gz",
+              target="sample_layout.fasta.gz")),
+        ("consensus_with_qualities_larger_window",
+         dict(reads="sample_reads.fastq.gz", overlaps="sample_overlaps.paf.gz",
+              target="sample_layout.fasta.gz", window_length=1000)),
+        ("consensus_with_qualities_edit_distance",
+         dict(reads="sample_reads.fastq.gz", overlaps="sample_overlaps.paf.gz",
+              target="sample_layout.fasta.gz", match=1, mismatch=-1, gap=-1)),
+    ]
+    for name, kw in fixtures:
+        polished = run_pipeline(kw.pop("reads"), kw.pop("overlaps"),
+                                kw.pop("target"), **kw)
+        print(f"{name}: n={len(polished)} distance="
+              f"{reference_distance(polished[0])}", flush=True)
+
+    frags = [
+        ("fragment_correction_with_qualities",
+         dict(reads="sample_reads.fastq.gz",
+              overlaps="sample_ava_overlaps.paf.gz",
+              target="sample_reads.fastq.gz",
+              match=1, mismatch=-1, gap=-1)),
+        ("fragment_correction_with_qualities_full",
+         dict(reads="sample_reads.fastq.gz",
+              overlaps="sample_ava_overlaps.paf.gz",
+              target="sample_reads.fastq.gz", type_=PolisherType.kF,
+              match=1, mismatch=-1, gap=-1, drop_unpolished=False)),
+        ("fragment_correction_without_qualities_full",
+         dict(reads="sample_reads.fasta.gz",
+              overlaps="sample_ava_overlaps.paf.gz",
+              target="sample_reads.fasta.gz", type_=PolisherType.kF,
+              match=1, mismatch=-1, gap=-1, drop_unpolished=False)),
+        ("fragment_correction_with_qualities_full_mhap",
+         dict(reads="sample_reads.fastq.gz",
+              overlaps="sample_ava_overlaps.mhap.gz",
+              target="sample_reads.fastq.gz", type_=PolisherType.kF,
+              match=1, mismatch=-1, gap=-1, drop_unpolished=False)),
+    ]
+    for name, kw in frags:
+        polished = run_pipeline(kw.pop("reads"), kw.pop("overlaps"),
+                                kw.pop("target"), **kw)
+        print(f"{name}: n={len(polished)} total_bp={total_length(polished)}",
+              flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
