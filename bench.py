@@ -125,7 +125,10 @@ def phase_consensus(mode: str) -> int:
 
 def phase_aligner() -> int:
     """Child process: device-aligner smoke — overlap alignment phase only
-    (initialize), device kernel mandatory (STRICT)."""
+    (initialize), device kernel mandatory (STRICT). Long overlaps host-
+    align (counted as device skips, the cudaaligner exceeded_max_length
+    discipline) so the smoke stays inside its wall cap."""
+    os.environ.setdefault("RACON_TPU_ALIGNER_MAXLEN", "16384")
     polisher = build_polisher(0, aligner_batches=1)
     t0 = time.perf_counter()
     polisher.initialize()

@@ -109,12 +109,12 @@ def get_lib() -> ctypes.CDLL:
         ]
         lib.rh_poa_session_prepare.restype = i32
         lib.rh_poa_session_prepare.argtypes = [
-            i64, i32, i32p, i32p, i32p, i32p, i32p, i32p, i32p,
+            i64, i32, i32, i32p, i32p, i32p, i32p, i32p, i32p, i32p,
             i8p, i32p, i32p, u8p, i8p,
         ]
         lib.rh_poa_session_commit.restype = None
-        lib.rh_poa_session_commit.argtypes = [i64, i32, i32p, i32p, i32p,
-                                              i32p]
+        lib.rh_poa_session_commit.argtypes = [i64, i32, i32, i32p, i32p,
+                                              i32p, i32p]
         lib.rh_poa_session_stats.restype = None
         lib.rh_poa_session_stats.argtypes = [i64, i64p]
         lib.rh_poa_session_finish.restype = i64
@@ -171,13 +171,15 @@ class PoaSession:
 
     def __init__(self, windows, match: int, mismatch: int, gap: int,
                  max_nodes: int, max_pred: int, max_len: int,
-                 max_jobs: int = 256, banded_only: bool = False):
+                 max_jobs: int = 256, banded_only: bool = False,
+                 n_threads: int = 1):
         self._lib = get_lib()
         self.n_windows = len(windows)
         self.max_nodes = max_nodes
         self.max_pred = max_pred
         self.max_len = max_len
         self.max_jobs = max_jobs
+        self.n_threads = n_threads
         packed = _pack_windows(windows)
         self._total_seq_bytes = int(packed[1][-1])
         i32, u8 = ctypes.c_int32, ctypes.c_uint8
@@ -211,7 +213,7 @@ class PoaSession:
         b = self._buf
         i32, i8, u8 = ctypes.c_int32, ctypes.c_int8, ctypes.c_uint8
         n = int(self._lib.rh_poa_session_prepare(
-            self._handle, self.max_jobs,
+            self._handle, self.max_jobs, self.n_threads,
             _ptr(b["win"], i32), _ptr(b["layer"], i32), _ptr(b["band"], i32),
             _ptr(b["nnodes"], i32), _ptr(b["len"], i32),
             _ptr(b["origin"], i32), _ptr(b["maxpred"], i32),
@@ -234,8 +236,8 @@ class PoaSession:
         full[:, :ranks.shape[1]] = ranks[:n]
         i32 = ctypes.c_int32
         self._lib.rh_poa_session_commit(
-            self._handle, n, _ptr(win, i32), _ptr(layer, i32),
-            _ptr(band, i32), _ptr(full, i32))
+            self._handle, n, self.n_threads, _ptr(win, i32),
+            _ptr(layer, i32), _ptr(band, i32), _ptr(full, i32))
 
     def stats(self) -> dict:
         """Session counters: jobs prepared, layers committed, banded

@@ -232,7 +232,7 @@ int64_t rh_poa_session_new(
 // Returns the number of jobs written (0 = no window is ready; the round is
 // drained when this is 0 and no jobs are uncommitted).
 int32_t rh_poa_session_prepare(
-    int64_t handle, int32_t max_jobs,
+    int64_t handle, int32_t max_jobs, int32_t n_threads,
     int32_t* job_win, int32_t* job_layer, int32_t* job_band,
     int32_t* job_nnodes, int32_t* job_len, int32_t* job_origin,
     int32_t* job_maxpred,
@@ -243,11 +243,14 @@ int32_t rh_poa_session_prepare(
         return 0;
     }
     const int32_t N = s->max_nodes, P = s->max_pred, L = s->max_len;
-
-    int32_t n_jobs = 0;
     const size_t n_windows = s->windows.size();
-    std::vector<int32_t> order, rank_of, mapping;
-    for (size_t scanned = 0; scanned < n_windows && n_jobs < max_jobs;
+
+    // pass 1 (serial): round-robin candidate selection — cheap flag checks
+    std::vector<int32_t> cand;
+    cand.reserve(max_jobs);
+    for (size_t scanned = 0;
+         scanned < n_windows &&
+         static_cast<int32_t>(cand.size()) < max_jobs;
          ++scanned) {
         const size_t w = (s->cursor + scanned) % n_windows;
         WindowState& ws = s->windows[w];
@@ -255,87 +258,149 @@ int32_t rh_poa_session_prepare(
             ws.next_layer >= ws.layer_rank.size()) {
             continue;
         }
-        const int32_t li = ws.layer_rank[ws.next_layer];
-        const racon_host::JobPlan plan =
-            racon_host::plan_layer(ws, li, ws.redo_full);
+        cand.push_back(static_cast<int32_t>(w));
+    }
+    const int32_t n_cand = static_cast<int32_t>(cand.size());
+    s->cursor = (s->cursor + n_cand) % (n_windows ? n_windows : 1);
 
-        // densify the graph this layer aligns against
-        const Graph* g = &ws.graph;
-        Graph sub;
-        mapping.clear();
-        if (!plan.spanning) {
-            sub = ws.graph.subgraph(ws.begins[li], ws.ends[li], mapping);
-            g = &sub;
-        }
-        const int32_t n = static_cast<int32_t>(g->nodes.size());
-        if (n > N || static_cast<int32_t>(ws.graph.nodes.size()) > N) {
-            // graph outgrew the kernel envelope (possibly mid-build):
-            // discard and host-polish the whole window at finish()
-            ws.unfit = true;
-            continue;
-        }
-        order = g->topo_order();
-        rank_of.assign(n, 0);
-        for (int32_t r = 0; r < n; ++r) {
-            rank_of[order[r]] = r;
-        }
-        int8_t* jc = codes + static_cast<int64_t>(n_jobs) * N;
-        int32_t* jp = preds + static_cast<int64_t>(n_jobs) * N * P;
-        int32_t* jcen = centers + static_cast<int64_t>(n_jobs) * N;
-        uint8_t* jsink = sinks + static_cast<int64_t>(n_jobs) * N;
-        std::memset(jc, 5, N);
-        std::fill(jp, jp + static_cast<int64_t>(N) * P, -1);
-        std::memset(jcen, 0, static_cast<int64_t>(N) * sizeof(int32_t));
-        std::memset(jsink, 0, N);
-        bool fits = true;
-        int32_t max_indeg = 1;  // the virtual source counts as one slot
-        for (int32_t r = 0; r < n && fits; ++r) {
-            const racon_host::Node& node = g->nodes[order[r]];
-            jc[r] = static_cast<int8_t>(node.code);
-            jcen[r] = node.bpos - plan.origin + 1;
-            jsink[r] = node.out.empty() ? 1 : 0;
-            if (node.in.empty()) {
-                jp[static_cast<int64_t>(r) * P] = 0;  // virtual source row
-            } else if (static_cast<int32_t>(node.in.size()) > P) {
-                fits = false;  // in-degree over the cap: host fallback
-            } else {
-                for (size_t e = 0; e < node.in.size(); ++e) {
-                    jp[static_cast<int64_t>(r) * P + e] =
-                        rank_of[g->edges[node.in[e]].tail] + 1;
-                }
-                if (static_cast<int32_t>(node.in.size()) > max_indeg) {
-                    max_indeg = static_cast<int32_t>(node.in.size());
+    // pass 2 (parallel over candidates — distinct windows, no sharing):
+    // plan, subgraph, topo order, densify into the candidate's slot
+    std::vector<uint8_t> valid(n_cand, 0);
+    std::atomic<int32_t> next(0);
+    auto densify = [&]() {
+        std::vector<int32_t> order, rank_of, mapping;
+        while (true) {
+            const int32_t c = next.fetch_add(1);
+            if (c >= n_cand) {
+                return;
+            }
+            WindowState& ws = s->windows[cand[c]];
+            const int32_t li = ws.layer_rank[ws.next_layer];
+            const racon_host::JobPlan plan =
+                racon_host::plan_layer(ws, li, ws.redo_full);
+            const Graph* g = &ws.graph;
+            Graph sub;
+            mapping.clear();
+            if (!plan.spanning) {
+                sub = ws.graph.subgraph(ws.begins[li], ws.ends[li],
+                                        mapping);
+                g = &sub;
+            }
+            const int32_t n = static_cast<int32_t>(g->nodes.size());
+            if (n > N ||
+                static_cast<int32_t>(ws.graph.nodes.size()) > N) {
+                // graph outgrew the kernel envelope (possibly mid-build):
+                // discard and host-polish the whole window at finish()
+                ws.unfit = true;
+                continue;
+            }
+            order = g->topo_order();
+            rank_of.assign(n, 0);
+            for (int32_t r = 0; r < n; ++r) {
+                rank_of[order[r]] = r;
+            }
+            int8_t* jc = codes + static_cast<int64_t>(c) * N;
+            int32_t* jp = preds + static_cast<int64_t>(c) * N * P;
+            int32_t* jcen = centers + static_cast<int64_t>(c) * N;
+            uint8_t* jsink = sinks + static_cast<int64_t>(c) * N;
+            std::memset(jc, 5, N);
+            std::fill(jp, jp + static_cast<int64_t>(N) * P, -1);
+            std::memset(jcen, 0,
+                        static_cast<int64_t>(N) * sizeof(int32_t));
+            std::memset(jsink, 0, N);
+            bool fits = true;
+            int32_t max_indeg = 1;  // the virtual source counts as one
+            for (int32_t r = 0; r < n && fits; ++r) {
+                const racon_host::Node& node = g->nodes[order[r]];
+                jc[r] = static_cast<int8_t>(node.code);
+                jcen[r] = node.bpos - plan.origin + 1;
+                jsink[r] = node.out.empty() ? 1 : 0;
+                if (node.in.empty()) {
+                    jp[static_cast<int64_t>(r) * P] = 0;  // virtual source
+                } else if (static_cast<int32_t>(node.in.size()) > P) {
+                    fits = false;  // in-degree over the cap: host fallback
+                } else {
+                    for (size_t e = 0; e < node.in.size(); ++e) {
+                        jp[static_cast<int64_t>(r) * P + e] =
+                            rank_of[g->edges[node.in[e]].tail] + 1;
+                    }
+                    if (static_cast<int32_t>(node.in.size()) > max_indeg) {
+                        max_indeg = static_cast<int32_t>(node.in.size());
+                    }
                 }
             }
+            if (!fits) {
+                ws.unfit = true;
+                continue;
+            }
+            const int32_t len = static_cast<int32_t>(ws.seqs[li].size());
+            int8_t* jq = seqs + static_cast<int64_t>(c) * L;
+            std::memset(jq, 5, L);
+            for (int32_t i = 0; i < len; ++i) {
+                jq[i] = static_cast<int8_t>(
+                    racon_host::kBaseCode[ws.seqs[li][i]]);
+            }
+            job_win[c] = cand[c];
+            job_layer[c] = li;
+            job_band[c] = plan.band;
+            job_nnodes[c] = n;
+            job_len[c] = len;
+            job_origin[c] = plan.origin;
+            job_maxpred[c] = max_indeg;
+            ws.pending_spanning = plan.spanning;
+            ws.pending_order = order;
+            ws.pending_mapping = mapping;
+            ws.outstanding = true;
+            valid[c] = 1;
         }
-        if (!fits) {
-            ws.unfit = true;
-            continue;
+    };
+    int32_t nt = n_threads > 1 ? n_threads : 1;
+    if (nt > n_cand) {
+        nt = n_cand > 0 ? n_cand : 1;
+    }
+    if (nt <= 1) {
+        densify();
+    } else {
+        std::vector<std::thread> pool;
+        pool.reserve(nt);
+        for (int32_t t = 0; t < nt; ++t) {
+            pool.emplace_back(densify);
         }
-        const int32_t len = static_cast<int32_t>(ws.seqs[li].size());
-        int8_t* jq = seqs + static_cast<int64_t>(n_jobs) * L;
-        std::memset(jq, 5, L);
-        for (int32_t i = 0; i < len; ++i) {
-            jq[i] = static_cast<int8_t>(
-                racon_host::kBaseCode[ws.seqs[li][i]]);
-        }
-        job_win[n_jobs] = static_cast<int32_t>(w);
-        job_layer[n_jobs] = li;
-        job_band[n_jobs] = plan.band;
-        job_nnodes[n_jobs] = n;
-        job_len[n_jobs] = len;
-        job_origin[n_jobs] = plan.origin;
-        job_maxpred[n_jobs] = max_indeg;
-        ws.pending_spanning = plan.spanning;
-        ws.pending_order = order;
-        ws.pending_mapping = mapping;
-        ws.outstanding = true;
-        ++n_jobs;
-        if (scanned + 1 == n_windows) {
-            break;
+        for (auto& th : pool) {
+            th.join();
         }
     }
-    s->cursor = (s->cursor + n_jobs) % (n_windows ? n_windows : 1);
+
+    // pass 3 (serial): compact over slots invalidated by unfit windows
+    // (rare — at most once per window over the whole session)
+    int32_t n_jobs = 0;
+    for (int32_t c = 0; c < n_cand; ++c) {
+        if (!valid[c]) {
+            continue;
+        }
+        if (n_jobs != c) {
+            std::memcpy(codes + static_cast<int64_t>(n_jobs) * N,
+                        codes + static_cast<int64_t>(c) * N, N);
+            std::memcpy(preds + static_cast<int64_t>(n_jobs) * N * P,
+                        preds + static_cast<int64_t>(c) * N * P,
+                        static_cast<int64_t>(N) * P * sizeof(int32_t));
+            std::memcpy(centers + static_cast<int64_t>(n_jobs) * N,
+                        centers + static_cast<int64_t>(c) * N,
+                        static_cast<int64_t>(N) * sizeof(int32_t));
+            std::memcpy(sinks + static_cast<int64_t>(n_jobs) * N,
+                        sinks + static_cast<int64_t>(c) * N, N);
+            std::memcpy(seqs + static_cast<int64_t>(n_jobs) * L,
+                        seqs + static_cast<int64_t>(c) * L, L);
+            job_win[n_jobs] = job_win[c];
+            job_layer[n_jobs] = job_layer[c];
+            job_band[n_jobs] = job_band[c];
+            job_nnodes[n_jobs] = job_nnodes[c];
+            job_len[n_jobs] = job_len[c];
+            job_origin[n_jobs] = job_origin[c];
+            job_maxpred[n_jobs] = job_maxpred[c];
+        }
+        ++n_jobs;
+    }
     s->n_prepared += n_jobs;
     return n_jobs;
 }
@@ -348,7 +413,7 @@ int32_t rh_poa_session_prepare(
 // band_clipped retry of the host engine). Malformed results mark the
 // window unfit (host fallback).
 void rh_poa_session_commit(
-    int64_t handle, int32_t n_jobs,
+    int64_t handle, int32_t n_jobs, int32_t n_threads,
     const int32_t* job_win, const int32_t* job_layer,
     const int32_t* job_band, const int32_t* ranks) {
     Session* s = racon_host::get_session(handle);
@@ -357,61 +422,91 @@ void rh_poa_session_commit(
     }
     const int32_t L = s->max_len;
 
-    std::vector<uint32_t> wbuf;
-    for (int32_t j = 0; j < n_jobs; ++j) {
-        WindowState& ws = s->windows[job_win[j]];
-        const int32_t li = job_layer[j];
-        ws.outstanding = false;
-        // rank -> full-graph node id via the densification cached at
-        // prepare() (the graph is untouched while the job is outstanding)
-        const std::vector<int32_t> order = std::move(ws.pending_order);
-        const std::vector<int32_t> mapping = std::move(ws.pending_mapping);
-        const bool spanning = ws.pending_spanning;
-        ws.pending_order.clear();
-        ws.pending_mapping.clear();
-        if (ws.unfit) {
-            continue;
-        }
-        const int32_t n = static_cast<int32_t>(order.size());
+    // parallel over jobs: each job's window is distinct within a batch
+    // (one outstanding job per window), so graph ingest has no sharing
+    std::atomic<int32_t> next(0);
+    std::atomic<int64_t> committed(0), redos(0);
+    auto ingest = [&]() {
+        std::vector<uint32_t> wbuf;
+        while (true) {
+            const int32_t j = next.fetch_add(1);
+            if (j >= n_jobs) {
+                return;
+            }
+            WindowState& ws = s->windows[job_win[j]];
+            const int32_t li = job_layer[j];
+            ws.outstanding = false;
+            // rank -> full-graph node id via the densification cached at
+            // prepare() (the graph is untouched while outstanding)
+            const std::vector<int32_t> order = std::move(ws.pending_order);
+            const std::vector<int32_t> mapping =
+                std::move(ws.pending_mapping);
+            const bool spanning = ws.pending_spanning;
+            ws.pending_order.clear();
+            ws.pending_mapping.clear();
+            if (ws.unfit) {
+                continue;
+            }
+            const int32_t n = static_cast<int32_t>(order.size());
 
-        const int32_t len = static_cast<int32_t>(ws.seqs[li].size());
-        const int32_t* jr = ranks + static_cast<int64_t>(j) * L;
-        Alignment aln;
-        aln.reserve(len);
-        bool ok = true;
-        for (int32_t i = 0; i < len; ++i) {
-            int32_t node = -1;
-            if (jr[i] >= 0) {
-                if (jr[i] >= n) {
-                    ok = false;
+            const int32_t len = static_cast<int32_t>(ws.seqs[li].size());
+            const int32_t* jr = ranks + static_cast<int64_t>(j) * L;
+            Alignment aln;
+            aln.reserve(len);
+            bool ok = true;
+            for (int32_t i = 0; i < len; ++i) {
+                int32_t node = -1;
+                if (jr[i] >= 0) {
+                    if (jr[i] >= n) {
+                        ok = false;
+                        break;
+                    }
+                    node = order[jr[i]];
+                    if (!spanning) {
+                        node = mapping[node];
+                    }
+                } else if (jr[i] != -1) {
+                    ok = false;  // -2 pad inside the sequence span
                     break;
                 }
-                node = order[jr[i]];
-                if (!spanning) {
-                    node = mapping[node];
-                }
-            } else if (jr[i] != -1) {
-                ok = false;  // -2 pad inside the sequence span
-                break;
+                aln.push_back(AlnPair{node, i});
             }
-            aln.push_back(AlnPair{node, i});
+            if (!ok) {
+                ws.unfit = true;
+                continue;
+            }
+            if (job_band[j] > 0 && !s->banded_only &&
+                racon_host::band_clipped(aln, ws.seqs[li].data(),
+                                         ws.graph)) {
+                ws.redo_full = true;  // re-queue this layer with band 0
+                redos.fetch_add(1);
+                continue;
+            }
+            ws.graph.add_alignment(aln, ws.seqs[li].data(), len,
+                                   racon_host::weights_of(ws, li, wbuf));
+            ws.redo_full = false;
+            ++ws.next_layer;
+            committed.fetch_add(1);
         }
-        if (!ok) {
-            ws.unfit = true;
-            continue;
-        }
-        if (job_band[j] > 0 && !s->banded_only &&
-            racon_host::band_clipped(aln, ws.seqs[li].data(), ws.graph)) {
-            ws.redo_full = true;  // re-queue this layer with band 0
-            ++s->n_redo;
-            continue;
-        }
-        ws.graph.add_alignment(aln, ws.seqs[li].data(), len,
-                               racon_host::weights_of(ws, li, wbuf));
-        ws.redo_full = false;
-        ++ws.next_layer;
-        ++s->n_committed;
+    };
+    int32_t nt = n_threads > 1 ? n_threads : 1;
+    if (nt > n_jobs) {
+        nt = n_jobs > 0 ? n_jobs : 1;
     }
+    if (nt <= 1) {
+        ingest();
+    } else {
+        std::vector<std::thread> pool;
+        pool.reserve(nt);
+        for (int32_t t = 0; t < nt; ++t) {
+            pool.emplace_back(ingest);
+        }
+        for (auto& th : pool) {
+            th.join();
+        }
+    }
+    s->n_committed += committed.load();
+    s->n_redo += redos.load();
 }
 
 // Counters: out[0] jobs prepared, out[1] layers committed, out[2] banded

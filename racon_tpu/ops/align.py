@@ -254,9 +254,17 @@ class BatchAligner:
     #: target bytes of packed backpointers per device batch
     MAX_BP_BYTES = 192 * 1024 * 1024
 
-    def __init__(self, band_width: int = 0, max_length: int = 65536,
+    def __init__(self, band_width: int = 0, max_length: int | None = None,
                  runner=None):
+        import os
+
         self.band_width = band_width
+        # the cudaaligner max-length envelope (exceeded_max_length ->
+        # CPU, cudaaligner.cpp:63-68); RACON_TPU_ALIGNER_MAXLEN trims it
+        # e.g. for time-capped smoke runs on slow links
+        if max_length is None:
+            max_length = int(os.environ.get("RACON_TPU_ALIGNER_MAXLEN",
+                                            65536))
         self.max_length = max_length
         self.runner = runner
         #: pairs whose banded distance hit the band-adequacy limit and were

@@ -198,9 +198,13 @@ def graph_aligner(n_nodes: int, seq_len: int, max_pred: int, match: int,
             return H, bp_row
 
         ks = jnp.arange(1, N + 1, dtype=jnp.int32)
+        # unroll on TPU: the scan body is small relative to the While-loop
+        # iteration overhead at N=2048 steps; CPU (tests) keeps compiles fast
+        unroll = 4 if jax.default_backend() == "tpu" else 1
         H, bps = jax.lax.scan(
             step, H,
-            (codes.T, preds.transpose(1, 0, 2), centers.T, ks))
+            (codes.T, preds.transpose(1, 0, 2), centers.T, ks),
+            unroll=unroll)
         # bps: [N, B, L+1] int8
 
         # best sink at the layer's final column; ties -> smallest rank
@@ -346,7 +350,8 @@ class DeviceGraphPOA:
         session = PoaSession(windows, self.match, self.mismatch, self.gap,
                              self.max_nodes, self.max_pred, self.max_len,
                              max_jobs=self.cycle_jobs,
-                             banded_only=self.banded_only)
+                             banded_only=self.banded_only,
+                             n_threads=self.num_threads)
         bar = self.logger.bar if self.logger is not None else None
         total_layers = sum(max(0, len(w) - 1) for w in windows)
         if self.logger is not None and total_layers:

@@ -7,12 +7,12 @@ packaged lambda-phage sample data and asserts consensus quality.
 
 The reference pins exact per-backend values (CPU vs CUDA differ:
 e.g. 1312 vs 1385 for the first fixture, racon_test.cpp:107,312) — numeric
-divergence between engines is accepted, each pinned separately. We follow
-the same pattern with *bounds*: the TPU-framework value must be at least as
-good as the worse of the two reference backends (plus a small margin), so
-quality regressions fail loudly while implementation improvements don't
-need constant re-pinning. Measured values for this implementation are noted
-inline.
+divergence between engines is accepted, each pinned separately. This
+implementation is pinned the same way: every fixture asserts THIS
+implementation's measured value exactly (both engines produce the same
+bytes, so one pin covers both; tools/measure_fixtures.py regenerates the
+numbers after an intentional algorithm change). Reference CPU/GPU values
+are noted inline for comparison.
 """
 
 import os
@@ -82,48 +82,47 @@ def test_target_path_extension_error():
 
 
 # -- contig polishing goldens (racon_test.cpp:88-218) ---------------------
-# bounds: worse-of(CPU, GPU reference value) + ~3%
+# pins: THIS implementation's measured value, exact
 
 def test_consensus_with_qualities():
-    # reference: CPU 1312 / GPU 1385 (racon_test.cpp:107,312); measured 1352
+    # reference: CPU 1312 / GPU 1385 (racon_test.cpp:107,312)
     polished = run_pipeline("sample_reads.fastq.gz", "sample_overlaps.paf.gz",
                             "sample_layout.fasta.gz")
     assert len(polished) == 1
-    assert reference_distance(polished[0]) <= 1425
+    assert reference_distance(polished[0]) == 1352
 
 
 def test_consensus_without_qualities():
-    # reference: CPU 1566 / GPU 1607 (racon_test.cpp:129,334); measured 1530
+    # reference: CPU 1566 / GPU 1607 (racon_test.cpp:129,334)
     polished = run_pipeline("sample_reads.fasta.gz", "sample_overlaps.paf.gz",
                             "sample_layout.fasta.gz")
     assert len(polished) == 1
-    assert reference_distance(polished[0]) <= 1655
+    assert reference_distance(polished[0]) == 1530
 
 
 def test_consensus_with_qualities_and_alignments():
-    # reference: CPU 1317 / GPU 1541 (racon_test.cpp:151,356); measured 1358
+    # reference: CPU 1317 / GPU 1541 (racon_test.cpp:151,356)
     polished = run_pipeline("sample_reads.fastq.gz", "sample_overlaps.sam.gz",
                             "sample_layout.fasta.gz")
     assert len(polished) == 1
-    assert reference_distance(polished[0]) <= 1585
+    assert reference_distance(polished[0]) == 1358
 
 
 def test_consensus_without_qualities_and_with_alignments():
-    # reference: CPU 1770 / GPU 1661 (racon_test.cpp:173,378); measured 1859
-    # (the one fixture currently ~5% behind the reference CPU engine)
+    # reference: CPU 1770 / GPU 1661 (racon_test.cpp:173,378); ~5% behind
+    # the reference CPU engine on this one fixture
     polished = run_pipeline("sample_reads.fasta.gz", "sample_overlaps.sam.gz",
                             "sample_layout.fasta.gz")
     assert len(polished) == 1
-    assert reference_distance(polished[0]) <= 1920
+    assert reference_distance(polished[0]) == 1859
 
 
 def test_consensus_with_qualities_larger_window():
-    # reference: CPU 1289 / GPU 4168 (racon_test.cpp:195,400); the GPU value
-    # regresses badly so the bound follows the CPU value
+    # reference: CPU 1289 / GPU 4168 (racon_test.cpp:195,400)
     polished = run_pipeline("sample_reads.fastq.gz", "sample_overlaps.paf.gz",
                             "sample_layout.fasta.gz", window_length=1000)
     assert len(polished) == 1
-    assert reference_distance(polished[0]) <= 1500
+    assert reference_distance(polished[0]) == 1353
 
 
 def test_consensus_with_qualities_edit_distance():
@@ -133,12 +132,10 @@ def test_consensus_with_qualities_edit_distance():
                             "sample_layout.fasta.gz",
                             match=1, mismatch=-1, gap=-1)
     assert len(polished) == 1
-    assert reference_distance(polished[0]) <= 1405
+    assert reference_distance(polished[0]) == 1331
 
 
 # -- fragment correction goldens (racon_test.cpp:220-290) -----------------
-# sequence counts are structural (must match); total lengths are engine-
-# dependent (CPU vs GPU reference differ by ~0.3%), bounded at +-1%
 
 def total_length(polished):
     return sum(len(s.data) for s in polished)
@@ -152,7 +149,7 @@ def test_fragment_correction_with_qualities():
                             "sample_reads.fastq.gz",
                             match=1, mismatch=-1, gap=-1)
     assert len(polished) == 39
-    assert abs(total_length(polished) - 389394) <= 6000
+    assert total_length(polished) == 389340
 
 
 def test_fragment_correction_with_qualities_full():
@@ -163,7 +160,7 @@ def test_fragment_correction_with_qualities_full():
                             match=1, mismatch=-1, gap=-1,
                             drop_unpolished=False)
     assert len(polished) == 236
-    assert abs(total_length(polished) - 1658216) <= 17000
+    assert total_length(polished) == 1658859
 
 
 # -- whole-output golden diff (ci/gpu/cuda_test.sh:30-44 analogue) --------
@@ -218,16 +215,17 @@ def test_fragment_correction_without_qualities_full():
                             match=1, mismatch=-1, gap=-1,
                             drop_unpolished=False)
     assert len(polished) == 236
-    assert abs(total_length(polished) - 1663982) <= 17000
+    assert total_length(polished) == 1664167
 
 
 @full_goldens
 def test_fragment_correction_with_qualities_full_mhap():
-    # reference: 236 seqs, 1658216 bp (CPU) / 1655505 (GPU)
+    # reference: 236 seqs, 1658216 bp (CPU) / 1655505 (GPU); must equal the
+    # PAF fixture's value exactly, as in the reference
     polished = run_pipeline("sample_reads.fastq.gz",
                             "sample_ava_overlaps.mhap.gz",
                             "sample_reads.fastq.gz", type_=PolisherType.kF,
                             match=1, mismatch=-1, gap=-1,
                             drop_unpolished=False)
     assert len(polished) == 236
-    assert abs(total_length(polished) - 1658216) <= 17000
+    assert total_length(polished) == 1658859
