@@ -196,6 +196,31 @@ full_goldens = pytest.mark.skipif(
 
 
 @full_goldens
+def test_synth_genome_golden_exact_diff():
+    """Whole-genome-scale golden: a deterministic 50 kb synthetic ONT
+    workload (tools/synthbench.py, seed 42) must reproduce the committed
+    polished FASTA byte-for-byte — the scale analogue of the reference's
+    5.2 MB CI golden (ci/gpu/cuda_test.sh:30-44)."""
+    import subprocess
+    import sys
+    import tempfile
+
+    golden_path = os.path.join(os.path.dirname(__file__), "data",
+                               "synth_50kb_golden.fasta")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with tempfile.NamedTemporaryFile(suffix=".fasta") as tmp:
+        subprocess.run(
+            [sys.executable, os.path.join(repo, "tools", "synthbench.py"),
+             "--genome-kb", "50", "--coverage", "20", "--seed", "42",
+             "--golden-out", tmp.name],
+            check=True, capture_output=True, cwd=repo)
+        with open(tmp.name, "rb") as fh:
+            got = fh.read()
+    with open(golden_path, "rb") as fh:
+        assert got == fh.read()
+
+
+@full_goldens
 def test_golden_output_exact_diff_device():
     # the device engine must hit the SAME golden (byte-identity design);
     # the default suite covers this via

@@ -84,6 +84,9 @@ def main(argv=None):
     ap.add_argument("-c", "--tpupoa-batches", type=int, default=0)
     ap.add_argument("--tpualigner-batches", type=int, default=0)
     ap.add_argument("--seed", type=int, default=42)
+    ap.add_argument("--golden-out", default=None,
+                    help="write the polished FASTA here (golden artifact; "
+                         "deterministic for a given seed/params)")
     args = ap.parse_args(argv)
 
     from racon_tpu.core.polisher import create_polisher, PolisherType
@@ -121,6 +124,12 @@ def main(argv=None):
         n_windows = len(polisher.windows)
         polished = polisher.polish()
         t2 = time.perf_counter()
+
+    if args.golden_out:
+        with open(args.golden_out, "wb") as fh:
+            for seq in polished:
+                fh.write(b">" + seq.name.encode() + b"\n" + seq.data + b"\n")
+        print(f"[synthbench] wrote golden {args.golden_out}", file=sys.stderr)
 
     d_draft = edit_distance(draft, truth)
     d_pol = edit_distance(polished[0].data, truth)
