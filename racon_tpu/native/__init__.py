@@ -102,6 +102,7 @@ def get_lib() -> ctypes.CDLL:
         lib.rh_sf_close.restype = None
         lib.rh_sf_close.argtypes = [vp]
         i8p = ctypes.POINTER(ctypes.c_int8)
+        i16p = ctypes.POINTER(ctypes.c_int16)
         lib.rh_poa_session_new.restype = i64
         lib.rh_poa_session_new.argtypes = [
             u8p, i64p, u8p, i64p, i32p, i32p, i64p, i64,
@@ -110,7 +111,7 @@ def get_lib() -> ctypes.CDLL:
         lib.rh_poa_session_prepare.restype = i32
         lib.rh_poa_session_prepare.argtypes = [
             i64, i32, i32, i32p, i32p, i32p, i32p, i32p, i32p, i32p,
-            i8p, i32p, i32p, u8p, i8p,
+            i8p, i16p, i16p, u8p, i8p,
         ]
         lib.rh_poa_session_commit.restype = None
         lib.rh_poa_session_commit.argtypes = [i64, i32, i32, i32p, i32p,
@@ -200,8 +201,8 @@ class PoaSession:
             "origin": np.empty(J, dtype=np.int32),
             "maxpred": np.empty(J, dtype=np.int32),
             "codes": np.empty((J, N), dtype=np.int8),
-            "preds": np.empty((J, N, P), dtype=np.int32),
-            "centers": np.empty((J, N), dtype=np.int32),
+            "preds": np.empty((J, N, P), dtype=np.int16),
+            "centers": np.empty((J, N), dtype=np.int16),
             "sinks": np.empty((J, N), dtype=np.uint8),
             "seqs": np.empty((J, L), dtype=np.int8),
         }
@@ -212,13 +213,14 @@ class PoaSession:
         job count, or None when every window is drained."""
         b = self._buf
         i32, i8, u8 = ctypes.c_int32, ctypes.c_int8, ctypes.c_uint8
+        i16 = ctypes.c_int16
         n = int(self._lib.rh_poa_session_prepare(
             self._handle, self.max_jobs, self.n_threads,
             _ptr(b["win"], i32), _ptr(b["layer"], i32), _ptr(b["band"], i32),
             _ptr(b["nnodes"], i32), _ptr(b["len"], i32),
             _ptr(b["origin"], i32), _ptr(b["maxpred"], i32),
-            _ptr(b["codes"], i8), _ptr(b["preds"], i32),
-            _ptr(b["centers"], i32), _ptr(b["sinks"], u8),
+            _ptr(b["codes"], i8), _ptr(b["preds"], i16),
+            _ptr(b["centers"], i16), _ptr(b["sinks"], u8),
             _ptr(b["seqs"], i8)))
         if n <= 0:
             return None

@@ -236,7 +236,7 @@ int32_t rh_poa_session_prepare(
     int32_t* job_win, int32_t* job_layer, int32_t* job_band,
     int32_t* job_nnodes, int32_t* job_len, int32_t* job_origin,
     int32_t* job_maxpred,
-    int8_t* codes, int32_t* preds, int32_t* centers, uint8_t* sinks,
+    int8_t* codes, int16_t* preds, int16_t* centers, uint8_t* sinks,
     int8_t* seqs) {
     Session* s = racon_host::get_session(handle);
     if (s == nullptr || max_jobs <= 0) {
@@ -300,20 +300,21 @@ int32_t rh_poa_session_prepare(
                 rank_of[order[r]] = r;
             }
             int8_t* jc = codes + static_cast<int64_t>(c) * N;
-            int32_t* jp = preds + static_cast<int64_t>(c) * N * P;
-            int32_t* jcen = centers + static_cast<int64_t>(c) * N;
+            int16_t* jp = preds + static_cast<int64_t>(c) * N * P;
+            int16_t* jcen = centers + static_cast<int64_t>(c) * N;
             uint8_t* jsink = sinks + static_cast<int64_t>(c) * N;
             std::memset(jc, 5, N);
-            std::fill(jp, jp + static_cast<int64_t>(N) * P, -1);
+            std::fill(jp, jp + static_cast<int64_t>(N) * P,
+                      static_cast<int16_t>(-1));
             std::memset(jcen, 0,
-                        static_cast<int64_t>(N) * sizeof(int32_t));
+                        static_cast<int64_t>(N) * sizeof(int16_t));
             std::memset(jsink, 0, N);
             bool fits = true;
             int32_t max_indeg = 1;  // the virtual source counts as one
             for (int32_t r = 0; r < n && fits; ++r) {
                 const racon_host::Node& node = g->nodes[order[r]];
                 jc[r] = static_cast<int8_t>(node.code);
-                jcen[r] = node.bpos - plan.origin + 1;
+                jcen[r] = static_cast<int16_t>(node.bpos - plan.origin + 1);
                 jsink[r] = node.out.empty() ? 1 : 0;
                 if (node.in.empty()) {
                     jp[static_cast<int64_t>(r) * P] = 0;  // virtual source
@@ -322,7 +323,8 @@ int32_t rh_poa_session_prepare(
                 } else {
                     for (size_t e = 0; e < node.in.size(); ++e) {
                         jp[static_cast<int64_t>(r) * P + e] =
-                            rank_of[g->edges[node.in[e]].tail] + 1;
+                            static_cast<int16_t>(
+                                rank_of[g->edges[node.in[e]].tail] + 1);
                     }
                     if (static_cast<int32_t>(node.in.size()) > max_indeg) {
                         max_indeg = static_cast<int32_t>(node.in.size());
@@ -383,10 +385,10 @@ int32_t rh_poa_session_prepare(
                         codes + static_cast<int64_t>(c) * N, N);
             std::memcpy(preds + static_cast<int64_t>(n_jobs) * N * P,
                         preds + static_cast<int64_t>(c) * N * P,
-                        static_cast<int64_t>(N) * P * sizeof(int32_t));
+                        static_cast<int64_t>(N) * P * sizeof(int16_t));
             std::memcpy(centers + static_cast<int64_t>(n_jobs) * N,
                         centers + static_cast<int64_t>(c) * N,
-                        static_cast<int64_t>(N) * sizeof(int32_t));
+                        static_cast<int64_t>(N) * sizeof(int16_t));
             std::memcpy(sinks + static_cast<int64_t>(n_jobs) * N,
                         sinks + static_cast<int64_t>(c) * N, N);
             std::memcpy(seqs + static_cast<int64_t>(n_jobs) * L,

@@ -90,7 +90,7 @@ def _bytes_per_row(n_nodes: int, seq_len: int, max_pred: int) -> int:
     the densified inputs."""
     h = (n_nodes + 1) * (seq_len + 1) * 4
     bp = 2 * n_nodes * (seq_len + 1)
-    inputs = n_nodes * (4 * max_pred + 6) + seq_len
+    inputs = n_nodes * (2 * max_pred + 4) + seq_len
     return h + bp + inputs
 
 
@@ -115,17 +115,18 @@ def graph_aligner(n_nodes: int, seq_len: int, max_pred: int, match: int,
                   mismatch: int, gap: int):
     """Jitted batched graph-NW align + traceback for one shape bucket.
 
-    Args (all leading dim B = batch):
+    Args (all leading dim B = batch; preds/centers ship as int16 — half
+    the host->device bytes, upcast on device):
       codes   [B, N] int8   topo-ordered node base codes (pad 5)
-      preds   [B, N, P] int32  predecessor DP-row indices (rank+1; 0 is the
+      preds   [B, N, P] int16  predecessor DP-row indices (rank+1; 0 is the
                                virtual source row; -1 pad)
-      centers [B, N] int32  band center column per node (bpos - origin + 1)
+      centers [B, N] int16  band center column per node (bpos - origin + 1)
       sinks   [B, N] uint8  1 = sink node
       seq     [B, L] int8   layer base codes (pad 5)
       lens    [B]    int32  layer lengths
       band    [B]    int32  static band width (0 = exact full DP)
 
-    Returns ranks [B, L] int32: for layer base i, the 0-based topo rank of
+    Returns ranks [B, L] int16: for layer base i, the 0-based topo rank of
     the node it aligned to, or -1 for an insertion (-2 beyond lens).
     """
     import jax
@@ -136,6 +137,8 @@ def graph_aligner(n_nodes: int, seq_len: int, max_pred: int, match: int,
 
     def align(codes, preds, centers, sinks, seq, lens, band):
         B = codes.shape[0]
+        preds = preds.astype(jnp.int32)
+        centers = centers.astype(jnp.int32)
         jidx = jnp.arange(L + 1, dtype=jnp.int32)
         l32 = lens.astype(jnp.int32)
         band2 = (band // 2).astype(jnp.int32)
@@ -242,13 +245,14 @@ def graph_aligner(n_nodes: int, seq_len: int, max_pred: int, match: int,
             consume = active & ~is_vert                # diag or horizontal
             jc = jnp.clip(j - 1, 0, L - 1)
             cur = jnp.take_along_axis(out, jc[:, None], axis=1)[:, 0]
-            emit = jnp.where(is_diag, r - 1, -1)
+            emit = jnp.where(is_diag, r - 1, -1).astype(jnp.int16)
             out = out.at[rows_b, jc].set(jnp.where(consume, emit, cur))
             r = jnp.where(active & (is_diag | is_vert), pr, r)
             j = jnp.where(consume, j - 1, j)
             return r, j, out
 
-        out0 = jnp.full((B, L), -2, dtype=jnp.int32)
+        # int16 output: rank < N <= 32767; halves the device->host bytes
+        out0 = jnp.full((B, L), -2, dtype=jnp.int16)
         _, _, ranks = jax.lax.while_loop(
             cond, body, (best_rank + 1, l32, out0))
         return ranks
@@ -321,10 +325,10 @@ class DeviceGraphPOA:
             # a valid tiny problem: linear 2-node chain, 2-base layer
             codes = np.full((B, nb), 5, dtype=np.int8)
             codes[:, :2] = 0
-            preds = np.full((B, nb, self.max_pred), -1, dtype=np.int32)
+            preds = np.full((B, nb, self.max_pred), -1, dtype=np.int16)
             preds[:, 0, 0] = 0
             preds[:, 1, 0] = 1
-            centers = np.zeros((B, nb), dtype=np.int32)
+            centers = np.zeros((B, nb), dtype=np.int16)
             centers[:, :2] = (1, 2)
             sinks = np.zeros((B, nb), dtype=np.uint8)
             sinks[:, 1] = 1

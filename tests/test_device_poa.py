@@ -65,8 +65,8 @@ def linear_graph_inputs(ts, qs, n_nodes, seq_len, max_pred=4):
     does, so the kernel can be tested directly against plain NW."""
     B = len(ts)
     codes = np.full((B, n_nodes), 5, dtype=np.int8)
-    preds = np.full((B, n_nodes, max_pred), -1, dtype=np.int32)
-    centers = np.zeros((B, n_nodes), dtype=np.int32)
+    preds = np.full((B, n_nodes, max_pred), -1, dtype=np.int16)
+    centers = np.zeros((B, n_nodes), dtype=np.int16)
     sinks = np.zeros((B, n_nodes), dtype=np.uint8)
     seqs = np.full((B, seq_len), 5, dtype=np.int8)
     lens = np.zeros(B, dtype=np.int32)
