@@ -241,15 +241,19 @@ class PoaSession:
             "seqs": np.empty((J, L), dtype=np.int8),
         }
 
-    def prepare(self):
+    def prepare(self, max_jobs: int | None = None):
         """Returns a dict of job arrays (buffers reused across calls — the
         caller must consume/copy before the next prepare) with key "n" =
-        job count, or None when every window is drained."""
+        job count, or None when no window is ready. `max_jobs` limits this
+        call (defaults to the buffer capacity) — the scheduler uses it to
+        split windows into interleaved half-batches for pipelining."""
         b = self._buf
         i32, i8, u8 = ctypes.c_int32, ctypes.c_int8, ctypes.c_uint8
         i16 = ctypes.c_int16
+        want = self.max_jobs if max_jobs is None else min(max_jobs,
+                                                          self.max_jobs)
         n = int(self._lib.rh_poa_session_prepare(
-            self._handle, self.max_jobs, self.n_threads,
+            self._handle, want, self.n_threads,
             _ptr(b["win"], i32), _ptr(b["layer"], i32), _ptr(b["band"], i32),
             _ptr(b["nnodes"], i32), _ptr(b["len"], i32),
             _ptr(b["origin"], i32), _ptr(b["maxpred"], i32),

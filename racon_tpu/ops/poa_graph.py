@@ -365,16 +365,35 @@ class DeviceGraphPOA:
         if self.logger is not None and total_layers:
             self.logger.bar_total(total_layers)
 
-        # pipeline depth: how many dispatched batches may be in flight
-        # before the host pauses preparing new work (bounds queued device
-        # memory on large inputs while keeping the device fed)
-        depth = 8
+        # split-half pipelining: each prepare() pulls at most HALF the
+        # active windows (round-robin), so while half A's results are
+        # committed (mutating graphs), half B computes on device — and
+        # every batch stays large (few device calls, few round trips)
+        # instead of fragmenting to whatever the last commit freed.
+        n_active = sum(1 for w in windows if len(w) >= 3)
+        half = max(8, min(self.cycle_jobs, max(1, n_active // 2)))
+        # how many dispatched batches to keep queued: enough to hide the
+        # host's commit+prepare time behind device compute, small enough
+        # to bound queued transfers on large inputs
+        depth = 4
+        # prepare only in BURSTS — once enough windows have been freed by
+        # commits to fill a decent batch — otherwise each commit's handful
+        # of freed windows would round-trip as a tiny fragment batch
+        threshold = 1
+        freed = 1
         inflight: deque = deque()
         while True:
-            if len(inflight) < depth:
-                jobs = session.prepare()
-                if jobs is not None:
+            if freed >= threshold or not inflight:
+                burst = 0
+                while len(inflight) < depth:
+                    jobs = session.prepare(half)
+                    if jobs is None:
+                        break
+                    burst += jobs["n"]
                     inflight.extend(self._dispatch_round(jobs))
+                if burst:
+                    freed = 0
+                    threshold = max(8, burst // 2)
             if not inflight:
                 break
             # commit the oldest batch (blocks only on ITS device result;
@@ -382,12 +401,18 @@ class DeviceGraphPOA:
             win, layer, band, npart, lb, out = inflight.popleft()
             ranks = np.asarray(out)[:npart, :lb]
             session.commit(win, layer, band, ranks)
+            freed += npart
             if bar is not None:
                 for _ in range(npart):
                     bar("[racon_tpu::Polisher.polish] "
                         "aligning layers to graphs on device")
         self.last_stats = session.stats()
         return session.finish(self.num_threads)
+
+    #: bucket groups smaller than this merge upward into the next larger
+    #: nonempty bucket: a slightly longer scan for a few jobs beats paying
+    #: another device round trip for a nearly-empty batch
+    MIN_FILL = 16
 
     def _dispatch_round(self, jobs):
         """Bucket one prepare() round and dispatch every batch async.
@@ -399,6 +424,16 @@ class DeviceGraphPOA:
         for i in range(n):
             b = self._bucket(int(jobs["nnodes"][i]), int(jobs["len"][i]))
             groups.setdefault(b, []).append(i)
+
+        # merge under-filled groups upward (jobs always fit any larger
+        # bucket) so each round dispatches few, well-filled batches
+        order = sorted(groups)
+        for gi, b in enumerate(order[:-1]):
+            if len(groups.get(b, ())) < self.MIN_FILL:
+                for nb in order[gi + 1:]:
+                    if groups.get(nb) and nb[0] >= b[0] and nb[1] >= b[1]:
+                        groups[nb] = groups.pop(b) + groups[nb]
+                        break
 
         batches = []
         for (nb, lb), idx in sorted(groups.items()):
