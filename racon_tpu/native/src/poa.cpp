@@ -206,16 +206,23 @@ std::vector<int32_t> Graph::topo_order() const {
 
 static constexpr int32_t kNegInf = std::numeric_limits<int32_t>::min() / 4;
 
-Alignment Graph::align_nw(const uint8_t* seq, int32_t len, int32_t match,
-                          int32_t mismatch, int32_t gap, int32_t band,
-                          int32_t bpos_origin) const {
+// DP + traceback body, templated on the score cell type: int16_t halves
+// the memory traffic and doubles the SIMD lane count of the hot loops when
+// the score bounds allow it (checked by align_nw); int32_t otherwise. The
+// clamp to neg_inf in the fold loops stops unreachable-cell drift from
+// wrapping the narrow type; reachable scores and the traceback are
+// bit-identical between the two instantiations.
+template <typename S>
+static Alignment align_nw_impl(const Graph& g, const uint8_t* seq,
+                               int32_t len, int32_t match, int32_t mismatch,
+                               int32_t gap, int32_t band,
+                               int32_t bpos_origin, S neg_inf) {
     Alignment out;
+    const std::vector<Node>& nodes = g.nodes;
+    const std::vector<Edge>& edges = g.edges;
     const int32_t n = static_cast<int32_t>(nodes.size());
-    if (n == 0 || len <= 0) {
-        return out;
-    }
 
-    const std::vector<int32_t> order = topo_order();
+    const std::vector<int32_t> order = g.topo_order();
     std::vector<int32_t> rank_of(n);
     for (int32_t r = 0; r < n; ++r) {
         rank_of[order[r]] = r;
@@ -223,29 +230,30 @@ Alignment Graph::align_nw(const uint8_t* seq, int32_t len, int32_t match,
 
     // H is (n + 1) x (len + 1); row 0 is the virtual source.
     const int64_t stride = len + 1;
-    std::vector<int32_t> H(static_cast<size_t>(n + 1) * stride);
+    std::vector<S> H(static_cast<size_t>(n + 1) * stride);
     for (int32_t j = 0; j <= len; ++j) {
-        H[j] = j * gap;
+        H[j] = static_cast<S>(j * gap);
     }
 
     // per-code substitution profiles hoisted out of the DP loops (the
     // striped-profile idea SIMD POA engines use): profile[c][j] is the
     // diagonal score delta for aligning seq[j-1] to a code-c node, so the
     // inner loops below are branchless and auto-vectorize.
-    std::vector<int32_t> profile(static_cast<size_t>(5) * stride);
+    std::vector<S> profile(static_cast<size_t>(5) * stride);
     for (int32_t c = 0; c < 5; ++c) {
-        int32_t* p = &profile[static_cast<size_t>(c) * stride];
+        S* p = &profile[static_cast<size_t>(c) * stride];
         for (int32_t j = 1; j <= len; ++j) {
-            p[j] = (kBaseCode[seq[j - 1]] == c) ? match : mismatch;
+            p[j] = static_cast<S>((kBaseCode[seq[j - 1]] == c) ? match
+                                                               : mismatch);
         }
     }
+    const S sgap = static_cast<S>(gap);
 
     std::vector<int32_t> pred_rows;  // predecessor row indices, reused
     for (int32_t r = 1; r <= n; ++r) {
         const Node& node = nodes[order[r - 1]];
-        int32_t* row = &H[static_cast<size_t>(r) * stride];
-        const int32_t* prof =
-            &profile[static_cast<size_t>(node.code) * stride];
+        S* row = &H[static_cast<size_t>(r) * stride];
+        const S* prof = &profile[static_cast<size_t>(node.code) * stride];
 
         // banded: compute only columns near the node's expected diagonal;
         // everything else scores -inf (cheap vector fill vs DP compute)
@@ -254,7 +262,7 @@ Alignment Graph::align_nw(const uint8_t* seq, int32_t len, int32_t match,
             const int32_t center = node.bpos - bpos_origin + 1;
             jlo = std::max<int32_t>(1, center - band / 2);
             jhi = std::min<int32_t>(len, center + band / 2);
-            std::fill(row, row + stride, kNegInf);
+            std::fill(row, row + stride, neg_inf);
         }
 
         pred_rows.clear();
@@ -267,36 +275,40 @@ Alignment Graph::align_nw(const uint8_t* seq, int32_t len, int32_t match,
 
         // initialize from the first predecessor, then fold the rest in
         {
-            const int32_t* prow = &H[static_cast<size_t>(pred_rows[0]) * stride];
-            row[0] = prow[0] + gap;
+            const S* prow = &H[static_cast<size_t>(pred_rows[0]) * stride];
+            row[0] = static_cast<S>(prow[0] + sgap);
             for (int32_t j = jlo; j <= jhi; ++j) {
-                const int32_t diag = prow[j - 1] + prof[j];
-                const int32_t vert = prow[j] + gap;
-                row[j] = diag > vert ? diag : vert;
+                const S diag = static_cast<S>(prow[j - 1] + prof[j]);
+                const S vert = static_cast<S>(prow[j] + sgap);
+                const S best = diag > vert ? diag : vert;
+                row[j] = best > neg_inf ? best : neg_inf;
             }
         }
         for (size_t pi = 1; pi < pred_rows.size(); ++pi) {
-            const int32_t* prow = &H[static_cast<size_t>(pred_rows[pi]) * stride];
-            if (prow[0] + gap > row[0]) row[0] = prow[0] + gap;
+            const S* prow = &H[static_cast<size_t>(pred_rows[pi]) * stride];
+            if (static_cast<S>(prow[0] + sgap) > row[0]) {
+                row[0] = static_cast<S>(prow[0] + sgap);
+            }
             for (int32_t j = jlo; j <= jhi; ++j) {
-                const int32_t diag = prow[j - 1] + prof[j];
-                const int32_t vert = prow[j] + gap;
-                const int32_t best = diag > vert ? diag : vert;
+                const S diag = static_cast<S>(prow[j - 1] + prof[j]);
+                const S vert = static_cast<S>(prow[j] + sgap);
+                const S best = diag > vert ? diag : vert;
                 if (best > row[j]) row[j] = best;
             }
         }
         // horizontal pass (sequence gap) — must run after all predecessors
         for (int32_t j = jlo; j <= jhi; ++j) {
-            const int32_t horiz = row[j - 1] + gap;
+            const S horiz = static_cast<S>(row[j - 1] + sgap);
             if (horiz > row[j]) row[j] = horiz;
         }
     }
 
     // best sink row at the final column (ties -> smallest rank)
-    int32_t best_r = -1, best_score = kNegInf;
+    int32_t best_r = -1;
+    S best_score = neg_inf;
     for (int32_t r = 1; r <= n; ++r) {
         if (!nodes[order[r - 1]].out.empty()) continue;
-        const int32_t s = H[static_cast<size_t>(r) * stride + len];
+        const S s = H[static_cast<size_t>(r) * stride + len];
         if (s > best_score) {
             best_score = s;
             best_r = r;
@@ -309,7 +321,7 @@ Alignment Graph::align_nw(const uint8_t* seq, int32_t len, int32_t match,
     // traceback; preference: diagonal, vertical, horizontal (deterministic)
     int32_t r = best_r, j = len;
     while (r != 0 || j != 0) {
-        const int32_t cur = H[static_cast<size_t>(r) * stride + j];
+        const S cur = H[static_cast<size_t>(r) * stride + j];
         bool moved = false;
         if (r != 0) {
             const Node& node = nodes[order[r - 1]];
@@ -321,10 +333,12 @@ Alignment Graph::align_nw(const uint8_t* seq, int32_t len, int32_t match,
                 pred_rows.push_back(0);
             }
             if (j > 0) {
-                const int32_t sub =
-                    (kBaseCode[seq[j - 1]] == node.code) ? match : mismatch;
+                const S sub = static_cast<S>(
+                    (kBaseCode[seq[j - 1]] == node.code) ? match : mismatch);
                 for (int32_t pr : pred_rows) {
-                    if (H[static_cast<size_t>(pr) * stride + j - 1] + sub == cur) {
+                    if (static_cast<S>(
+                            H[static_cast<size_t>(pr) * stride + j - 1] +
+                            sub) == cur) {
                         out.push_back(AlnPair{order[r - 1], j - 1});
                         r = pr;
                         --j;
@@ -335,7 +349,9 @@ Alignment Graph::align_nw(const uint8_t* seq, int32_t len, int32_t match,
             }
             if (!moved) {
                 for (int32_t pr : pred_rows) {
-                    if (H[static_cast<size_t>(pr) * stride + j] + gap == cur) {
+                    if (static_cast<S>(
+                            H[static_cast<size_t>(pr) * stride + j] +
+                            sgap) == cur) {
                         out.push_back(AlnPair{order[r - 1], -1});
                         r = pr;
                         moved = true;
@@ -352,6 +368,32 @@ Alignment Graph::align_nw(const uint8_t* seq, int32_t len, int32_t match,
     }
     std::reverse(out.begin(), out.end());
     return out;
+}
+
+
+Alignment Graph::align_nw(const uint8_t* seq, int32_t len, int32_t match,
+                          int32_t mismatch, int32_t gap, int32_t band,
+                          int32_t bpos_origin) const {
+    const int32_t n = static_cast<int32_t>(nodes.size());
+    if (n == 0 || len <= 0) {
+        return Alignment();
+    }
+    // int16 cells when every reachable score fits with margin: the worst
+    // real path magnitude is (n + len + 2) * max|score|, which must stay
+    // above the -28000 unreachable sentinel (itself clear of INT16_MIN
+    // after the per-row clamp)
+    const int32_t maxabs = std::max(std::abs(match),
+                                    std::max(std::abs(mismatch),
+                                             std::abs(gap)));
+    const int64_t bound =
+        static_cast<int64_t>(n + len + 2) * std::max(maxabs, 1);
+    if (bound < 27000) {
+        return align_nw_impl<int16_t>(*this, seq, len, match, mismatch, gap,
+                                      band, bpos_origin,
+                                      static_cast<int16_t>(-28000));
+    }
+    return align_nw_impl<int32_t>(*this, seq, len, match, mismatch, gap,
+                                  band, bpos_origin, kNegInf);
 }
 
 Graph Graph::subgraph(int32_t begin, int32_t end,

@@ -370,8 +370,14 @@ class DeviceGraphPOA:
         # committed (mutating graphs), half B computes on device — and
         # every batch stays large (few device calls, few round trips)
         # instead of fragmenting to whatever the last commit freed.
+        import os
+
         n_active = sum(1 for w in windows if len(w) >= 3)
-        half = max(8, min(self.cycle_jobs, max(1, n_active // 2)))
+        # RACON_TPU_SCHED_HALVES: windows per prepare = active/H. H=2
+        # overlaps host ingest with device compute; H=1 minimizes device
+        # round trips (serial rounds) — tune per link latency
+        halves = max(1, int(os.environ.get("RACON_TPU_SCHED_HALVES", "2")))
+        half = max(8, min(self.cycle_jobs, max(1, n_active // halves)))
         # how many dispatched batches to keep queued: enough to hide the
         # host's commit+prepare time behind device compute, small enough
         # to bound queued transfers on large inputs
