@@ -52,3 +52,27 @@ def test_device_output_matches_host_bytes():
     host = polish_bytes(threads=2)
     device = polish_bytes(threads=2, device=1)
     assert device == host
+
+
+@pytest.mark.skipif(not os.environ.get("RACON_TPU_FULL_GOLDENS"),
+                    reason="several-minute fixture; RACON_TPU_FULL_GOLDENS=1")
+def test_device_output_matches_host_bytes_fragment_correction():
+    """Same identity claim on the fragment-correction workload (kF, NGS-
+    style short windows — exercises the small device buckets and subgraph
+    jobs the contig sample rarely hits)."""
+    from racon_tpu.core.polisher import PolisherType
+
+    def run(device):
+        p = create_polisher(DATA + "sample_reads.fastq.gz",
+                            DATA + "sample_ava_overlaps.paf.gz",
+                            DATA + "sample_reads.fastq.gz",
+                            PolisherType.kF, 500, 10.0, 0.3,
+                            match=1, mismatch=-1, gap=-1, num_threads=2,
+                            tpu_poa_batches=device)
+        p.initialize()
+        out = b""
+        for seq in p.polish(False):
+            out += b">" + seq.name.encode() + b"\n" + seq.data + b"\n"
+        return out
+
+    assert run(1) == run(0)
