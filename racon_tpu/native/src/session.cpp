@@ -626,4 +626,105 @@ void rh_poa_session_free(int64_t handle) {
     racon_host::g_sessions.erase(handle);
 }
 
+// Consensus from the fused device engine's fetched graph arrays
+// (racon_tpu/ops/poa_fused.py): rebuild each window's Graph — nodes with
+// codes and sequence counts, edges from the predecessor slots in slot
+// order (the DP tie-break order), aligned lists from column membership —
+// then run the exact host heaviest-bundle consensus. Output layout
+// identical to rh_poa_batch; returns total bytes or -needed.
+int64_t rh_poa_finish_arrays(
+    const int8_t* codes, const int16_t* preds, const int32_t* predw,
+    const int32_t* nseq, const int16_t* col_of,
+    const int32_t* n_nodes, int64_t n_windows, int32_t N, int32_t P,
+    int32_t n_threads,
+    uint8_t* cons_data, uint32_t* cov_data, int64_t cons_cap,
+    int64_t* cons_off) {
+    std::vector<std::vector<uint8_t>> results(n_windows);
+    std::vector<std::vector<uint32_t>> coverages(n_windows);
+
+    std::atomic<int64_t> next_w(0);
+    auto worker = [&]() {
+        while (true) {
+            const int64_t w = next_w.fetch_add(1);
+            if (w >= n_windows) {
+                return;
+            }
+            const int32_t n = n_nodes[w];
+            const int8_t* wc = codes + w * N;
+            const int16_t* wp = preds + static_cast<int64_t>(w) * N * P;
+            const int32_t* ww = predw + static_cast<int64_t>(w) * N * P;
+            const int32_t* wn = nseq + w * N;
+            const int16_t* wcol = col_of + w * N;
+
+            Graph g;
+            g.nodes.resize(n);
+            std::unordered_map<int32_t, std::vector<int32_t>> columns;
+            for (int32_t v = 0; v < n; ++v) {
+                racon_host::Node& node = g.nodes[v];
+                node.code = static_cast<uint8_t>(wc[v]);
+                node.bpos = 0;
+                node.n_seqs = wn[v];
+                columns[wcol[v]].push_back(v);
+            }
+            for (int32_t v = 0; v < n; ++v) {
+                for (int32_t s = 0; s < P; ++s) {
+                    const int32_t t = wp[static_cast<int64_t>(v) * P + s];
+                    if (t < 0) {
+                        continue;
+                    }
+                    const int32_t ei = static_cast<int32_t>(g.edges.size());
+                    g.edges.push_back(racon_host::Edge{
+                        t, v, ww[static_cast<int64_t>(v) * P + s]});
+                    g.nodes[v].in.push_back(ei);
+                    g.nodes[t].out.push_back(ei);
+                }
+            }
+            for (const auto& kv : columns) {
+                for (int32_t a : kv.second) {
+                    for (int32_t b : kv.second) {
+                        if (a != b) {
+                            g.nodes[a].aligned.push_back(b);
+                        }
+                    }
+                }
+            }
+            results[w] = g.consensus(coverages[w]);
+        }
+    };
+    int32_t nt = n_threads > 1 ? n_threads : 1;
+    if (nt > n_windows) {
+        nt = static_cast<int32_t>(n_windows > 0 ? n_windows : 1);
+    }
+    if (nt <= 1) {
+        worker();
+    } else {
+        std::vector<std::thread> pool;
+        pool.reserve(nt);
+        for (int32_t i = 0; i < nt; ++i) {
+            pool.emplace_back(worker);
+        }
+        for (auto& th : pool) {
+            th.join();
+        }
+    }
+
+    int64_t total = 0;
+    for (int64_t w = 0; w < n_windows; ++w) {
+        total += static_cast<int64_t>(results[w].size());
+    }
+    if (total > cons_cap) {
+        return -total;
+    }
+    int64_t at = 0;
+    for (int64_t w = 0; w < n_windows; ++w) {
+        cons_off[w] = at;
+        std::memcpy(cons_data + at, results[w].data(), results[w].size());
+        std::memcpy(cov_data + at, coverages[w].data(),
+                    coverages[w].size() * sizeof(uint32_t));
+        at += static_cast<int64_t>(results[w].size());
+    }
+    cons_off[n_windows] = at;
+    return total;
+}
+
 }  // extern "C"

@@ -96,20 +96,32 @@ class BatchPOA:
                     bar("[racon_tpu::Polisher.polish] generating consensus")
 
     def _device_consensus(self, todo, trim):
-        """Evolving-graph device consensus over all of `todo`. The session
-        host-polishes unfit windows internally, so nothing is left over."""
+        """Device consensus over all of `todo`; unfit/failed windows are
+        host-polished internally, so nothing is left over.
+
+        RACON_TPU_ENGINE selects the device engine: "session" (default,
+        the per-layer evolving-graph engine, byte-identical to host) or
+        "fused" (experimental whole-window single-launch engine,
+        ops/poa_fused.py — the cudapoa-shaped design)."""
         import sys
 
-        from .poa_graph import DeviceGraphPOA
+        if os.environ.get("RACON_TPU_ENGINE", "session") == "fused":
+            from .poa_fused import FusedPOA
 
-        engine = DeviceGraphPOA(self.match, self.mismatch, self.gap,
-                                num_threads=self.num_threads,
-                                logger=self.logger,
-                                banded_only=self.banded_only)
+            engine = FusedPOA(self.match, self.mismatch, self.gap,
+                              num_threads=self.num_threads,
+                              logger=self.logger)
+        else:
+            from .poa_graph import DeviceGraphPOA
+
+            engine = DeviceGraphPOA(self.match, self.mismatch, self.gap,
+                                    num_threads=self.num_threads,
+                                    logger=self.logger,
+                                    banded_only=self.banded_only)
         results, statuses = engine.consensus([_pack(w) for w in todo])
         for w, (cons, cov) in zip(todo, results):
             w.apply_trim(cons, cov, trim)
-        stats = getattr(engine, "last_stats", {})
+        stats = getattr(engine, "last_stats", None) or {}
         if stats:
             print(f"[racon_tpu::BatchPOA] device layer alignments: "
                   f"{stats['committed']} committed, {stats['redos']} "

@@ -1,0 +1,551 @@
+"""Single-launch whole-window POA on device (experimental engine).
+
+The cudapoa-shaped design (reference src/cuda/cudabatch.cpp:77-270: add
+windows until the batch is full, then ONE generate_poa() builds every
+window's whole graph on device) rebuilt TPU-first. Where the session
+engine (ops/poa_graph.py) round-trips host<->device once per layer wave,
+this engine runs ALL layers of a window batch in a single jitted call —
+the POA graph itself lives in fixed-shape device arrays and is mutated by
+vectorized scatters:
+
+  - the graph's topological order is maintained WITHOUT graph traversal:
+    every aligned column owns a 64-bit ORDER KEY; node order is
+    `argsort(column key, node id)` — one vectorized sort per layer instead
+    of a sequential topo walk. Insertion columns get keys strictly between
+    their path neighbours' keys (run-partitioned equal spacing), with the
+    low 8 bits salted by layer index so keys are globally unique (equal
+    keys would let node-id tie-breaking reorder columns under later
+    in-column allocations);
+  - per layer: graph-NW DP + traceback on device (the same formulation as
+    ops/poa_graph.graph_aligner, full DP), then a fully VECTORIZED ingest
+    — target resolution (same base -> existing node, mismatch -> aligned
+    alternate or new node in column, insertion -> new node + new column),
+    prefix-sum node allocation, and conflict-free scatter wiring of edges,
+    edge weights (w[i-1] + w[i], the endpoint-sum convention of
+    native/src/poa.cpp add_alignment), sequence counts and out-degrees.
+    No sequential walk anywhere in the ingest;
+  - windows that exceed any envelope (nodes, columns, in-degree P, key
+    spacing) raise a per-window `failed` flag and fall back to the host
+    engine — the per-window GPU->CPU fallback discipline
+    (cudapolisher.cpp:354-383);
+  - consensus runs on host from the fetched arrays via the SAME C++
+    heaviest-bundle the host engine uses (native rh_poa_finish_arrays), so
+    clean windows reproduce the host engine's consensus byte-for-byte in
+    practice (tests assert it on synthetic data; the engine still pins its
+    own fixture values, the reference's GPU discipline,
+    racon_test.cpp:292-496).
+
+Eligibility: windows whose layers all SPAN the window (begin within 1% of
+0, end within 1% of backbone length — reference window.cpp:87-103's
+full-graph case). Non-spanning layers need subgraph alignment, which the
+session engine handles; the polisher routes windows accordingly when this
+engine is selected (RACON_TPU_ENGINE=fused).
+
+Depth is bucketed ((8, 16, 32, 64) layers per call) and deeper windows
+CHAIN calls: the state arrays stream out of one call and into the next
+with a layer-index base, so arbitrary depth costs no extra host work
+beyond the fetch/feed of the fixed-size state.
+
+Requires jax x64 (the order keys are int64); enabled at kernel build.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+from ..utils.logger import Logger
+
+#: engine envelope: max nodes / columns per window graph, max layer len,
+#: max in-degree (same node budget as the session engine, measured on the
+#: lambda sample in round 4: graphs reach ~2000 nodes at depth 38)
+MAX_NODES = 2048
+MAX_LEN = 640
+MAX_PRED = 8
+
+#: layers per call; deeper windows chain calls with carried state
+DEPTH_BUCKETS = (8, 16, 32, 64)
+
+_NEG = -(1 << 29)
+
+
+@functools.lru_cache(maxsize=None)
+def fused_builder(n_nodes: int, seq_len: int, depth: int, max_pred: int,
+                  match: int, mismatch: int, gap: int):
+    """Jitted whole-window POA builder for one (N, L, D, P) shape.
+
+    State arrays (leading dim B): codes [B,N] i8 (-1 free), preds [B,N,P]
+    i16 node ids (-1 empty), predw [B,N,P] i32, nseq [B,N] i32, outdeg
+    [B,N] i16, col_of [B,N] i16, colkey [B,N] i64, colnodes [B,N,5] i16,
+    n_nodes/n_cols [B] i32. Layer inputs: seqs [B,D,L] i8 (pad 5), lens
+    [B,D] i32 (0 = no layer), wts [B,D,L] i32, lbase scalar i32.
+    Returns the updated state + failed [B] bool.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    jax.config.update("jax_enable_x64", True)
+
+    N, L, D, P = n_nodes, seq_len, depth, max_pred
+    C = N  # column capacity
+    NEG = jnp.int32(_NEG)
+    MAXKEY = jnp.int64(1) << 44  # composite (key << 11 | id) must fit i64
+
+    def dp_align(codes_r, preds_r, sinks_r, seq, slen, B):
+        jidx = jnp.arange(L + 1, dtype=jnp.int32)
+        h0 = jnp.where(jidx[None, :] <= slen[:, None], jidx[None, :] * gap,
+                       NEG).astype(jnp.int32)
+        H = jnp.full((B, N + 1, L + 1), NEG, dtype=jnp.int32)
+        H = H.at[:, 0, :].set(h0)
+
+        def step(H, xs):
+            code_k, preds_k, k = xs
+            pk = jnp.clip(preds_k, 0, N)
+            rows = jnp.take_along_axis(H, pk[:, :, None], axis=1)
+            rows = jnp.where((preds_k >= 0)[:, :, None], rows, NEG)
+            sub = jnp.where(seq == code_k[:, None], match,
+                            mismatch).astype(jnp.int32)
+            diag = rows[:, :, :-1] + sub[:, None, :]
+            vert = rows[:, :, 1:] + gap
+            best = jnp.max(jnp.maximum(diag, vert), axis=1)
+            row0 = jnp.max(rows[:, :, 0], axis=1) + gap
+            inb = (jidx[None, 1:] >= 1) & (jidx[None, 1:] <= slen[:, None])
+            pre = jnp.where(inb, best, NEG)
+            cat = jnp.concatenate([row0[:, None], pre], axis=1)
+            run = jax.lax.cummax(cat - jidx * gap, axis=1) + jidx * gap
+            hrow = jnp.where(inb, run[:, 1:], pre)
+            new_row = jnp.concatenate([row0[:, None], hrow], axis=1)
+
+            nr = new_row[:, 1:]
+            is_diag = nr[:, None, :] == diag
+            is_vert = nr[:, None, :] == vert
+            pd = jnp.argmax(is_diag, axis=1).astype(jnp.int32)
+            pv = jnp.argmax(is_vert, axis=1).astype(jnp.int32)
+            bpc = jnp.where(jnp.any(is_diag, axis=1), pd,
+                            jnp.where(jnp.any(is_vert, axis=1), P + pv,
+                                      2 * P))
+            is_v0 = row0[:, None] == rows[:, :, 0] + gap
+            bp0 = P + jnp.argmax(is_v0, axis=1).astype(jnp.int32)
+            bp_row = jnp.concatenate([bp0[:, None], bpc],
+                                     axis=1).astype(jnp.int8)
+            H = jax.lax.dynamic_update_slice(
+                H, new_row[:, None, :], (jnp.int32(0), k, jnp.int32(0)))
+            return H, bp_row
+
+        ks = jnp.arange(1, N + 1, dtype=jnp.int32)
+        unroll = 1 if jax.default_backend() == "cpu" else 4
+        H, bps = jax.lax.scan(step, H,
+                              (codes_r.T, preds_r.transpose(1, 0, 2), ks),
+                              unroll=unroll)
+
+        flat_h = H.reshape(B, (N + 1) * (L + 1))
+        ridx = (jnp.arange(1, N + 1, dtype=jnp.int32)[None, :] * (L + 1)
+                + slen[:, None])
+        scores = jnp.take_along_axis(flat_h, ridx, axis=1)
+        cand = jnp.where(sinks_r, scores, NEG)
+        best_rank = jnp.argmax(cand, axis=1).astype(jnp.int32)
+
+        bp_flat = bps.transpose(1, 0, 2).reshape(B, N * (L + 1))
+        preds_flat = preds_r.reshape(B, N * P)
+        rows_b = jnp.arange(B)
+
+        def cond(st):
+            r, j, _ = st
+            return jnp.any((r > 0) | (j > 0))
+
+        def body(st):
+            r, j, out = st
+            active = (r > 0) | (j > 0)
+            lin = (jnp.clip(r - 1, 0, N - 1) * (L + 1) + jnp.clip(j, 0, L))
+            code = jnp.take_along_axis(
+                bp_flat, lin[:, None], axis=1)[:, 0].astype(jnp.int32)
+            code = jnp.where(r > 0, code, 2 * P)
+            is_diag = code < P
+            is_vert = (code >= P) & (code < 2 * P)
+            p = jnp.where(is_diag, code, code - P)
+            plin = (jnp.clip(r - 1, 0, N - 1) * P + jnp.clip(p, 0, P - 1))
+            pr = jnp.take_along_axis(preds_flat, plin[:, None],
+                                     axis=1)[:, 0]
+            consume = active & ~is_vert
+            jc = jnp.clip(j - 1, 0, L - 1)
+            cur = jnp.take_along_axis(out, jc[:, None], axis=1)[:, 0]
+            emit = jnp.where(is_diag, r - 1, -1)
+            out = out.at[rows_b, jc].set(jnp.where(consume, emit, cur))
+            r = jnp.where(active & (is_diag | is_vert), pr, r)
+            j = jnp.where(consume, j - 1, j)
+            return r, j, out
+
+        out0 = jnp.full((B, L), -2, dtype=jnp.int32)
+        _, _, ranks = jax.lax.while_loop(cond, body,
+                                         (best_rank + 1, slen, out0))
+        return ranks
+
+    def fwd(a, b):
+        return jnp.where(b[1], b[0], a[0]), (a[1] | b[1])
+
+    def bwd_seg(a, b):
+        return (jnp.where(b[1], b[0], jnp.maximum(a[0], b[0])),
+                (a[1] | b[1]))
+
+    def one_layer(state, layer):
+        (codes, preds, predw, nseq, outdeg, col_of, colkey, colnodes,
+         n_nodes, n_cols, failed) = state
+        seq, slen, wts, lidx = layer
+        B = codes.shape[0]
+        rows_b = jnp.arange(B)
+        active = (slen > 0) & ~failed
+
+        # topo order from column keys (argsort; node-id tiebreak)
+        alloc = codes >= 0
+        nkey = jnp.where(
+            alloc,
+            (jnp.take_along_axis(
+                colkey, jnp.clip(col_of, 0, C - 1).astype(jnp.int32),
+                axis=1) << 11) | jnp.arange(N, dtype=jnp.int64)[None, :],
+            jnp.int64(1) << 62)
+        order = jnp.argsort(nkey, axis=1).astype(jnp.int32)
+        rank_of = jnp.zeros((B, N), dtype=jnp.int32)
+        rank_of = rank_of.at[rows_b[:, None], order].set(
+            jnp.arange(N, dtype=jnp.int32)[None, :])
+
+        codes_r = jnp.take_along_axis(codes, order, axis=1)
+        codes_r = jnp.where(codes_r >= 0, codes_r, 5).astype(jnp.int8)
+        pr_nodes = jnp.take_along_axis(preds, order[:, :, None], axis=1)
+        pr_rank = jnp.where(
+            pr_nodes >= 0,
+            jnp.take_along_axis(
+                rank_of, jnp.clip(pr_nodes, 0, N - 1).reshape(B, -1),
+                axis=1).reshape(B, N, P) + 1,
+            -1).astype(jnp.int32)
+        no_pred = (pr_nodes < 0).all(axis=2)
+        pr_rank = pr_rank.at[:, :, 0].set(
+            jnp.where(no_pred, 0, pr_rank[:, :, 0]))
+        alloc_r = jnp.take_along_axis(alloc, order, axis=1)
+        outdeg_r = jnp.take_along_axis(outdeg, order, axis=1)
+        sinks_r = alloc_r & (outdeg_r == 0)
+
+        ranks = dp_align(codes_r, pr_rank, sinks_r, seq, slen, B)
+
+        # ---- vectorized ingest
+        iidx = jnp.arange(L, dtype=jnp.int32)
+        inlen = (iidx[None, :] < slen[:, None]) & active[:, None]
+        base = seq.astype(jnp.int32)
+        aligned = (ranks >= 0) & inlen
+        node_at = jnp.where(
+            aligned,
+            jnp.take_along_axis(order, jnp.clip(ranks, 0, N - 1), axis=1),
+            -1)
+        col0 = jnp.where(
+            aligned,
+            jnp.take_along_axis(col_of, jnp.clip(node_at, 0, N - 1),
+                                axis=1).astype(jnp.int32),
+            -1)
+        same = aligned & (jnp.take_along_axis(
+            codes, jnp.clip(node_at, 0, N - 1), axis=1) == base)
+        alt = jnp.where(
+            aligned,
+            colnodes.reshape(B, -1)[
+                rows_b[:, None],
+                jnp.clip(col0, 0, C - 1) * 5 + jnp.clip(base, 0, 4)],
+            -1).astype(jnp.int32)
+        use_alt = aligned & ~same & (alt >= 0)
+        new_in_col = aligned & ~same & (alt < 0)
+        insertion = inlen & ~aligned
+
+        # per-run anchor keys: prev (forward) / next (backward)
+        akey = jnp.where(
+            aligned,
+            jnp.take_along_axis(
+                colkey, jnp.clip(col0, 0, C - 1).astype(jnp.int32),
+                axis=1),
+            0)
+        pkey = jax.lax.associative_scan(fwd, (akey, aligned), axis=1)[0]
+        pkey_prev = jnp.concatenate(
+            [jnp.zeros((B, 1), jnp.int64), pkey[:, :-1]], axis=1)
+        nk = jax.lax.associative_scan(
+            fwd, (jnp.flip(akey, 1), jnp.flip(aligned, 1)), axis=1)[0]
+        nkey_next = jnp.flip(nk, 1)
+        nkey_next = jnp.where(
+            jnp.flip(jax.lax.associative_scan(
+                jnp.logical_or, jnp.flip(aligned, 1), axis=1), 1),
+            nkey_next, MAXKEY)
+
+        # position within insertion run and run length
+        ins_i = jnp.cumsum(insertion.astype(jnp.int32), axis=1)
+        run_start_ins = jax.lax.associative_scan(
+            fwd, (ins_i.astype(jnp.int64), aligned), axis=1)[0]
+        run_start_ins = jnp.concatenate(
+            [jnp.zeros((B, 1), jnp.int64), run_start_ins[:, :-1]],
+            axis=1).astype(jnp.int32)
+        jrun = jnp.where(insertion, ins_i - run_start_ins, 0)
+        mrev = jax.lax.associative_scan(
+            bwd_seg, (jnp.flip(jnp.where(insertion, jrun, 0), 1),
+                      jnp.flip(aligned, 1)), axis=1)[0]
+        mrun = jnp.flip(mrev, 1)
+
+        # insertion column keys: run-partitioned equal spacing, low 8 bits
+        # replaced with the layer salt for global uniqueness
+        span = nkey_next - pkey_prev
+        spacing = span // (mrun.astype(jnp.int64) + 1)
+        grid = pkey_prev + span * jrun.astype(jnp.int64) // (
+            mrun.astype(jnp.int64) + 1)
+        salt = (lidx.astype(jnp.int64) + 1) & 0xFF
+        ikey = (grid & ~jnp.int64(0xFF)) | salt
+        key_bad = insertion & ((spacing <= 512) |
+                               (ikey <= pkey_prev) | (ikey >= nkey_next))
+
+        new_node = new_in_col | insertion
+        nid = (n_nodes[:, None] +
+               jnp.cumsum(new_node.astype(jnp.int32), axis=1) - 1)
+        cid = (n_cols[:, None] +
+               jnp.cumsum(insertion.astype(jnp.int32), axis=1) - 1)
+        overflow = (new_node & (nid >= N)) | (insertion & (cid >= C))
+        layer_fail = key_bad.any(axis=1) | overflow.any(axis=1)
+        ok = active & ~layer_fail
+        okm = ok[:, None]
+
+        target = jnp.where(same, node_at,
+                           jnp.where(use_alt, alt,
+                                     jnp.where(new_node, nid, -1)))
+        tcol = jnp.where(insertion, cid, col0)
+
+        sn = jnp.where(new_node & okm, nid, N + 1)
+        codes = codes.at[rows_b[:, None], sn].set(
+            base.astype(jnp.int8), mode="drop")
+        col_of = col_of.at[rows_b[:, None], sn].set(
+            tcol.astype(col_of.dtype), mode="drop")
+        sc = jnp.where(insertion & okm, cid, C + 1)
+        colkey = colkey.at[rows_b[:, None], sc].set(ikey, mode="drop")
+        flat_cn = colnodes.reshape(B, C * 5)
+        cnpos = jnp.where(new_node & okm,
+                          jnp.clip(tcol, 0, C - 1) * 5 + base, C * 5 + 1)
+        flat_cn = flat_cn.at[rows_b[:, None], cnpos].set(
+            nid.astype(colnodes.dtype), mode="drop")
+        colnodes = flat_cn.reshape(B, C, 5)
+
+        st = jnp.where((inlen & (target >= 0)) & okm, target, N + 1)
+        nseq = nseq.at[rows_b[:, None], st].add(1, mode="drop")
+
+        # edges between consecutive path positions
+        tails = target[:, :-1]
+        heads = target[:, 1:]
+        epresent = inlen[:, 1:] & inlen[:, :-1] & okm
+        ew = (wts[:, :-1] + wts[:, 1:]).astype(jnp.int32)
+        hclip = jnp.clip(heads, 0, N - 1)
+        hpred = jnp.take_along_axis(preds, hclip[:, :, None], axis=1)
+        match_slot = (hpred == tails[:, :, None]) & (tails[:, :, None] >= 0)
+        empty_slot = hpred < 0
+        has_match = match_slot.any(axis=2)
+        slot = jnp.where(has_match, jnp.argmax(match_slot, axis=2),
+                         jnp.argmax(empty_slot, axis=2))
+        slot_ok = has_match | empty_slot.any(axis=2)
+        edge_fail = (epresent & ~slot_ok).any(axis=1)
+        failed = failed | (active & (layer_fail | edge_fail))
+        eok = epresent & slot_ok & (~edge_fail)[:, None]
+
+        flat_p = preds.reshape(B, N * P)
+        flat_w = predw.reshape(B, N * P)
+        ppos = jnp.where(eok, hclip * P + slot, N * P + 1)
+        flat_p = flat_p.at[rows_b[:, None], ppos].set(
+            tails.astype(preds.dtype), mode="drop")
+        flat_w = flat_w.at[rows_b[:, None], ppos].add(ew, mode="drop")
+        preds = flat_p.reshape(B, N, P)
+        predw = flat_w.reshape(B, N, P)
+        tpos = jnp.where(eok & ~has_match,
+                         jnp.clip(tails, 0, N - 1), N + 1)
+        outdeg = outdeg.at[rows_b[:, None], tpos].add(1, mode="drop")
+
+        n_nodes = jnp.where(
+            ok, n_nodes + new_node.sum(axis=1, dtype=jnp.int32), n_nodes)
+        n_cols = jnp.where(
+            ok, n_cols + insertion.sum(axis=1, dtype=jnp.int32), n_cols)
+        return ((codes, preds, predw, nseq, outdeg, col_of, colkey,
+                 colnodes, n_nodes, n_cols, failed), None)
+
+    def run(codes, preds, predw, nseq, outdeg, col_of, colkey, colnodes,
+            n_nodes, n_cols, failed, seqs, lens, wts, lbase):
+        state = (codes, preds, predw, nseq, outdeg, col_of, colkey,
+                 colnodes, n_nodes, n_cols, failed)
+        state, _ = jax.lax.scan(
+            one_layer, state,
+            (seqs.transpose(1, 0, 2), lens.T, wts.transpose(1, 0, 2),
+             lbase + jnp.arange(D, dtype=jnp.int32)))
+        return state
+
+    return jax.jit(run)
+
+
+def _weights_of(qual, length):
+    if qual:
+        w = np.frombuffer(qual, np.uint8).astype(np.int32) - 33
+        return np.clip(w, 0, None)
+    return np.ones(length, dtype=np.int32)
+
+
+class FusedPOA:
+    """Whole-window device POA engine (see module docstring).
+
+    consensus(windows) has the same contract as DeviceGraphPOA.consensus:
+    windows are lists of (seq, qual|None, begin, end) with element 0 the
+    backbone; returns (results, statuses) with statuses 0 = device-built,
+    1 = host fallback, 2 = backbone-only.
+    """
+
+    def __init__(self, match: int, mismatch: int, gap: int,
+                 num_threads: int = 1, logger: Logger | None = None,
+                 max_nodes: int = MAX_NODES, max_len: int = MAX_LEN,
+                 max_pred: int = MAX_PRED, batch_rows: int = 32,
+                 depth_buckets=DEPTH_BUCKETS):
+        self.match = match
+        self.mismatch = mismatch
+        self.gap = gap
+        self.num_threads = num_threads
+        self.logger = logger
+        self.N = max_nodes
+        self.L = max_len
+        self.P = max_pred
+        self.B = batch_rows
+        self.depth_buckets = tuple(depth_buckets)
+        self._code_of = np.full(256, 4, dtype=np.int8)
+        for i, b in enumerate(b"ACGT"):
+            self._code_of[b] = i
+
+    def _eligible(self, win) -> bool:
+        bb_len = len(win[0][0])
+        offset = int(0.01 * bb_len)
+        if bb_len + 1 > self.N:
+            return False
+        for seq, _, b, e in win[1:]:
+            if not seq or len(seq) > self.L:
+                return False
+            if not (b < offset and e > bb_len - offset):
+                return False  # non-spanning: subgraph path -> other engine
+        return True
+
+    def precompile(self) -> None:
+        for d in self.depth_buckets:
+            fn = fused_builder(self.N, self.L, d, self.P, self.match,
+                               self.mismatch, self.gap)
+            state = self._init_state([b"AC"], [np.ones(2, np.int32)])
+            seqs = np.full((self.B, d, self.L), 5, np.int8)
+            lens = np.zeros((self.B, d), np.int32)
+            wts = np.zeros((self.B, d, self.L), np.int32)
+            out = fn(*state, seqs, lens, wts, 0)
+            np.asarray(out[0])  # block
+
+    def _init_state(self, backbones, bweights):
+        B, N, P, C = self.B, self.N, self.P, self.N
+        codes = np.full((B, N), -1, dtype=np.int8)
+        preds = np.full((B, N, P), -1, dtype=np.int16)
+        predw = np.zeros((B, N, P), dtype=np.int32)
+        nseq = np.zeros((B, N), dtype=np.int32)
+        outdeg = np.zeros((B, N), dtype=np.int16)
+        col_of = np.full((B, N), -1, dtype=np.int16)
+        colkey = np.zeros((B, C), dtype=np.int64)
+        colnodes = np.full((B, C, 5), -1, dtype=np.int16)
+        n_nodes = np.zeros(B, dtype=np.int32)
+        n_cols = np.zeros(B, dtype=np.int32)
+        failed = np.zeros(B, dtype=bool)
+        for k, (bb, w) in enumerate(zip(backbones, bweights)):
+            m = len(bb)
+            codes[k, :m] = self._code_of[np.frombuffer(bb, np.uint8)]
+            col_of[k, :m] = np.arange(m)
+            colkey[k, :m] = (np.arange(m, dtype=np.int64) + 1) << 32
+            colnodes[k, np.arange(m), codes[k, :m]] = np.arange(m)
+            preds[k, 1:m, 0] = np.arange(m - 1)
+            predw[k, 1:m, 0] = w[:-1] + w[1:]
+            outdeg[k, :m - 1] = 1
+            nseq[k, :m] = 1
+            n_nodes[k] = m
+            n_cols[k] = m
+        return (codes, preds, predw, nseq, outdeg, col_of, colkey,
+                colnodes, n_nodes, n_cols, failed)
+
+    def consensus(self, windows):
+        from ..native import poa_batch, poa_finish_arrays
+
+        n = len(windows)
+        results: list = [None] * n
+        statuses = np.ones(n, dtype=np.int32)
+        fused_idx = []
+        for i, w in enumerate(windows):
+            if len(w) < 3:
+                statuses[i] = 2
+                results[i] = (w[0][0], np.zeros(len(w[0][0]), np.uint32))
+            elif self._eligible(w):
+                fused_idx.append(i)
+
+        bar = self.logger.bar if self.logger is not None else None
+        if self.logger is not None and fused_idx:
+            self.logger.bar_total(len(fused_idx))
+
+        for s in range(0, len(fused_idx), self.B):
+            chunk = fused_idx[s:s + self.B]
+            self._run_chunk(windows, chunk, results, statuses)
+            if bar is not None:
+                for _ in chunk:
+                    bar("[racon_tpu::Polisher.polish] "
+                        "building whole-window POA graphs on device")
+
+        # host engine for everything left (ineligible or device-failed)
+        rest = [i for i in range(n) if results[i] is None]
+        if rest:
+            host = poa_batch([windows[i] for i in rest], self.match,
+                             self.mismatch, self.gap,
+                             n_threads=self.num_threads)
+            for i, r in zip(rest, host):
+                results[i] = r
+                statuses[i] = 1
+        self.n_fallback = len(rest)
+        return results, statuses
+
+    def _run_chunk(self, windows, chunk, results, statuses):
+        from ..native import poa_finish_arrays
+
+        backbones = [windows[i][0][0] for i in chunk]
+        bweights = [_weights_of(windows[i][0][1], len(windows[i][0][0]))
+                    for i in chunk]
+        state = self._init_state(backbones, bweights)
+        depth = max(len(windows[i]) - 1 for i in chunk)
+        done = 0
+        while done < depth:
+            # greedy chaining: largest bucket that fits the remaining
+            # depth (padded layers still pay a full DP scan), else the
+            # smallest bucket that covers the tail
+            rem = depth - done
+            fits = [b for b in self.depth_buckets if b <= rem]
+            d = max(fits) if fits else min(
+                b for b in self.depth_buckets if b >= rem)
+            seqs = np.full((self.B, d, self.L), 5, np.int8)
+            lens = np.zeros((self.B, d), np.int32)
+            wts = np.zeros((self.B, d, self.L), np.int32)
+            for k, i in enumerate(chunk):
+                layers = windows[i][1:]
+                for dd in range(d):
+                    li = done + dd
+                    if li >= len(layers):
+                        break
+                    seq, qual, _, _ = layers[li]
+                    seqs[k, dd, :len(seq)] = self._code_of[
+                        np.frombuffer(seq, np.uint8)]
+                    lens[k, dd] = len(seq)
+                    wts[k, dd, :len(seq)] = _weights_of(qual, len(seq))
+            fn = fused_builder(self.N, self.L, d, self.P, self.match,
+                               self.mismatch, self.gap)
+            state = [np.asarray(x) for x in fn(*state, seqs, lens, wts,
+                                               done)]
+            done += d
+
+        (codes, preds, predw, nseq, outdeg, col_of, colkey, colnodes,
+         n_nodes, n_cols, failed) = state
+        okrows = [k for k in range(len(chunk)) if not failed[k]]
+        if okrows:
+            sel = np.asarray(okrows)
+            fin = poa_finish_arrays(
+                codes[sel], preds[sel], predw[sel], nseq[sel],
+                col_of[sel], colkey[sel], n_nodes[sel],
+                n_threads=self.num_threads)
+            for k, r in zip(okrows, fin):
+                results[chunk[k]] = r
+                statuses[chunk[k]] = 0

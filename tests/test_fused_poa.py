@@ -1,0 +1,113 @@
+"""Fused whole-window device POA engine tests (ops/poa_fused.py).
+
+The engine builds complete POA graphs on device in ONE call per window
+batch (the cudapoa single-launch shape, reference cudabatch.cpp:77-270).
+The correctness bar mirrors the session engine's: consensus byte-identical
+to the host engine on clean data (asserted here), per-window host fallback
+for anything outside the envelope.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from test_device_poa import _make_windows, _pack, mutate  # noqa: E402
+
+from racon_tpu.native import poa_batch  # noqa: E402
+from racon_tpu.ops.poa_fused import FusedPOA  # noqa: E402
+
+ACGT = b"ACGT"
+
+
+def _assert_identical(res, host, statuses, where=""):
+    for i, ((dc, dcov), (hc, hcov)) in enumerate(zip(res, host)):
+        assert dc == hc, f"{where} window {i} consensus diverged " \
+                         f"(status {int(statuses[i])})"
+        np.testing.assert_array_equal(dcov, hcov, err_msg=f"window {i}")
+
+
+def test_fused_byte_identical_to_host():
+    """Spanning TGS-style windows, incl. a rotated adversarial layer: the
+    fused engine's consensus must equal the host engine's byte-for-byte."""
+    rng = random.Random(5)
+    windows, _ = _make_windows(rng, 10, length=220, depth=7, rate=0.12)
+    bb = windows[0].sequences[0]
+    windows[0].add_layer(bb[110:] + bb[:110], None, 0, len(bb) - 1)
+    packed = [_pack(w) for w in windows]
+
+    eng = FusedPOA(3, -5, -4, num_threads=2, max_nodes=768, max_len=384,
+                   batch_rows=8, depth_buckets=(4, 8))
+    res, statuses = eng.consensus(packed)
+    host = poa_batch(packed, 3, -5, -4, n_threads=2)
+
+    assert (statuses == 0).all(), statuses.tolist()
+    assert eng.n_fallback == 0
+    _assert_identical(res, host, statuses)
+
+
+def test_fused_deep_windows_chain_calls():
+    """Depth beyond the largest bucket chains device calls (state streams
+    out of one call into the next); output must still match the host."""
+    rng = random.Random(9)
+    windows, _ = _make_windows(rng, 4, length=220, depth=11, rate=0.1)
+    packed = [_pack(w) for w in windows]
+
+    eng = FusedPOA(3, -5, -4, max_nodes=768, max_len=384, batch_rows=4,
+                   depth_buckets=(4,))  # 11 layers -> 3 chained calls
+    res, statuses = eng.consensus(packed)
+    host = poa_batch(packed, 3, -5, -4)
+
+    assert (statuses == 0).all(), statuses.tolist()
+    _assert_identical(res, host, statuses, "chained")
+
+
+def test_fused_failed_and_ineligible_fall_back_to_host():
+    """Envelope violations (node overflow) and ineligible windows
+    (non-spanning layers) must host-fallback per window — and the final
+    output is still identical to the host engine for every window."""
+    rng = random.Random(6)
+    windows, _ = _make_windows(rng, 3, length=220, depth=5, rate=0.1)
+    # non-spanning layers -> ineligible
+    sub, _ = _make_windows(rng, 2, length=220, depth=4, spanning=False)
+    windows += sub
+    packed = [_pack(w) for w in windows]
+
+    eng = FusedPOA(3, -5, -4, max_nodes=230, max_len=384, batch_rows=4,
+                   depth_buckets=(8,))  # 230 nodes: graphs overflow fast
+    res, statuses = eng.consensus(packed)
+    host = poa_batch(packed, 3, -5, -4)
+
+    assert (statuses[3:] == 1).all(), statuses.tolist()  # ineligible
+    assert eng.n_fallback >= 2
+    _assert_identical(res, host, statuses, "fallback")
+
+
+def test_fused_backbone_only_windows():
+    rng = random.Random(7)
+    windows, _ = _make_windows(rng, 1, length=220, depth=4)
+    packed = [_pack(windows[0]), [(b"ACGTACGT" * 30, None, 0, 239)]]
+    eng = FusedPOA(3, -5, -4, max_nodes=768, max_len=384, batch_rows=4,
+                   depth_buckets=(4,))
+    res, statuses = eng.consensus(packed)
+    assert statuses[1] == 2
+    assert res[1][0] == packed[1][0][0]
+
+
+def test_fused_through_batchpoa_env(monkeypatch):
+    """RACON_TPU_ENGINE=fused routes BatchPOA's device path through the
+    fused engine end-to-end."""
+    from racon_tpu.native import edit_distance
+    from racon_tpu.ops.poa import BatchPOA
+
+    monkeypatch.setenv("RACON_TPU_ENGINE", "fused")
+    rng = random.Random(8)
+    windows, truths = _make_windows(rng, 4, length=220, depth=6, rate=0.1)
+    engine = BatchPOA(3, -5, -4, 220, device_batches=1)
+    engine.generate_consensus(windows, trim=False)
+    for w, truth in zip(windows, truths):
+        assert w.polished
+        assert edit_distance(w.consensus, truth) <= \
+            edit_distance(w.sequences[0], truth)
