@@ -105,20 +105,43 @@ class BatchPOA:
         ops/poa_fused.py — the cudapoa-shaped design)."""
         import sys
 
+        from .poa_graph import DeviceGraphPOA
+
+        packed = [_pack(w) for w in todo]
         if os.environ.get("RACON_TPU_ENGINE", "session") == "fused":
             from .poa_fused import FusedPOA
 
-            engine = FusedPOA(self.match, self.mismatch, self.gap,
-                              num_threads=self.num_threads,
-                              logger=self.logger)
+            fused = FusedPOA(self.match, self.mismatch, self.gap,
+                             num_threads=self.num_threads,
+                             logger=self.logger)
+            results, statuses = fused.consensus(packed, fallback=False)
+            # windows the fused engine cannot take (non-spanning layers
+            # need subgraph alignment, or the graph overflowed its
+            # envelope) run on the per-layer session engine — the whole
+            # batch stays on device
+            rest = [i for i, r in enumerate(results) if r is None]
+            print(f"[racon_tpu::BatchPOA] fused engine built "
+                  f"{int((statuses == 0).sum())} windows; "
+                  f"{len(rest)} to session engine", file=sys.stderr)
+            if rest:
+                engine = DeviceGraphPOA(self.match, self.mismatch,
+                                        self.gap,
+                                        num_threads=self.num_threads,
+                                        logger=self.logger,
+                                        banded_only=self.banded_only)
+                sub_res, sub_st = engine.consensus(
+                    [packed[i] for i in rest])
+                for i, r, st in zip(rest, sub_res, sub_st):
+                    results[i] = r
+                    statuses[i] = st
+            else:
+                engine = fused
         else:
-            from .poa_graph import DeviceGraphPOA
-
             engine = DeviceGraphPOA(self.match, self.mismatch, self.gap,
                                     num_threads=self.num_threads,
                                     logger=self.logger,
                                     banded_only=self.banded_only)
-        results, statuses = engine.consensus([_pack(w) for w in todo])
+            results, statuses = engine.consensus(packed)
         for w, (cons, cov) in zip(todo, results):
             w.apply_trim(cons, cov, trim)
         stats = getattr(engine, "last_stats", None) or {}

@@ -462,8 +462,11 @@ class FusedPOA:
         return (codes, preds, predw, nseq, outdeg, col_of, colkey,
                 colnodes, n_nodes, n_cols, failed)
 
-    def consensus(self, windows):
-        from ..native import poa_batch, poa_finish_arrays
+    def consensus(self, windows, fallback: bool = True):
+        """fallback=False leaves ineligible/failed windows as (None,
+        status 1) for the caller to polish (e.g. with the session engine,
+        which handles non-spanning layers via subgraphs)."""
+        from ..native import poa_batch
 
         n = len(windows)
         results: list = [None] * n
@@ -488,16 +491,16 @@ class FusedPOA:
                     bar("[racon_tpu::Polisher.polish] "
                         "building whole-window POA graphs on device")
 
-        # host engine for everything left (ineligible or device-failed)
+        # everything left is ineligible or device-failed
         rest = [i for i in range(n) if results[i] is None]
-        if rest:
+        self.n_fallback = len(rest)
+        if rest and fallback:
             host = poa_batch([windows[i] for i in rest], self.match,
                              self.mismatch, self.gap,
                              n_threads=self.num_threads)
             for i, r in zip(rest, host):
                 results[i] = r
                 statuses[i] = 1
-        self.n_fallback = len(rest)
         return results, statuses
 
     def _run_chunk(self, windows, chunk, results, statuses):
