@@ -29,17 +29,25 @@ vectorized scatters:
     engine — the per-window GPU->CPU fallback discipline
     (cudapolisher.cpp:354-383);
   - consensus runs on host from the fetched arrays via the SAME C++
-    heaviest-bundle the host engine uses (native rh_poa_finish_arrays), so
-    clean windows reproduce the host engine's consensus byte-for-byte in
-    practice (tests assert it on synthetic data; the engine still pins its
-    own fixture values, the reference's GPU discipline,
-    racon_test.cpp:292-496).
+    heaviest-bundle the host engine uses (native rh_poa_finish_arrays).
 
-Eligibility: windows whose layers all SPAN the window (begin within 1% of
-0, end within 1% of backbone length — reference window.cpp:87-103's
-full-graph case). Non-spanning layers need subgraph alignment, which the
-session engine handles; the polisher routes windows accordingly when this
-engine is selected (RACON_TPU_ENGINE=fused).
+Accuracy contract (the reference's GPU discipline — numeric divergence
+between backends accepted and pinned separately, racon_test.cpp:292-496):
+spanning-layer windows reproduce the host engine byte-for-byte in
+practice (this engine uses full DP where the host bands, and its global
+column-key rank order differs from per-subgraph Kahn order, so
+non-spanning/banded cases can drift by a few edits). On the lambda sample
+the full fused pipeline measures 1356 vs the host engine's 1352 — inside
+the reference's own CPU/GPU spread (1312/1385).
+
+Non-spanning layers (reference window.cpp:87-103's subgraph case) are
+handled by MASKING, not extraction: every node carries its backbone
+position (`bpos`, inherited exactly like the host engine's), and a layer
+with range [begin, end] aligns against only the in-range nodes — preds
+filtered to in-range (a node with no in-range pred becomes a subgraph
+source), sinks recomputed as in-range nodes without in-range successors.
+This reproduces the host's bpos-range-induced subgraph
+(native/src/poa.cpp Graph::subgraph) without materializing it.
 
 Depth is bucketed ((8, 16, 32, 64) layers per call) and deeper windows
 CHAIN calls: the state arrays stream out of one call and into the next
@@ -78,9 +86,10 @@ def fused_builder(n_nodes: int, seq_len: int, depth: int, max_pred: int,
     State arrays (leading dim B): codes [B,N] i8 (-1 free), preds [B,N,P]
     i16 node ids (-1 empty), predw [B,N,P] i32, nseq [B,N] i32, outdeg
     [B,N] i16, col_of [B,N] i16, colkey [B,N] i64, colnodes [B,N,5] i16,
-    n_nodes/n_cols [B] i32. Layer inputs: seqs [B,D,L] i8 (pad 5), lens
-    [B,D] i32 (0 = no layer), wts [B,D,L] i32, lbase scalar i32.
-    Returns the updated state + failed [B] bool.
+    bpos [B,N] i16, n_nodes/n_cols [B] i32. Layer inputs: seqs [B,D,L] i8
+    (pad 5), lens [B,D] i32 (0 = no layer), wts [B,D,L] i32, rlo/rhi
+    [B,D] i16 (the layer's bpos range; -32768/32767 = spanning, full
+    graph), lbase scalar i32. Returns the updated state + failed [B] bool.
     """
     import jax
     import jax.numpy as jnp
@@ -190,8 +199,8 @@ def fused_builder(n_nodes: int, seq_len: int, depth: int, max_pred: int,
 
     def one_layer(state, layer):
         (codes, preds, predw, nseq, outdeg, col_of, colkey, colnodes,
-         n_nodes, n_cols, failed) = state
-        seq, slen, wts, lidx = layer
+         bpos, n_nodes, n_cols, failed) = state
+        seq, slen, wts, rlo, rhi, lidx = layer
         B = codes.shape[0]
         rows_b = jnp.arange(B)
         active = (slen > 0) & ~failed
@@ -209,21 +218,36 @@ def fused_builder(n_nodes: int, seq_len: int, depth: int, max_pred: int,
         rank_of = rank_of.at[rows_b[:, None], order].set(
             jnp.arange(N, dtype=jnp.int32)[None, :])
 
+        # the layer's bpos-range-induced subgraph, by masking (the host's
+        # Graph::subgraph semantics): out-of-range nodes become dead rows,
+        # in-range nodes keep only in-range preds (none left -> subgraph
+        # source), sinks = in-range nodes with no in-range successor
+        in_range = (alloc & (bpos >= rlo[:, None]) &
+                    (bpos <= rhi[:, None]))
+        in_range_r = jnp.take_along_axis(in_range, order, axis=1)
+
         codes_r = jnp.take_along_axis(codes, order, axis=1)
-        codes_r = jnp.where(codes_r >= 0, codes_r, 5).astype(jnp.int8)
+        codes_r = jnp.where(in_range_r, codes_r, 5).astype(jnp.int8)
         pr_nodes = jnp.take_along_axis(preds, order[:, :, None], axis=1)
+        pr_clip = jnp.clip(pr_nodes, 0, N - 1).reshape(B, -1)
+        pr_ok = (pr_nodes >= 0) & jnp.take_along_axis(
+            in_range, pr_clip, axis=1).reshape(B, N, P)
         pr_rank = jnp.where(
-            pr_nodes >= 0,
-            jnp.take_along_axis(
-                rank_of, jnp.clip(pr_nodes, 0, N - 1).reshape(B, -1),
-                axis=1).reshape(B, N, P) + 1,
+            pr_ok,
+            jnp.take_along_axis(rank_of, pr_clip,
+                                axis=1).reshape(B, N, P) + 1,
             -1).astype(jnp.int32)
-        no_pred = (pr_nodes < 0).all(axis=2)
+        no_pred = (~pr_ok).all(axis=2) & in_range_r
         pr_rank = pr_rank.at[:, :, 0].set(
             jnp.where(no_pred, 0, pr_rank[:, :, 0]))
-        alloc_r = jnp.take_along_axis(alloc, order, axis=1)
-        outdeg_r = jnp.take_along_axis(outdeg, order, axis=1)
-        sinks_r = alloc_r & (outdeg_r == 0)
+
+        has_succ = jnp.zeros((B, N + 2), dtype=bool)
+        succ_pos = jnp.where(pr_ok & in_range_r[:, :, None],
+                             pr_clip.reshape(B, N, P), N + 1)
+        has_succ = has_succ.at[
+            rows_b[:, None, None], succ_pos].set(True, mode="drop")
+        sinks_r = in_range_r & ~jnp.take_along_axis(
+            has_succ[:, :N], order, axis=1)
 
         ranks = dp_align(codes_r, pr_rank, sinks_r, seq, slen, B)
 
@@ -253,23 +277,41 @@ def fused_builder(n_nodes: int, seq_len: int, depth: int, max_pred: int,
         new_in_col = aligned & ~same & (alt < 0)
         insertion = inlen & ~aligned
 
-        # per-run anchor keys: prev (forward) / next (backward)
+        # per-run anchor keys: prev (forward) / next (backward); anchor
+        # bpos propagated the same way for insertion-node bpos inheritance
+        # (host: insertions take the previous column's bpos, leading
+        # insertions backfill from the next aligned column)
         akey = jnp.where(
             aligned,
             jnp.take_along_axis(
                 colkey, jnp.clip(col0, 0, C - 1).astype(jnp.int32),
                 axis=1),
             0)
-        pkey = jax.lax.associative_scan(fwd, (akey, aligned), axis=1)[0]
+        abpos = jnp.where(
+            aligned,
+            jnp.take_along_axis(bpos, jnp.clip(node_at, 0, N - 1),
+                                axis=1).astype(jnp.int64),
+            0)
+        pkey, pflag = jax.lax.associative_scan(fwd, (akey, aligned),
+                                               axis=1)
         pkey_prev = jnp.concatenate(
             [jnp.zeros((B, 1), jnp.int64), pkey[:, :-1]], axis=1)
+        has_prev = jnp.concatenate(
+            [jnp.zeros((B, 1), bool), pflag[:, :-1]], axis=1)
+        pbp = jax.lax.associative_scan(fwd, (abpos, aligned), axis=1)[0]
+        pbp_prev = jnp.concatenate(
+            [jnp.zeros((B, 1), jnp.int64), pbp[:, :-1]], axis=1)
         nk = jax.lax.associative_scan(
             fwd, (jnp.flip(akey, 1), jnp.flip(aligned, 1)), axis=1)[0]
         nkey_next = jnp.flip(nk, 1)
+        nbp_next = jnp.flip(jax.lax.associative_scan(
+            fwd, (jnp.flip(abpos, 1), jnp.flip(aligned, 1)), axis=1)[0], 1)
         nkey_next = jnp.where(
             jnp.flip(jax.lax.associative_scan(
                 jnp.logical_or, jnp.flip(aligned, 1), axis=1), 1),
             nkey_next, MAXKEY)
+        ins_bpos = jnp.where(has_prev, pbp_prev, nbp_next).astype(
+            jnp.int16)
 
         # position within insertion run and run length
         ins_i = jnp.cumsum(insertion.astype(jnp.int32), axis=1)
@@ -315,6 +357,11 @@ def fused_builder(n_nodes: int, seq_len: int, depth: int, max_pred: int,
             base.astype(jnp.int8), mode="drop")
         col_of = col_of.at[rows_b[:, None], sn].set(
             tcol.astype(col_of.dtype), mode="drop")
+        tbpos = jnp.where(insertion, ins_bpos,
+                          jnp.take_along_axis(
+                              bpos, jnp.clip(node_at, 0, N - 1),
+                              axis=1)).astype(jnp.int16)
+        bpos = bpos.at[rows_b[:, None], sn].set(tbpos, mode="drop")
         sc = jnp.where(insertion & okm, cid, C + 1)
         colkey = colkey.at[rows_b[:, None], sc].set(ikey, mode="drop")
         flat_cn = colnodes.reshape(B, C * 5)
@@ -361,16 +408,17 @@ def fused_builder(n_nodes: int, seq_len: int, depth: int, max_pred: int,
         n_cols = jnp.where(
             ok, n_cols + insertion.sum(axis=1, dtype=jnp.int32), n_cols)
         return ((codes, preds, predw, nseq, outdeg, col_of, colkey,
-                 colnodes, n_nodes, n_cols, failed), None)
+                 colnodes, bpos, n_nodes, n_cols, failed), None)
 
     def run(codes, preds, predw, nseq, outdeg, col_of, colkey, colnodes,
-            n_nodes, n_cols, failed, seqs, lens, wts, lbase):
+            bpos, n_nodes, n_cols, failed, seqs, lens, wts, rlo, rhi,
+            lbase):
         state = (codes, preds, predw, nseq, outdeg, col_of, colkey,
-                 colnodes, n_nodes, n_cols, failed)
+                 colnodes, bpos, n_nodes, n_cols, failed)
         state, _ = jax.lax.scan(
             one_layer, state,
             (seqs.transpose(1, 0, 2), lens.T, wts.transpose(1, 0, 2),
-             lbase + jnp.arange(D, dtype=jnp.int32)))
+             rlo.T, rhi.T, lbase + jnp.arange(D, dtype=jnp.int32)))
         return state
 
     return jax.jit(run)
@@ -413,14 +461,11 @@ class FusedPOA:
 
     def _eligible(self, win) -> bool:
         bb_len = len(win[0][0])
-        offset = int(0.01 * bb_len)
         if bb_len + 1 > self.N:
             return False
         for seq, _, b, e in win[1:]:
             if not seq or len(seq) > self.L:
                 return False
-            if not (b < offset and e > bb_len - offset):
-                return False  # non-spanning: subgraph path -> other engine
         return True
 
     def precompile(self) -> None:
@@ -431,7 +476,9 @@ class FusedPOA:
             seqs = np.full((self.B, d, self.L), 5, np.int8)
             lens = np.zeros((self.B, d), np.int32)
             wts = np.zeros((self.B, d, self.L), np.int32)
-            out = fn(*state, seqs, lens, wts, 0)
+            rlo = np.full((self.B, d), -32768, np.int16)
+            rhi = np.full((self.B, d), 32767, np.int16)
+            out = fn(*state, seqs, lens, wts, rlo, rhi, 0)
             np.asarray(out[0])  # block
 
     def _init_state(self, backbones, bweights):
@@ -444,6 +491,7 @@ class FusedPOA:
         col_of = np.full((B, N), -1, dtype=np.int16)
         colkey = np.zeros((B, C), dtype=np.int64)
         colnodes = np.full((B, C, 5), -1, dtype=np.int16)
+        bpos = np.zeros((B, N), dtype=np.int16)
         n_nodes = np.zeros(B, dtype=np.int32)
         n_cols = np.zeros(B, dtype=np.int32)
         failed = np.zeros(B, dtype=bool)
@@ -453,6 +501,7 @@ class FusedPOA:
             col_of[k, :m] = np.arange(m)
             colkey[k, :m] = (np.arange(m, dtype=np.int64) + 1) << 32
             colnodes[k, np.arange(m), codes[k, :m]] = np.arange(m)
+            bpos[k, :m] = np.arange(m)
             preds[k, 1:m, 0] = np.arange(m - 1)
             predw[k, 1:m, 0] = w[:-1] + w[1:]
             outdeg[k, :m - 1] = 1
@@ -460,7 +509,7 @@ class FusedPOA:
             n_nodes[k] = m
             n_cols[k] = m
         return (codes, preds, predw, nseq, outdeg, col_of, colkey,
-                colnodes, n_nodes, n_cols, failed)
+                colnodes, bpos, n_nodes, n_cols, failed)
 
     def consensus(self, windows, fallback: bool = True):
         """fallback=False leaves ineligible/failed windows as (None,
@@ -478,6 +527,9 @@ class FusedPOA:
                 results[i] = (w[0][0], np.zeros(len(w[0][0]), np.uint32))
             elif self._eligible(w):
                 fused_idx.append(i)
+        # windows are processed deepest-first so each batch chunk chains
+        # a similar number of calls (padding layers are not free)
+        fused_idx.sort(key=lambda i: -len(windows[i]))
 
         bar = self.logger.bar if self.logger is not None else None
         if self.logger is not None and fused_idx:
@@ -523,25 +575,34 @@ class FusedPOA:
             seqs = np.full((self.B, d, self.L), 5, np.int8)
             lens = np.zeros((self.B, d), np.int32)
             wts = np.zeros((self.B, d, self.L), np.int32)
+            rlo = np.full((self.B, d), -32768, np.int16)
+            rhi = np.full((self.B, d), 32767, np.int16)
             for k, i in enumerate(chunk):
                 layers = windows[i][1:]
+                bb_len = len(windows[i][0][0])
+                offset = int(0.01 * bb_len)
                 for dd in range(d):
                     li = done + dd
                     if li >= len(layers):
                         break
-                    seq, qual, _, _ = layers[li]
+                    seq, qual, b, e = layers[li]
                     seqs[k, dd, :len(seq)] = self._code_of[
                         np.frombuffer(seq, np.uint8)]
                     lens[k, dd] = len(seq)
                     wts[k, dd, :len(seq)] = _weights_of(qual, len(seq))
+                    if not (b < offset and e > bb_len - offset):
+                        # non-spanning: bpos-range subgraph (reference
+                        # window.cpp:97-102)
+                        rlo[k, dd] = b
+                        rhi[k, dd] = e
             fn = fused_builder(self.N, self.L, d, self.P, self.match,
                                self.mismatch, self.gap)
             state = [np.asarray(x) for x in fn(*state, seqs, lens, wts,
-                                               done)]
+                                               rlo, rhi, done)]
             done += d
 
         (codes, preds, predw, nseq, outdeg, col_of, colkey, colnodes,
-         n_nodes, n_cols, failed) = state
+         bpos, n_nodes, n_cols, failed) = state
         okrows = [k for k in range(len(chunk)) if not failed[k]]
         if okrows:
             sel = np.asarray(okrows)

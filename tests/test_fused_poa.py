@@ -64,15 +64,39 @@ def test_fused_deep_windows_chain_calls():
     _assert_identical(res, host, statuses, "chained")
 
 
-def test_fused_failed_and_ineligible_fall_back_to_host():
-    """Envelope violations (node overflow) and ineligible windows
-    (non-spanning layers) must host-fallback per window — and the final
-    output is still identical to the host engine for every window."""
+def test_fused_non_spanning_layers_use_range_subgraph():
+    """Non-spanning layers align against the bpos-range-masked subgraph
+    on device (the host's Graph::subgraph semantics). Tie-break order
+    differs from the host here (global column-key ranks vs per-subgraph
+    Kahn order), so the contract is reference-GPU-style: consensus quality
+    within a small margin of the host engine's, never behind the
+    backbone."""
+    from racon_tpu.native import edit_distance
+
+    rng = random.Random(12)
+    windows, truths = _make_windows(rng, 6, length=110, depth=5,
+                                    spanning=False, rate=0.1)
+    packed = [_pack(w) for w in windows]
+
+    eng = FusedPOA(3, -5, -4, max_nodes=512, max_len=256, batch_rows=8,
+                   depth_buckets=(8,))
+    res, statuses = eng.consensus(packed)
+    host = poa_batch(packed, 3, -5, -4)
+
+    assert (statuses == 0).all(), statuses.tolist()
+    for (fc, _), (hc, _), truth, w in zip(res, host, truths, windows):
+        d_f = edit_distance(fc, truth)
+        d_h = edit_distance(hc, truth)
+        d_bb = edit_distance(w.sequences[0], truth)
+        assert d_f <= max(d_h + 2, d_bb // 2), (d_f, d_h, d_bb)
+
+
+def test_fused_envelope_overflow_falls_back_to_host():
+    """Graphs that outgrow the node envelope must host-fallback per
+    window — and the final output is still identical to the host engine
+    for every window."""
     rng = random.Random(6)
     windows, _ = _make_windows(rng, 3, length=220, depth=5, rate=0.1)
-    # non-spanning layers -> ineligible
-    sub, _ = _make_windows(rng, 2, length=220, depth=4, spanning=False)
-    windows += sub
     packed = [_pack(w) for w in windows]
 
     eng = FusedPOA(3, -5, -4, max_nodes=230, max_len=384, batch_rows=4,
@@ -80,8 +104,7 @@ def test_fused_failed_and_ineligible_fall_back_to_host():
     res, statuses = eng.consensus(packed)
     host = poa_batch(packed, 3, -5, -4)
 
-    assert (statuses[3:] == 1).all(), statuses.tolist()  # ineligible
-    assert eng.n_fallback >= 2
+    assert eng.n_fallback >= 1
     _assert_identical(res, host, statuses, "fallback")
 
 
