@@ -101,15 +101,16 @@ def fused_builder(n_nodes: int, seq_len: int, depth: int, max_pred: int,
     NEG = jnp.int32(_NEG)
     MAXKEY = jnp.int64(1) << 44  # composite (key << 11 | id) must fit i64
 
-    def dp_align(codes_r, preds_r, sinks_r, seq, slen, B):
+    def dp_align(codes_r, preds_r, sinks_r, centers_r, band, seq, slen, B):
         jidx = jnp.arange(L + 1, dtype=jnp.int32)
         h0 = jnp.where(jidx[None, :] <= slen[:, None], jidx[None, :] * gap,
                        NEG).astype(jnp.int32)
         H = jnp.full((B, N + 1, L + 1), NEG, dtype=jnp.int32)
         H = H.at[:, 0, :].set(h0)
+        band2 = (band // 2).astype(jnp.int32)
 
         def step(H, xs):
-            code_k, preds_k, k = xs
+            code_k, preds_k, center_k, k = xs
             pk = jnp.clip(preds_k, 0, N)
             rows = jnp.take_along_axis(H, pk[:, :, None], axis=1)
             rows = jnp.where((preds_k >= 0)[:, :, None], rows, NEG)
@@ -119,9 +120,17 @@ def fused_builder(n_nodes: int, seq_len: int, depth: int, max_pred: int,
             vert = rows[:, :, 1:] + gap
             best = jnp.max(jnp.maximum(diag, vert), axis=1)
             row0 = jnp.max(rows[:, :, 0], axis=1) + gap
-            inb = (jidx[None, 1:] >= 1) & (jidx[None, 1:] <= slen[:, None])
+            # static-band masking around each node's expected diagonal,
+            # exactly like the host engine (band 0 = full DP)
+            use_band = band > 0
+            jlo = jnp.where(use_band, jnp.maximum(1, center_k - band2), 1)
+            jhi = jnp.where(use_band, jnp.minimum(slen, center_k + band2),
+                            slen)
+            inb = ((jidx[None, 1:] >= jlo[:, None]) &
+                   (jidx[None, 1:] <= jhi[:, None]))
             pre = jnp.where(inb, best, NEG)
-            cat = jnp.concatenate([row0[:, None], pre], axis=1)
+            seed0 = jnp.where(jlo == 1, row0, NEG)
+            cat = jnp.concatenate([seed0[:, None], pre], axis=1)
             run = jax.lax.cummax(cat - jidx * gap, axis=1) + jidx * gap
             hrow = jnp.where(inb, run[:, 1:], pre)
             new_row = jnp.concatenate([row0[:, None], hrow], axis=1)
@@ -145,7 +154,8 @@ def fused_builder(n_nodes: int, seq_len: int, depth: int, max_pred: int,
         ks = jnp.arange(1, N + 1, dtype=jnp.int32)
         unroll = 1 if jax.default_backend() == "cpu" else 4
         H, bps = jax.lax.scan(step, H,
-                              (codes_r.T, preds_r.transpose(1, 0, 2), ks),
+                              (codes_r.T, preds_r.transpose(1, 0, 2),
+                               centers_r.T, ks),
                               unroll=unroll)
 
         flat_h = H.reshape(B, (N + 1) * (L + 1))
@@ -200,7 +210,7 @@ def fused_builder(n_nodes: int, seq_len: int, depth: int, max_pred: int,
     def one_layer(state, layer):
         (codes, preds, predw, nseq, outdeg, col_of, colkey, colnodes,
          bpos, n_nodes, n_cols, failed) = state
-        seq, slen, wts, rlo, rhi, lidx = layer
+        seq, slen, wts, rlo, rhi, band, lidx = layer
         B = codes.shape[0]
         rows_b = jnp.arange(B)
         active = (slen > 0) & ~failed
@@ -249,7 +259,13 @@ def fused_builder(n_nodes: int, seq_len: int, depth: int, max_pred: int,
         sinks_r = in_range_r & ~jnp.take_along_axis(
             has_succ[:, :N], order, axis=1)
 
-        ranks = dp_align(codes_r, pr_rank, sinks_r, seq, slen, B)
+        # band centers: bpos relative to the layer's range origin
+        origin = jnp.maximum(rlo.astype(jnp.int32), 0)
+        centers_r = (jnp.take_along_axis(bpos, order, axis=1).astype(
+            jnp.int32) - origin[:, None] + 1)
+
+        ranks = dp_align(codes_r, pr_rank, sinks_r, centers_r,
+                         band.astype(jnp.int32), seq, slen, B)
 
         # ---- vectorized ingest
         iidx = jnp.arange(L, dtype=jnp.int32)
@@ -412,13 +428,13 @@ def fused_builder(n_nodes: int, seq_len: int, depth: int, max_pred: int,
 
     def run(codes, preds, predw, nseq, outdeg, col_of, colkey, colnodes,
             bpos, n_nodes, n_cols, failed, seqs, lens, wts, rlo, rhi,
-            lbase):
+            band, lbase):
         state = (codes, preds, predw, nseq, outdeg, col_of, colkey,
                  colnodes, bpos, n_nodes, n_cols, failed)
         state, _ = jax.lax.scan(
             one_layer, state,
             (seqs.transpose(1, 0, 2), lens.T, wts.transpose(1, 0, 2),
-             rlo.T, rhi.T, lbase + jnp.arange(D, dtype=jnp.int32)))
+             rlo.T, rhi.T, band.T, lbase + jnp.arange(D, dtype=jnp.int32)))
         return state
 
     return jax.jit(run)
@@ -478,7 +494,8 @@ class FusedPOA:
             wts = np.zeros((self.B, d, self.L), np.int32)
             rlo = np.full((self.B, d), -32768, np.int16)
             rhi = np.full((self.B, d), 32767, np.int16)
-            out = fn(*state, seqs, lens, wts, rlo, rhi, 0)
+            band = np.zeros((self.B, d), np.int32)
+            out = fn(*state, seqs, lens, wts, rlo, rhi, band, 0)
             np.asarray(out[0])  # block
 
     def _init_state(self, backbones, bweights):
@@ -577,8 +594,11 @@ class FusedPOA:
             wts = np.zeros((self.B, d, self.L), np.int32)
             rlo = np.full((self.B, d), -32768, np.int16)
             rhi = np.full((self.B, d), 32767, np.int16)
+            band = np.zeros((self.B, d), np.int32)
             for k, i in enumerate(chunk):
-                layers = windows[i][1:]
+                # layer order: stable sort by begin, the host engine's
+                # visit order (reference window.cpp:84-85)
+                layers = sorted(windows[i][1:], key=lambda s: s[2])
                 bb_len = len(windows[i][0][0])
                 offset = int(0.01 * bb_len)
                 for dd in range(d):
@@ -590,15 +610,21 @@ class FusedPOA:
                         np.frombuffer(seq, np.uint8)]
                     lens[k, dd] = len(seq)
                     wts[k, dd, :len(seq)] = _weights_of(qual, len(seq))
-                    if not (b < offset and e > bb_len - offset):
+                    spanning = b < offset and e > bb_len - offset
+                    span = bb_len if spanning else e - b + 1
+                    if not spanning:
                         # non-spanning: bpos-range subgraph (reference
                         # window.cpp:97-102)
                         rlo[k, dd] = b
                         rhi[k, dd] = e
+                    # the host engine's static-band rule (band 256 when
+                    # the layer fits, exact DP otherwise)
+                    if abs(len(seq) - span) < 256 // 2 - 16:
+                        band[k, dd] = 256
             fn = fused_builder(self.N, self.L, d, self.P, self.match,
                                self.mismatch, self.gap)
             state = [np.asarray(x) for x in fn(*state, seqs, lens, wts,
-                                               rlo, rhi, done)]
+                                               rlo, rhi, band, done)]
             done += d
 
         (codes, preds, predw, nseq, outdeg, col_of, colkey, colnodes,
