@@ -31,14 +31,15 @@ vectorized scatters:
   - consensus runs on host from the fetched arrays via the SAME C++
     heaviest-bundle the host engine uses (native rh_poa_finish_arrays).
 
-Accuracy contract (the reference's GPU discipline — numeric divergence
-between backends accepted and pinned separately, racon_test.cpp:292-496):
-spanning-layer windows reproduce the host engine byte-for-byte in
-practice (this engine uses full DP where the host bands, and its global
-column-key rank order differs from per-subgraph Kahn order, so
-non-spanning/banded cases can drift by a few edits). On the lambda sample
-the full fused pipeline measures 1356 vs the host engine's 1352 — inside
-the reference's own CPU/GPU spread (1312/1385).
+Accuracy contract: the engine replicates the host's layer order
+(begin-sorted, window.cpp:84-85), band rule (256 when the layer fits,
+exact DP otherwise) and ingest semantics, and tests assert BYTE-IDENTITY
+to the host engine on spanning and non-spanning synthetic windows alike.
+The one intentional divergence is the banded clipped->full-DP retry
+(poa.cpp band_clipped), which this engine omits — a window whose banded
+alignment would have been clipped (rare: zero on the lambda sample) may
+differ, the reference's own GPU-divergence discipline
+(racon_test.cpp:292-496).
 
 Non-spanning layers (reference window.cpp:87-103's subgraph case) are
 handled by MASKING, not extraction: every node carries its backbone
