@@ -114,15 +114,21 @@ class BatchPOA:
             fused = FusedPOA(self.match, self.mismatch, self.gap,
                              num_threads=self.num_threads,
                              logger=self.logger)
-            results, statuses = fused.consensus(packed, fallback=False)
-            # windows the fused engine cannot take (non-spanning layers
-            # need subgraph alignment, or the graph overflowed its
-            # envelope) run on the per-layer session engine — the whole
-            # batch stays on device
+            # RACON_TPU_FUSED_FALLBACK picks who polishes the windows the
+            # fused engine cannot take (graph overflowed its envelope):
+            # "session" (default) keeps the whole batch on device via the
+            # per-layer session engine; "host" uses the C++ engine — the
+            # reference's per-window GPU->CPU fallback discipline
+            # (cudapolisher.cpp:354-383), no second device engine compile
+            to_host = (os.environ.get("RACON_TPU_FUSED_FALLBACK",
+                                      "session") == "host")
+            results, statuses = fused.consensus(packed, fallback=to_host)
             rest = [i for i, r in enumerate(results) if r is None]
             print(f"[racon_tpu::BatchPOA] fused engine built "
                   f"{int((statuses == 0).sum())} windows; "
-                  f"{len(rest)} to session engine", file=sys.stderr)
+                  f"{fused.n_fallback} to "
+                  f"{'host' if to_host else 'session'} engine",
+                  file=sys.stderr)
             if rest:
                 engine = DeviceGraphPOA(self.match, self.mismatch,
                                         self.gap,

@@ -126,3 +126,43 @@ def test_fused_through_batchpoa_env(monkeypatch):
         assert w.polished
         assert edit_distance(w.consensus, truth) <= \
             edit_distance(w.sequences[0], truth)
+
+
+def test_fused_fallback_host_env(monkeypatch, capsys):
+    """RACON_TPU_FUSED_FALLBACK=host polishes fused-ineligible windows on
+    the C++ engine (the reference's per-window GPU->CPU fallback,
+    cudapolisher.cpp:354-383) instead of the session engine — output still
+    byte-identical to a pure host run. STRICT so a broken fused path
+    fails instead of silently host-polishing everything."""
+    from racon_tpu.ops import poa_fused
+    from racon_tpu.ops.poa import BatchPOA
+
+    monkeypatch.setenv("RACON_TPU_ENGINE", "fused")
+    monkeypatch.setenv("RACON_TPU_FUSED_FALLBACK", "host")
+    monkeypatch.setenv("RACON_TPU_STRICT", "1")
+
+    class SmallFused(poa_fused.FusedPOA):  # shrink the envelope so some
+        def __init__(self, *a, **kw):      # windows are fused-ineligible
+            kw.update(max_nodes=230, max_len=384, batch_rows=4,
+                      depth_buckets=(8,))
+            super().__init__(*a, **kw)
+
+    monkeypatch.setattr(poa_fused, "FusedPOA", SmallFused)
+    rng = random.Random(13)
+    windows, _ = _make_windows(rng, 4, length=220, depth=5, rate=0.1)
+    host = poa_batch([_pack(w) for w in windows], 3, -5, -4)
+
+    engine = BatchPOA(3, -5, -4, 220, device_batches=1)
+    engine.generate_consensus(windows, trim=False)
+    err = capsys.readouterr().err
+    # prove the branch ran AND fell back: the engine report names the
+    # host engine with a nonzero count
+    import re
+
+    m = re.search(r"fused engine built \d+ windows; (\d+) to host engine",
+                  err)
+    assert m is not None, err
+    assert int(m.group(1)) >= 1
+    for w, (hc, _) in zip(windows, host):
+        assert w.polished
+        assert w.consensus == hc
