@@ -118,7 +118,9 @@ def phase_consensus(mode: str) -> int:
         if mode == "fused":
             from racon_tpu.ops.poa_fused import FusedPOA
 
-            FusedPOA(5, -4, -8).precompile()
+            depth = max((len(w.sequences) - 1 for w in polisher.windows),
+                        default=0)
+            FusedPOA(5, -4, -8).precompile(max_depth=depth)
         else:
             from racon_tpu.ops.poa_graph import DeviceGraphPOA
 

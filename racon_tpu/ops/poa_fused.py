@@ -102,7 +102,8 @@ def fused_builder(n_nodes: int, seq_len: int, depth: int, max_pred: int,
     NEG = jnp.int32(_NEG)
     MAXKEY = jnp.int64(1) << 44  # composite (key << 11 | id) must fit i64
 
-    def dp_align(codes_r, preds_r, sinks_r, centers_r, band, seq, slen, B):
+    def dp_align(codes_r, preds_r, sinks_r, centers_r, band, seq, slen, B,
+                 kmax):
         jidx = jnp.arange(L + 1, dtype=jnp.int32)
         h0 = jnp.where(jidx[None, :] <= slen[:, None], jidx[None, :] * gap,
                        NEG).astype(jnp.int32)
@@ -152,12 +153,25 @@ def fused_builder(n_nodes: int, seq_len: int, depth: int, max_pred: int,
                 H, new_row[:, None, :], (jnp.int32(0), k, jnp.int32(0)))
             return H, bp_row
 
-        ks = jnp.arange(1, N + 1, dtype=jnp.int32)
-        unroll = 1 if jax.default_backend() == "cpu" else 4
-        H, bps = jax.lax.scan(step, H,
-                              (codes_r.T, preds_r.transpose(1, 0, 2),
-                               centers_r.T, ks),
-                              unroll=unroll)
+        # row loop bounded by the batch's real node count (graphs start at
+        # backbone size ~N/4 and grow layer by layer — a static N-step
+        # scan would pay for every pad row on every layer)
+        bps0 = jnp.zeros((N, B, L + 1), dtype=jnp.int8)
+
+        def row(k, carry):
+            H, bps = carry
+            code_k = jax.lax.dynamic_slice_in_dim(
+                codes_r, k - 1, 1, axis=1)[:, 0]
+            preds_k = jax.lax.dynamic_slice_in_dim(
+                preds_r, k - 1, 1, axis=1)[:, 0]
+            center_k = jax.lax.dynamic_slice_in_dim(
+                centers_r, k - 1, 1, axis=1)[:, 0]
+            H, bp_row = step(H, (code_k, preds_k, center_k, k))
+            bps = jax.lax.dynamic_update_slice(
+                bps, bp_row[None], (k - 1, jnp.int32(0), jnp.int32(0)))
+            return H, bps
+
+        H, bps = jax.lax.fori_loop(jnp.int32(1), kmax + 1, row, (H, bps0))
 
         flat_h = H.reshape(B, (N + 1) * (L + 1))
         ridx = (jnp.arange(1, N + 1, dtype=jnp.int32)[None, :] * (L + 1)
@@ -266,7 +280,8 @@ def fused_builder(n_nodes: int, seq_len: int, depth: int, max_pred: int,
             jnp.int32) - origin[:, None] + 1)
 
         ranks = dp_align(codes_r, pr_rank, sinks_r, centers_r,
-                         band.astype(jnp.int32), seq, slen, B)
+                         band.astype(jnp.int32), seq, slen, B,
+                         jnp.max(n_nodes).astype(jnp.int32))
 
         # ---- vectorized ingest
         iidx = jnp.arange(L, dtype=jnp.int32)
@@ -438,7 +453,11 @@ def fused_builder(n_nodes: int, seq_len: int, depth: int, max_pred: int,
              rlo.T, rhi.T, band.T, lbase + jnp.arange(D, dtype=jnp.int32)))
         return state
 
-    return jax.jit(run)
+    # donate the state buffers on accelerators so chained calls mutate in
+    # place instead of allocating a second copy of the graph arrays (the
+    # CPU test backend can't donate and would warn on every call)
+    donate = () if jax.default_backend() == "cpu" else tuple(range(12))
+    return jax.jit(run, donate_argnums=donate)
 
 
 def _weights_of(qual, length):
@@ -485,8 +504,31 @@ class FusedPOA:
                 return False
         return True
 
-    def precompile(self) -> None:
-        for d in self.depth_buckets:
+    def _chain_plan(self, depth: int) -> list[int]:
+        """The greedy chained-call depth sequence for one chunk depth."""
+        plan, done = [], 0
+        while done < depth:
+            rem = depth - done
+            fits = [b for b in self.depth_buckets if b <= rem]
+            d = max(fits) if fits else min(
+                b for b in self.depth_buckets if b >= rem)
+            plan.append(d)
+            done += d
+        return plan
+
+    def precompile(self, max_depth: int | None = None) -> None:
+        """Compile the depth-bucket programs up front. `max_depth` (the
+        deepest window that will be polished) restricts compilation to the
+        buckets the chaining algorithm can actually pick — the caller
+        knows the windows, so the bench/polisher need not pay for unused
+        programs."""
+        if max_depth is None:
+            needed = set(self.depth_buckets)
+        else:
+            needed = set()
+            for depth in range(1, max(1, max_depth) + 1):
+                needed.update(self._chain_plan(depth))
+        for d in sorted(needed):
             fn = fused_builder(self.N, self.L, d, self.P, self.match,
                                self.mismatch, self.gap)
             state = self._init_state([b"AC"], [np.ones(2, np.int32)])
@@ -553,13 +595,26 @@ class FusedPOA:
         if self.logger is not None and fused_idx:
             self.logger.bar_total(len(fused_idx))
 
-        for s in range(0, len(fused_idx), self.B):
-            chunk = fused_idx[s:s + self.B]
-            self._run_chunk(windows, chunk, results, statuses)
+        def _done(chunk, state):
+            self._finalize_chunk(chunk, state, results, statuses)
             if bar is not None:
                 for _ in chunk:
                     bar("[racon_tpu::Polisher.polish] "
                         "building whole-window POA graphs on device")
+
+        # pipelined: chunk k+1's layer packing + dispatch happen while
+        # chunk k computes on device (jax dispatch is async; only the
+        # finalize's fetch blocks) — the stream-overlap role of the
+        # reference's per-batch CUDA streams (cudapolisher.cpp:165-199)
+        pending = None
+        for s in range(0, len(fused_idx), self.B):
+            chunk = fused_idx[s:s + self.B]
+            state = self._dispatch_chunk(windows, chunk)
+            if pending is not None:
+                _done(*pending)
+            pending = (chunk, state)
+        if pending is not None:
+            _done(*pending)
 
         # everything left is ineligible or device-failed
         rest = [i for i in range(n) if results[i] is None]
@@ -573,23 +628,16 @@ class FusedPOA:
                 statuses[i] = 1
         return results, statuses
 
-    def _run_chunk(self, windows, chunk, results, statuses):
-        from ..native import poa_finish_arrays
-
+    def _dispatch_chunk(self, windows, chunk):
+        """Build and dispatch every chained call for one window chunk;
+        returns the (device-resident, in-flight) final state."""
         backbones = [windows[i][0][0] for i in chunk]
         bweights = [_weights_of(windows[i][0][1], len(windows[i][0][0]))
                     for i in chunk]
         state = self._init_state(backbones, bweights)
         depth = max(len(windows[i]) - 1 for i in chunk)
         done = 0
-        while done < depth:
-            # greedy chaining: largest bucket that fits the remaining
-            # depth (padded layers still pay a full DP scan), else the
-            # smallest bucket that covers the tail
-            rem = depth - done
-            fits = [b for b in self.depth_buckets if b <= rem]
-            d = max(fits) if fits else min(
-                b for b in self.depth_buckets if b >= rem)
+        for d in self._chain_plan(depth):
             seqs = np.full((self.B, d, self.L), 5, np.int8)
             lens = np.zeros((self.B, d), np.int32)
             wts = np.zeros((self.B, d, self.L), np.int32)
@@ -624,12 +672,18 @@ class FusedPOA:
                         band[k, dd] = 256
             fn = fused_builder(self.N, self.L, d, self.P, self.match,
                                self.mismatch, self.gap)
-            state = [np.asarray(x) for x in fn(*state, seqs, lens, wts,
-                                               rlo, rhi, band, done)]
+            # state stays on device across chained calls (a fetch here
+            # would round-trip ~5 MB of graph arrays per call); only the
+            # final state is materialized for the host finalizer
+            state = fn(*state, seqs, lens, wts, rlo, rhi, band, done)
             done += d
+        return state
+
+    def _finalize_chunk(self, chunk, state, results, statuses):
+        from ..native import poa_finish_arrays
 
         (codes, preds, predw, nseq, outdeg, col_of, colkey, colnodes,
-         bpos, n_nodes, n_cols, failed) = state
+         bpos, n_nodes, n_cols, failed) = (np.asarray(x) for x in state)
         okrows = [k for k in range(len(chunk)) if not failed[k]]
         if okrows:
             sel = np.asarray(okrows)
