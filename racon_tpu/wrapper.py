@@ -33,11 +33,23 @@ def run(sequences: str, overlaps: str, target_sequences: str,
         error_threshold: float = 0.3, match: int = 5, mismatch: int = -4,
         gap: int = -8, threads: int = 1, tpu_poa_batches: int = 0,
         tpu_aligner_batches: int = 0, tpu_banded_alignment: bool = False,
-        out=None) -> None:
+        num_shards: int = 1, shard_id: int = 0, out=None) -> None:
     """Polish `target_sequences`, optionally subsampled/split, writing
-    FASTA to `out` (default stdout)."""
+    FASTA to `out` (default stdout).
+
+    `num_shards`/`shard_id` implement the multi-host scale-out story
+    (SURVEY.md §5): the window workload is embarrassingly parallel and
+    needs no inter-device communication, so hosts scale by FILE-LEVEL
+    scatter/gather over DCN — each host polishes a contiguous block of the
+    target chunks (chunks are byte-bounded, so blocks are balanced), and
+    concatenating the shard outputs in shard order reproduces the
+    unsharded output byte-for-byte. Requires --split so there is more
+    than one unit to scatter."""
     from .core.polisher import create_polisher, PolisherType
 
+    if not (0 <= shard_id < num_shards):
+        raise RaconError(
+            "wrapper", f"shard_id {shard_id} outside [0, {num_shards})")
     out = out if out is not None else sys.stdout.buffer
     work = tempfile.mkdtemp(prefix="racon_tpu_work_")
     try:
@@ -54,6 +66,20 @@ def run(sequences: str, overlaps: str, target_sequences: str,
                   f"{len(targets)}", file=sys.stderr)
         else:
             targets = [target_sequences]
+
+        if num_shards > 1:
+            if len(targets) < num_shards:
+                # every shard must have work: silently-empty shard output
+                # looks like a failed run to gather scripts
+                raise RaconError(
+                    "wrapper", f"num_shards {num_shards} exceeds the "
+                    f"{len(targets)} target chunk(s) --split produced; "
+                    "use a smaller --split size or fewer shards")
+            lo = shard_id * len(targets) // num_shards
+            hi = (shard_id + 1) * len(targets) // num_shards
+            print(f"[racon_tpu::wrapper] shard {shard_id}/{num_shards}: "
+                  f"chunks [{lo}, {hi}) of {len(targets)}", file=sys.stderr)
+            targets = targets[lo:hi]
 
         for part in targets:
             polisher = create_polisher(
@@ -97,6 +123,13 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("-c", "--tpupoa-batches", type=int, default=0)
     parser.add_argument("--tpualigner-batches", type=int, default=0)
     parser.add_argument("-b", "--tpu-banded-alignment", action="store_true")
+    parser.add_argument("--num-shards", type=int, default=1,
+                        help="multi-host scale-out: total hosts polishing "
+                             "this workload (file-level scatter over the "
+                             "--split chunks; cat shard outputs in shard "
+                             "order to gather)")
+    parser.add_argument("--shard-id", type=int, default=0,
+                        help="this host's shard index in [0, num_shards)")
 
     args = parser.parse_args(argv)
     try:
@@ -111,7 +144,8 @@ def main(argv: list[str] | None = None) -> int:
             match=args.match, mismatch=args.mismatch, gap=args.gap,
             threads=args.threads, tpu_poa_batches=args.tpupoa_batches,
             tpu_aligner_batches=args.tpualigner_batches,
-            tpu_banded_alignment=args.tpu_banded_alignment)
+            tpu_banded_alignment=args.tpu_banded_alignment,
+            num_shards=args.num_shards, shard_id=args.shard_id)
     except RaconError as exc:
         print(str(exc), file=sys.stderr)
         return 1

@@ -82,3 +82,62 @@ def test_wrapper_split_run_matches_whole(tmp_path):
     seqs = out.getvalue()
     assert seqs.count(b">") == 1
     assert seqs.startswith(b">utg000001l")
+
+
+@needs_data
+def test_wrapper_shards_concatenate_to_unsharded(tmp_path):
+    """Multi-host file-level scatter/gather (SURVEY.md §5): polishing the
+    same --split workload as 2 shards and concatenating the outputs in
+    shard order must reproduce the unsharded run byte-for-byte.
+
+    The sample layout is a single contig (rampler never splits
+    mid-sequence), so the multi-chunk workload is synthesized: four
+    contigs sliced from the real lambda layout, reads sliced from each
+    contig with exact PAF overlaps."""
+    import random
+
+    from racon_tpu.wrapper import run
+
+    layout = _load(DATA + "sample_layout.fasta.gz")[0].data
+    rng = random.Random(3)
+    contigs, reads, paf = [], [], []
+    for c in range(4):
+        tig = layout[c * 9000:(c + 1) * 9000]
+        name = f"tig{c}".encode()
+        contigs.append((name, tig))
+        for r in range(12):
+            beg = rng.randrange(0, len(tig) - 2000)
+            end = beg + 2000
+            rname = f"read{c}_{r}".encode()
+            reads.append((rname, tig[beg:end]))
+            paf.append(f"read{c}_{r}\t2000\t0\t2000\t+\t{name.decode()}\t"
+                       f"{len(tig)}\t{beg}\t{end}\t2000\t2000\t255")
+    tgt = tmp_path / "tigs.fasta"
+    rds = tmp_path / "reads.fasta"
+    ovl = tmp_path / "ovl.paf"
+    write_fasta(tgt, contigs)
+    write_fasta(rds, reads)
+    ovl.write_text("\n".join(paf) + "\n")
+
+    def polish(num_shards=1, shard_id=0):
+        out = io.BytesIO()
+        run(str(rds), str(ovl), str(tgt), split=9_500, threads=2,
+            num_shards=num_shards, shard_id=shard_id, out=out)
+        return out.getvalue()
+
+    whole = polish()
+    assert whole.count(b">") == 4  # split actually made multiple chunks
+    sharded = polish(2, 0) + polish(2, 1)
+    assert sharded == whole
+
+
+def test_wrapper_shard_validation(tmp_path):
+    from racon_tpu.errors import RaconError
+    from racon_tpu.wrapper import run
+
+    src = tmp_path / "t.fasta"
+    write_fasta(src, [(b"a", b"ACGT" * 50)])
+    with pytest.raises(RaconError, match="shard_id"):
+        run(str(src), str(src), str(src), num_shards=2, shard_id=5)
+    with pytest.raises(RaconError, match="--split"):
+        run(str(src), str(src), str(src), num_shards=2, shard_id=0)
