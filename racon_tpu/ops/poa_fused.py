@@ -88,9 +88,11 @@ def fused_builder(n_nodes: int, seq_len: int, depth: int, max_pred: int,
     i16 node ids (-1 empty), predw [B,N,P] i32, nseq [B,N] i32, outdeg
     [B,N] i16, col_of [B,N] i16, colkey [B,N] i64, colnodes [B,N,5] i16,
     bpos [B,N] i16, n_nodes/n_cols [B] i32. Layer inputs: seqs [B,D,L] i8
-    (pad 5), lens [B,D] i32 (0 = no layer), wts [B,D,L] i32, rlo/rhi
-    [B,D] i16 (the layer's bpos range; -32768/32767 = spanning, full
-    graph), lbase scalar i32. Returns the updated state + failed [B] bool.
+    (pad 5), lens [B,D] i32 (0 = no layer), wts [B,D,L] i8 (Phred-33
+    weights <= 93; upcast on device — a quarter of the host->device
+    bytes), rlo/rhi [B,D] i16 (the layer's bpos range; -32768/32767 =
+    spanning, full graph), lbase scalar i32. Returns the updated state +
+    failed [B] bool.
     """
     import jax
     import jax.numpy as jnp
@@ -410,7 +412,8 @@ def fused_builder(n_nodes: int, seq_len: int, depth: int, max_pred: int,
         tails = target[:, :-1]
         heads = target[:, 1:]
         epresent = inlen[:, 1:] & inlen[:, :-1] & okm
-        ew = (wts[:, :-1] + wts[:, 1:]).astype(jnp.int32)
+        w32 = wts.astype(jnp.int32)
+        ew = w32[:, :-1] + w32[:, 1:]
         hclip = jnp.clip(heads, 0, N - 1)
         hpred = jnp.take_along_axis(preds, hclip[:, :, None], axis=1)
         match_slot = (hpred == tails[:, :, None]) & (tails[:, :, None] >= 0)
@@ -463,7 +466,7 @@ def fused_builder(n_nodes: int, seq_len: int, depth: int, max_pred: int,
 def _weights_of(qual, length):
     if qual:
         w = np.frombuffer(qual, np.uint8).astype(np.int32) - 33
-        return np.clip(w, 0, None)
+        return np.clip(w, 0, 127)  # Phred <= 93; int8-safe by contract
     return np.ones(length, dtype=np.int32)
 
 
@@ -479,7 +482,7 @@ class FusedPOA:
     def __init__(self, match: int, mismatch: int, gap: int,
                  num_threads: int = 1, logger: Logger | None = None,
                  max_nodes: int = MAX_NODES, max_len: int = MAX_LEN,
-                 max_pred: int = MAX_PRED, batch_rows: int = 32,
+                 max_pred: int = MAX_PRED, batch_rows: int | None = None,
                  depth_buckets=DEPTH_BUCKETS):
         self.match = match
         self.mismatch = mismatch
@@ -489,11 +492,28 @@ class FusedPOA:
         self.N = max_nodes
         self.L = max_len
         self.P = max_pred
-        self.B = batch_rows
+        self.B = batch_rows if batch_rows else self._pin_rows()
         self.depth_buckets = tuple(depth_buckets)
         self._code_of = np.full(256, 4, dtype=np.int8)
         for i, b in enumerate(b"ACGT"):
             self._code_of[b] = i
+
+    def _pin_rows(self) -> int:
+        """ONE pinned batch width from the device free-memory query (the
+        90%-of-free-VRAM rule, cudapolisher.cpp:169-173,230-239). Wider
+        batches are nearly free on the VPU — the whole workload should fit
+        ONE chunk when memory allows, because sequential depth (layers x
+        graph rows) and launch count are the real costs; /3 keeps two
+        pipelined chunks' DP state plus slack in flight."""
+        import jax
+
+        from .poa_graph import _device_budget, pin_pow2_rows
+
+        h = (self.N + 1) * (self.L + 1) * 4     # DP score carry, per row
+        bps = self.N * (self.L + 1)             # backpointer stack, per row
+        state = self.N * (2 * self.P * 3 + 30)  # graph arrays, per row
+        return pin_pow2_rows(_device_budget(jax.devices()) // 3,
+                             h + bps + state)
 
     def _eligible(self, win) -> bool:
         bb_len = len(win[0][0])
@@ -534,7 +554,7 @@ class FusedPOA:
             state = self._init_state([b"AC"], [np.ones(2, np.int32)])
             seqs = np.full((self.B, d, self.L), 5, np.int8)
             lens = np.zeros((self.B, d), np.int32)
-            wts = np.zeros((self.B, d, self.L), np.int32)
+            wts = np.zeros((self.B, d, self.L), np.int8)
             rlo = np.full((self.B, d), -32768, np.int16)
             rhi = np.full((self.B, d), 32767, np.int16)
             band = np.zeros((self.B, d), np.int32)
@@ -640,7 +660,7 @@ class FusedPOA:
         for d in self._chain_plan(depth):
             seqs = np.full((self.B, d, self.L), 5, np.int8)
             lens = np.zeros((self.B, d), np.int32)
-            wts = np.zeros((self.B, d, self.L), np.int32)
+            wts = np.zeros((self.B, d, self.L), np.int8)
             rlo = np.full((self.B, d), -32768, np.int16)
             rhi = np.full((self.B, d), 32767, np.int16)
             band = np.zeros((self.B, d), np.int32)

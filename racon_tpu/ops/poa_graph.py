@@ -94,6 +94,15 @@ def _bytes_per_row(n_nodes: int, seq_len: int, max_pred: int) -> int:
     return h + bp + inputs
 
 
+def pin_pow2_rows(budget: int, per_row: int, lo: int = 8,
+                  hi: int = 128) -> int:
+    """Shared batch-width pinning policy: the largest power of two whose
+    rows fit `budget`, clamped to [lo, hi] — ONE size per program so the
+    compile count stays fixed."""
+    b = 1 << max(0, (budget // max(per_row, 1)).bit_length() - 1)
+    return max(lo, min(hi, b))
+
+
 def _device_budget(devices) -> int:
     """Free device memory to size batches from — queried from the chip
     like the reference's cudaMemGetInfo 90% rule
@@ -314,8 +323,7 @@ class DeviceGraphPOA:
         else:
             budget = _device_budget(self.runner.devices) // 4
             row = _bytes_per_row(bucket[0], bucket[1], self.max_pred)
-            b = 1 << max(0, (budget // max(row, 1)).bit_length() - 1)
-            b = max(8, min(128, b))
+            b = pin_pow2_rows(budget, row)
         return max(n_dev, (b // n_dev) * n_dev)
 
     def precompile(self) -> None:

@@ -72,9 +72,12 @@ def run(sequences: str, overlaps: str, target_sequences: str,
                 # every shard must have work: silently-empty shard output
                 # looks like a failed run to gather scripts
                 raise RaconError(
-                    "wrapper", f"num_shards {num_shards} exceeds the "
-                    f"{len(targets)} target chunk(s) --split produced; "
-                    "use a smaller --split size or fewer shards")
+                    "wrapper",
+                    f"num_shards {num_shards} exceeds the {len(targets)} "
+                    "target chunk(s); " +
+                    ("use a smaller --split size or fewer shards"
+                     if split is not None else
+                     "--num-shards needs --split to make chunks to scatter"))
             lo = shard_id * len(targets) // num_shards
             hi = (shard_id + 1) * len(targets) // num_shards
             print(f"[racon_tpu::wrapper] shard {shard_id}/{num_shards}: "

@@ -125,8 +125,11 @@ def test_wrapper_shards_concatenate_to_unsharded(tmp_path):
             num_shards=num_shards, shard_id=shard_id, out=out)
         return out.getvalue()
 
+    # the split geometry itself: four one-contig chunks to scatter
+    assert len(rampler.split(str(tgt), 9_500, str(tmp_path))) == 4
+
     whole = polish()
-    assert whole.count(b">") == 4  # split actually made multiple chunks
+    assert whole.count(b">") == 4
     sharded = polish(2, 0) + polish(2, 1)
     assert sharded == whole
 
