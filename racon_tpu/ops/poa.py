@@ -124,9 +124,12 @@ class BatchPOA:
                                       "session") == "host")
             results, statuses = fused.consensus(packed, fallback=to_host)
             rest = [i for i, r in enumerate(results) if r is None]
+            fs = fused.last_stats
             print(f"[racon_tpu::BatchPOA] fused engine built "
-                  f"{int((statuses == 0).sum())} windows; "
-                  f"{fused.n_fallback} to "
+                  f"{int((statuses == 0).sum())} windows "
+                  f"({fs['chunks']} chunks, {fs['launches']} device "
+                  f"launches, dispatch {fs['dispatch_s']:.2f}s, finalize "
+                  f"{fs['finalize_s']:.2f}s); {fused.n_fallback} to "
                   f"{'host' if to_host else 'session'} engine",
                   file=sys.stderr)
             if rest:
@@ -151,7 +154,7 @@ class BatchPOA:
         for w, (cons, cov) in zip(todo, results):
             w.apply_trim(cons, cov, trim)
         stats = getattr(engine, "last_stats", None) or {}
-        if stats:
+        if "committed" in stats:
             print(f"[racon_tpu::BatchPOA] device layer alignments: "
                   f"{stats['committed']} committed, {stats['redos']} "
                   "banded-clip full-DP retries", file=sys.stderr)

@@ -61,6 +61,7 @@ Requires jax x64 (the order keys are int64); enabled at kernel build.
 from __future__ import annotations
 
 import functools
+import time
 
 import numpy as np
 
@@ -463,6 +464,28 @@ def fused_builder(n_nodes: int, seq_len: int, depth: int, max_pred: int,
     return jax.jit(run, donate_argnums=donate)
 
 
+@functools.lru_cache(maxsize=None)
+def _pinned_rows(n_nodes: int, seq_len: int, max_pred: int) -> int:
+    """ONE pinned batch width per envelope from the device free-memory
+    query (the 90%-of-free-VRAM rule, cudapolisher.cpp:169-173,230-239).
+    Wider batches are nearly free on the VPU — the whole workload should
+    fit ONE chunk when memory allows, because sequential depth (layers x
+    graph rows) and launch count are the real costs; /3 keeps two
+    pipelined chunks' DP state plus slack in flight. Cached per process:
+    jit programs are shape-keyed on B, so the width the bench precompiles
+    must be the width the polish run uses even though precompile's own
+    buffers shrink the free-memory reading in between."""
+    import jax
+
+    from .poa_graph import _device_budget, pin_pow2_rows
+
+    h = (n_nodes + 1) * (seq_len + 1) * 4       # DP score carry, per row
+    bps = n_nodes * (seq_len + 1)               # backpointer stack, per row
+    state = n_nodes * (2 * max_pred * 3 + 30)   # graph arrays, per row
+    return pin_pow2_rows(_device_budget(jax.devices()) // 3,
+                         h + bps + state)
+
+
 def _weights_of(qual, length):
     if qual:
         w = np.frombuffer(qual, np.uint8).astype(np.int32) - 33
@@ -494,26 +517,14 @@ class FusedPOA:
         self.P = max_pred
         self.B = batch_rows if batch_rows else self._pin_rows()
         self.depth_buckets = tuple(depth_buckets)
+        self.last_stats = {"chunks": 0, "launches": 0,
+                           "dispatch_s": 0.0, "finalize_s": 0.0}
         self._code_of = np.full(256, 4, dtype=np.int8)
         for i, b in enumerate(b"ACGT"):
             self._code_of[b] = i
 
     def _pin_rows(self) -> int:
-        """ONE pinned batch width from the device free-memory query (the
-        90%-of-free-VRAM rule, cudapolisher.cpp:169-173,230-239). Wider
-        batches are nearly free on the VPU — the whole workload should fit
-        ONE chunk when memory allows, because sequential depth (layers x
-        graph rows) and launch count are the real costs; /3 keeps two
-        pipelined chunks' DP state plus slack in flight."""
-        import jax
-
-        from .poa_graph import _device_budget, pin_pow2_rows
-
-        h = (self.N + 1) * (self.L + 1) * 4     # DP score carry, per row
-        bps = self.N * (self.L + 1)             # backpointer stack, per row
-        state = self.N * (2 * self.P * 3 + 30)  # graph arrays, per row
-        return pin_pow2_rows(_device_budget(jax.devices()) // 3,
-                             h + bps + state)
+        return _pinned_rows(self.N, self.L, self.P)
 
     def _eligible(self, win) -> bool:
         bb_len = len(win[0][0])
@@ -615,8 +626,13 @@ class FusedPOA:
         if self.logger is not None and fused_idx:
             self.logger.bar_total(len(fused_idx))
 
+        self.last_stats = stats = {"chunks": 0, "launches": 0,
+                                   "dispatch_s": 0.0, "finalize_s": 0.0}
+
         def _done(chunk, state):
+            t = time.perf_counter()
             self._finalize_chunk(chunk, state, results, statuses)
+            stats["finalize_s"] += time.perf_counter() - t
             if bar is not None:
                 for _ in chunk:
                     bar("[racon_tpu::Polisher.polish] "
@@ -629,7 +645,10 @@ class FusedPOA:
         pending = None
         for s in range(0, len(fused_idx), self.B):
             chunk = fused_idx[s:s + self.B]
+            t = time.perf_counter()
             state = self._dispatch_chunk(windows, chunk)
+            stats["dispatch_s"] += time.perf_counter() - t
+            stats["chunks"] += 1
             if pending is not None:
                 _done(*pending)
             pending = (chunk, state)
@@ -657,18 +676,21 @@ class FusedPOA:
         state = self._init_state(backbones, bweights)
         depth = max(len(windows[i]) - 1 for i in chunk)
         done = 0
-        for d in self._chain_plan(depth):
+        plan = self._chain_plan(depth)
+        self.last_stats["launches"] += len(plan)
+        # per-window constants, hoisted out of the chained-call loop:
+        # layer order is a stable sort by begin, the host engine's visit
+        # order (reference window.cpp:84-85)
+        metas = [(sorted(windows[i][1:], key=lambda s: s[2]),
+                  len(windows[i][0][0])) for i in chunk]
+        for d in plan:
             seqs = np.full((self.B, d, self.L), 5, np.int8)
             lens = np.zeros((self.B, d), np.int32)
             wts = np.zeros((self.B, d, self.L), np.int8)
             rlo = np.full((self.B, d), -32768, np.int16)
             rhi = np.full((self.B, d), 32767, np.int16)
             band = np.zeros((self.B, d), np.int32)
-            for k, i in enumerate(chunk):
-                # layer order: stable sort by begin, the host engine's
-                # visit order (reference window.cpp:84-85)
-                layers = sorted(windows[i][1:], key=lambda s: s[2])
-                bb_len = len(windows[i][0][0])
+            for k, (layers, bb_len) in enumerate(metas):
                 offset = int(0.01 * bb_len)
                 for dd in range(d):
                     li = done + dd
