@@ -84,6 +84,14 @@ _CYCLE_JOBS = 1024
 _NEG = -(1 << 29)  # matches the host engine's kNegInf (INT32_MIN / 4)
 
 
+def _materialize(out) -> np.ndarray:
+    """Block on one dispatched batch's results; multi-device pallas
+    dispatches come back as a per-device list of shards."""
+    if isinstance(out, list):
+        return np.concatenate([np.asarray(o) for o in out])
+    return np.asarray(out)
+
+
 def _bytes_per_row(n_nodes: int, seq_len: int, max_pred: int) -> int:
     """Peak device bytes one batch row costs while its program runs: the
     H score carry, the backpointer stack (plus its traceback copy), and
@@ -291,8 +299,16 @@ class DeviceGraphPOA:
                  max_nodes: int = MAX_NODES, max_len: int = MAX_LEN,
                  max_pred: int = MAX_PRED, buckets=None,
                  batch_rows: int | None = None, cycle_jobs: int = _CYCLE_JOBS,
-                 banded_only: bool = False):
+                 banded_only: bool = False, use_pallas: bool | None = None):
+        import os as _os
+
         from ..parallel.mesh import BatchRunner
+
+        #: RACON_TPU_PALLAS=1 routes VMEM-sized buckets through the
+        #: resident pallas window-sweep kernel (ops/poa_pallas.py) instead
+        #: of the XLA scan program — experimental until profiled on chip
+        self.use_pallas = (bool(_os.environ.get("RACON_TPU_PALLAS"))
+                           if use_pallas is None else use_pallas)
 
         self.match = match
         self.mismatch = mismatch
@@ -332,8 +348,7 @@ class DeviceGraphPOA:
         compiles were the prime suspect in the on-chip failure)."""
         for (nb, lb) in self.buckets:
             B = self.batch_rows[(nb, lb)]
-            fn = graph_aligner(nb, lb, self.max_pred, self.match,
-                               self.mismatch, self.gap)
+            fn, wants_nnodes = self._kernel(nb, lb)
             # a valid tiny problem: linear 2-node chain, 2-base layer
             codes = np.full((B, nb), 5, dtype=np.int8)
             codes[:, :2] = 0
@@ -348,9 +363,14 @@ class DeviceGraphPOA:
             seq[:, :2] = 0
             lens = np.full(B, 2, dtype=np.int32)
             band = np.zeros(B, dtype=np.int32)
-            out = self.runner.run(fn, codes, preds, centers, sinks, seq,
-                                  lens, band)
-            np.asarray(out)  # block
+            if wants_nnodes:
+                out = self._run_pallas(fn, codes, preds, centers, sinks,
+                                       seq, lens, band,
+                                       np.full(B, 2, dtype=np.int32))
+            else:
+                out = self.runner.run(fn, codes, preds, centers, sinks,
+                                      seq, lens, band)
+            _materialize(out)  # block
 
     def _bucket(self, n_nodes: int, length: int) -> tuple[int, int]:
         return next((nb, lb) for nb, lb in self.buckets
@@ -413,7 +433,7 @@ class DeviceGraphPOA:
             # commit the oldest batch (blocks only on ITS device result;
             # younger batches keep computing via async dispatch)
             win, layer, band, npart, lb, out = inflight.popleft()
-            ranks = np.asarray(out)[:npart, :lb]
+            ranks = _materialize(out)[:npart, :lb]
             session.commit(win, layer, band, ranks)
             freed += npart
             if bar is not None:
@@ -461,9 +481,25 @@ class DeviceGraphPOA:
                 batches.append(meta + (len(part), lb, out))
         return batches
 
+    def _kernel(self, nb, lb):
+        """The compiled program for one bucket: the pallas resident-window
+        sweep when enabled and the bucket fits VMEM, else the XLA scan.
+        Returns (fn, wants_nnodes)."""
+        if self.use_pallas:
+            from .poa_pallas import fits_vmem, window_sweep
+
+            if fits_vmem(nb, lb):
+                import jax
+
+                interp = jax.default_backend() == "cpu"
+                return window_sweep(nb, lb, self.max_pred, self.match,
+                                    self.mismatch, self.gap,
+                                    interpret=interp), True
+        return graph_aligner(nb, lb, self.max_pred, self.match,
+                             self.mismatch, self.gap), False
+
     def _dispatch(self, jobs, sel, nb, lb, B):
-        fn = graph_aligner(nb, lb, self.max_pred, self.match,
-                           self.mismatch, self.gap)
+        fn, wants_nnodes = self._kernel(nb, lb)
         pad = B - len(sel)
 
         def take(arr, fill):
@@ -481,5 +517,26 @@ class DeviceGraphPOA:
         seqs = take(jobs["seqs"][:, :lb], 5)
         lens = take(jobs["len"], 0)
         band = take(jobs["band"], 0)
+        if wants_nnodes:
+            # pallas path: per-job real node count bounds its row sweep
+            return self._run_pallas(fn, codes, preds, centers, sinks,
+                                    seqs, lens, band,
+                                    take(jobs["nnodes"], 0))
         return self.runner.run(fn, codes, preds, centers, sinks, seqs,
                                lens, band)
+
+    def _run_pallas(self, fn, *args):
+        """Run the pallas sweep across every device: the grid is
+        sequential per core, so the batch is split device-wise (the
+        batch width is already a multiple of n_devices, _pin_batch) and
+        each shard dispatched async to its chip — the multi-GPU
+        batch-per-device loop of cudapolisher.cpp:228-345."""
+        devs = self.runner.devices
+        if len(devs) == 1:
+            return fn(*args)
+        import jax
+
+        per = args[0].shape[0] // len(devs)
+        return [fn(*(jax.device_put(a[i * per:(i + 1) * per], d)
+                     for a in args))
+                for i, d in enumerate(devs)]

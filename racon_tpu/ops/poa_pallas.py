@@ -1,0 +1,216 @@
+"""Pallas TPU kernel for whole-window graph-banded NW + traceback.
+
+The within-kernel half of GenomeWorks cudapoa, TPU-shaped. cudapoa runs
+one POA group per CUDA block with the working set in shared memory
+(SURVEY.md §2c-6); this kernel runs one (window, layer) job per
+sequential grid step with the ENTIRE job resident in VMEM:
+
+  - the full score matrix H [N+1, L+1] i32 (~5.3 MB at the largest
+    bucket) and the backpointer matrix live in VMEM scratch — the row
+    sweep never touches HBM;
+  - the virtual source is H row 0, and predecessor rows are scalar
+    dynamic slices (one window per step means predecessor ranks are
+    scalars — no per-lane gather problem);
+  - the row loop runs to THIS job's real node count (dynamic bound), not
+    the bucket's padded N;
+  - the traceback is in-kernel (scalar pointer chase over the VMEM
+    backpointers), so the kernel's only output is the final per-base
+    node ranks — nothing else leaves the chip.
+
+DP values, band masking and tie-breaking replicate
+ops/poa_graph.graph_aligner exactly (same formulas, same int32
+arithmetic), so consensus byte-identity is preserved;
+tests/test_pallas_poa.py fuzzes this kernel against the XLA one in
+interpret mode. The trade against the XLA kernel: the XLA program
+vectorizes one DP row across the whole batch ([B, L] per step) but pays
+HBM for every row and ~N+L while-loop steps of traceback per batch; this
+kernel's vectors are [L]-wide but every access is VMEM and the whole
+sweep is one fused loop. Which wins is a hardware question — the kernel
+is enabled with RACON_TPU_PALLAS=1 (default off until profiled on chip),
+and the dispatcher falls back to the XLA program for shapes the VMEM
+budget cannot hold.
+"""
+
+from __future__ import annotations
+
+import functools
+
+_NEG = -(1 << 29)
+
+#: VMEM the resident job may use (scores + backpointers + slack); the
+#: largest session bucket (2048, 640) needs ~10.6 MB of the ~16 MB
+VMEM_BUDGET = 14 << 20
+
+
+def fits_vmem(n_nodes: int, seq_len: int) -> bool:
+    h = (n_nodes + 1) * (seq_len + 1) * 4
+    bps = n_nodes * (seq_len + 1) * 4
+    return h + bps + (1 << 20) <= VMEM_BUDGET
+
+
+@functools.lru_cache(maxsize=None)
+def window_sweep(n_nodes: int, seq_len: int, max_pred: int, match: int,
+                 mismatch: int, gap: int, interpret: bool = False):
+    """Jitted fn(codes, preds, centers, sinks, seq, lens, band, nnodes)
+    -> ranks [B, L] i32, one grid step per batch row.
+
+    Argument layouts match graph_aligner's (codes [B,N] i8, preds
+    [B,N,P] i16 rank+1 with 0 = virtual source / -1 pad, centers [B,N]
+    i16, sinks [B,N] u8, seq [B,L] i8, lens/band [B] i32) plus nnodes
+    [B] i32 — the per-job real node count. Returns graph_aligner's rank
+    encoding (node rank, -1 insertion, -2 beyond lens).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    N, L, P = n_nodes, seq_len, max_pred
+
+    def kernel(scal_ref, codes_ref, preds_ref, centers_ref, sinks_ref,
+               seq_ref, out_ref, H, bps):
+        NEG = jnp.int32(_NEG)
+        slen = scal_ref[0, 0]
+        band = scal_ref[0, 1]
+        nn = scal_ref[0, 2]
+        jidx = jax.lax.broadcasted_iota(jnp.int32, (1, L + 1), 1)
+
+        # virtual source row: D[0][j] = j*gap within the layer
+        H[0:1, :] = jnp.where(jidx <= slen, jidx * gap, NEG)
+
+        seq2 = seq_ref[0:1, :]                                  # [1, L]
+        band2 = band // 2
+        use_band = band > 0
+
+        def row(k, carry):
+            code_k = codes_ref[0, k - 1]
+            center_k = centers_ref[0, k - 1]
+
+            rows = jnp.full((P, L + 1), NEG, dtype=jnp.int32)
+            for p in range(P):                       # static P, unrolled
+                pr = preds_ref[0, k - 1, p]
+                r2 = H[pl.ds(jnp.maximum(pr, 0), 1), :]         # [1, L+1]
+                rows = jax.lax.dynamic_update_slice(
+                    rows, jnp.where(pr >= 0, r2, NEG), (p, 0))
+
+            sub = jnp.where(seq2 == code_k, match,
+                            mismatch).astype(jnp.int32)         # [1, L]
+            diag = rows[:, :-1] + sub                           # [P, L]
+            vert = rows[:, 1:] + gap
+            best = jnp.max(jnp.maximum(diag, vert), axis=0,
+                           keepdims=True)                       # [1, L]
+            row0 = jnp.max(rows[:, 0]) + gap                    # scalar
+
+            jlo = jnp.where(use_band, jnp.maximum(1, center_k - band2), 1)
+            jhi = jnp.where(use_band, jnp.minimum(slen, center_k + band2),
+                            slen)
+            j1 = jidx[:, 1:]                                    # [1, L]
+            inb = (j1 >= jlo) & (j1 <= jhi)
+            pre = jnp.where(inb, best, NEG)
+            seed0 = jnp.where(jlo == 1, row0, NEG).reshape(1, 1)
+            cat = jnp.concatenate([seed0, pre], axis=1)         # [1, L+1]
+            # in-row gap recurrence: running max via Hillis-Steele
+            # doubling (deterministic TPU lowering; log2(L+1) steps)
+            x = cat - jidx * gap
+            s = 1
+            while s <= L:
+                shifted = jnp.concatenate(
+                    [jnp.full((1, s), NEG, jnp.int32), x[:, :-s]], axis=1)
+                x = jnp.maximum(x, shifted)
+                s <<= 1
+            run = x + jidx * gap
+            hrow = jnp.where(inb, run[:, 1:], pre)              # [1, L]
+            new_row = jnp.concatenate(
+                [jnp.full((1, 1), row0, jnp.int32), hrow], axis=1)
+
+            # backpointers, graph_aligner's encoding and tie order:
+            # diagonal via pred p -> p; vertical via pred p -> P+p;
+            # horizontal -> 2P
+            nr = new_row[:, 1:]                                 # [1, L]
+            is_diag = nr == diag                                # [P, L]
+            is_vert = nr == vert
+            pd = jnp.argmax(is_diag, axis=0)[None, :]           # [1, L]
+            pv = jnp.argmax(is_vert, axis=0)[None, :]
+            bpc = jnp.where(jnp.any(is_diag, axis=0)[None, :], pd,
+                            jnp.where(jnp.any(is_vert, axis=0)[None, :],
+                                      P + pv, 2 * P)).astype(jnp.int32)
+            is_v0 = (row0 == rows[:, 0:1] + gap)                # [P, 1]
+            bp0 = (P + jnp.argmax(is_v0, axis=0)).reshape(1, 1)
+            H[pl.ds(k, 1), :] = new_row
+            bps[pl.ds(k - 1, 1), :] = jnp.concatenate(
+                [bp0.astype(jnp.int32), bpc], axis=1)
+            return carry
+
+        jax.lax.fori_loop(1, nn + 1, row, 0)
+
+        # best sink at the layer's final column; ties -> smallest rank
+        kidx = jax.lax.broadcasted_iota(jnp.int32, (N, 1), 0)
+        col = H[:, pl.ds(slen, 1)]                              # [N+1, 1]
+        cand = jnp.where((sinks_ref[0:1, :].T > 0) & (kidx < nn),
+                         col[1:, :], NEG)                       # [N, 1]
+        best_rank = jnp.argmax(cand[:, 0]).astype(jnp.int32)
+
+        out_ref[0:1, :] = jnp.full((1, L), -2, dtype=jnp.int32)
+
+        def tb_cond(st):
+            r, j = st
+            return (r > 0) | (j > 0)
+
+        def tb_body(st):
+            r, j = st
+            code = jnp.where(r > 0,
+                             bps[jnp.maximum(r - 1, 0), jnp.maximum(j, 0)],
+                             2 * P)
+            is_diag = code < P
+            is_vert = (code >= P) & (code < 2 * P)
+            p = jnp.where(is_diag, code, code - P)
+            pr = preds_ref[0, jnp.maximum(r - 1, 0),
+                           jnp.clip(p, 0, P - 1)].astype(jnp.int32)
+            consume = jnp.logical_not(is_vert)     # diag or horizontal
+            jc = jnp.maximum(j - 1, 0)
+            old = out_ref[0, jc]
+            emit = jnp.where(is_diag, r - 1, -1)
+            out_ref[0, jc] = jnp.where(consume & (j > 0), emit, old)
+            r = jnp.where(is_diag | is_vert, pr, r)
+            j = jnp.where(consume, j - 1, j)
+            return r, j
+
+        # empty rows (nnodes == 0: batch padding) wrote no bps rows — the
+        # traceback must not start, or it would chase uninitialized
+        # scratch; start it pre-terminated instead
+        jax.lax.while_loop(tb_cond, tb_body,
+                           (jnp.where(nn > 0, best_rank + 1, 0),
+                            jnp.where(nn > 0, slen, 0)))
+
+    def call(codes, preds, centers, sinks, seq, lens, band, nnodes):
+        B = codes.shape[0]
+        scal = jnp.stack([lens.astype(jnp.int32),
+                          band.astype(jnp.int32),
+                          nnodes.astype(jnp.int32)], axis=1)    # [B, 3]
+        vmem = pltpu.VMEM
+        return pl.pallas_call(
+            kernel,
+            grid=(B,),
+            in_specs=[
+                pl.BlockSpec((1, 3), lambda b: (b, 0),
+                             memory_space=pltpu.SMEM),
+                pl.BlockSpec((1, N), lambda b: (b, 0), memory_space=vmem),
+                pl.BlockSpec((1, N, P), lambda b: (b, 0, 0),
+                             memory_space=vmem),
+                pl.BlockSpec((1, N), lambda b: (b, 0), memory_space=vmem),
+                pl.BlockSpec((1, N), lambda b: (b, 0), memory_space=vmem),
+                pl.BlockSpec((1, L), lambda b: (b, 0), memory_space=vmem),
+            ],
+            out_specs=pl.BlockSpec((1, L), lambda b: (b, 0),
+                                   memory_space=vmem),
+            out_shape=jax.ShapeDtypeStruct((B, L), jnp.int32),
+            scratch_shapes=[
+                pltpu.VMEM((N + 1, L + 1), jnp.int32),   # H
+                pltpu.VMEM((N, L + 1), jnp.int32),       # backpointers
+            ],
+            interpret=interpret,
+        )(scal, codes.astype(jnp.int32), preds.astype(jnp.int32),
+          centers.astype(jnp.int32), sinks.astype(jnp.int32),
+          seq.astype(jnp.int32))
+
+    return jax.jit(call)

@@ -1,0 +1,128 @@
+"""Pallas window-sweep kernel tests (ops/poa_pallas.py), interpret mode.
+
+The kernel must reproduce the XLA graph_aligner's output EXACTLY — same
+DP, same band masking, same tie order — because the engines' consensus
+byte-identity contract rests on it. Fuzzed on linear graphs and on real
+evolving-graph session jobs (subgraphs, bands, deep layers included).
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from test_device_poa import _make_windows, _pack, linear_graph_inputs, mutate
+
+from racon_tpu.native import PoaSession
+from racon_tpu.ops.poa_graph import graph_aligner
+from racon_tpu.ops.poa_pallas import fits_vmem, window_sweep
+
+ACGT = b"ACGT"
+
+
+def _nnodes_of(codes):
+    return (codes != 5).sum(axis=1).astype(np.int32)
+
+
+def test_pallas_matches_xla_on_linear_graphs():
+    rng = random.Random(11)
+    N, L, P = 96, 96, 4
+    ts, qs = [], []
+    for _ in range(6):
+        t = bytes(rng.choice(ACGT) for _ in range(rng.randint(40, N - 8)))
+        ts.append(t)
+        qs.append(mutate(rng, t, 0.15)[:L])
+    codes, preds, centers, sinks, seqs, lens, band = linear_graph_inputs(
+        ts, qs, N, L, max_pred=P)
+
+    xla = graph_aligner(N, L, P, 5, -4, -8)
+    pls = window_sweep(N, L, P, 5, -4, -8, interpret=True)
+    r_xla = np.asarray(xla(codes, preds, centers, sinks, seqs, lens, band))
+    r_pls = np.asarray(pls(codes, preds, centers, sinks, seqs, lens, band,
+                           _nnodes_of(codes)))
+    np.testing.assert_array_equal(r_pls, r_xla)
+
+
+def test_pallas_matches_xla_on_banded_linear_graphs():
+    rng = random.Random(21)
+    N, L, P = 96, 96, 4
+    ts, qs = [], []
+    for _ in range(4):
+        t = bytes(rng.choice(ACGT) for _ in range(80))
+        ts.append(t)
+        qs.append(mutate(rng, t, 0.1)[:L])
+    codes, preds, centers, sinks, seqs, lens, band = linear_graph_inputs(
+        ts, qs, N, L, max_pred=P)
+    band[:] = 32  # static band engages the masked recurrence + seed rule
+
+    xla = graph_aligner(N, L, P, 5, -4, -8)
+    pls = window_sweep(N, L, P, 5, -4, -8, interpret=True)
+    r_xla = np.asarray(xla(codes, preds, centers, sinks, seqs, lens, band))
+    r_pls = np.asarray(pls(codes, preds, centers, sinks, seqs, lens, band,
+                           _nnodes_of(codes)))
+    np.testing.assert_array_equal(r_pls, r_xla)
+
+
+def test_pallas_matches_xla_on_evolving_session_jobs():
+    """Every job a real session produces over whole windows — branching
+    graphs, subgraph ranges, band centers — must give identical ranks
+    from both kernels. XLA results are committed so the graphs keep
+    evolving through the full depth."""
+    rng = random.Random(31)
+    windows, _ = _make_windows(rng, 5, length=70, depth=5, rate=0.12)
+    sub, _ = _make_windows(rng, 3, length=70, depth=4, spanning=False,
+                           rate=0.1)
+    packed = [_pack(w) for w in windows + sub]
+    N, L, P = 192, 128, 8
+    session = PoaSession(packed, 3, -5, -4, N, P, L, max_jobs=64)
+
+    xla = graph_aligner(N, L, P, 3, -5, -4)
+    pls = window_sweep(N, L, P, 3, -5, -4, interpret=True)
+    rounds = 0
+    while True:
+        jobs = session.prepare()
+        if jobs is None:
+            break
+        n = jobs["n"]
+        args = (jobs["codes"][:n, :N], jobs["preds"][:n, :N, :P],
+                jobs["centers"][:n, :N], jobs["sinks"][:n, :N],
+                jobs["seqs"][:n, :L], jobs["len"][:n], jobs["band"][:n])
+        r_xla = np.asarray(xla(*args))
+        r_pls = np.asarray(pls(*args, jobs["nnodes"][:n]))
+        np.testing.assert_array_equal(r_pls, r_xla,
+                                      err_msg=f"round {rounds}")
+        session.commit(jobs["win"][:n].copy(), jobs["layer"][:n].copy(),
+                       jobs["band"][:n].copy(), r_xla)
+        rounds += 1
+    assert rounds >= 4  # the loop really exercised evolving graphs
+    session.close()
+
+
+def test_fits_vmem_envelope():
+    assert fits_vmem(2048, 640)       # the largest session bucket
+    assert fits_vmem(320, 256)
+    assert not fits_vmem(4096, 1024)  # beyond the resident budget
+
+
+def test_pallas_session_engine_byte_identical_to_host():
+    """The full device engine with the pallas kernel routed in
+    (use_pallas=True) must produce host-identical consensus — the same
+    contract the XLA path guarantees."""
+    from racon_tpu.native import poa_batch
+    from racon_tpu.ops.poa_graph import DeviceGraphPOA
+
+    rng = random.Random(41)
+    windows, _ = _make_windows(rng, 6, length=70, depth=5, rate=0.12)
+    packed = [_pack(w) for w in windows]
+
+    eng = DeviceGraphPOA(3, -5, -4, max_nodes=192, max_len=128,
+                         buckets=((192, 128),), batch_rows=8,
+                         use_pallas=True)
+    res, statuses = eng.consensus(packed)
+    host = poa_batch(packed, 3, -5, -4)
+    assert (statuses == 0).all(), statuses.tolist()
+    for i, ((dc, dcov), (hc, hcov)) in enumerate(zip(res, host)):
+        assert dc == hc, f"window {i}"
+        np.testing.assert_array_equal(dcov, hcov)
