@@ -168,6 +168,9 @@ def _run_phase(phase: str, cap: float, strict: bool):
     env = dict(os.environ)
     if strict:
         env["RACON_TPU_STRICT"] = "1"
+    # phases are separate processes; a persistent compilation cache lets
+    # later phases (and warm re-runs) reuse earlier phases' XLA compiles
+    env.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/racon_tpu_jax_cache")
     try:
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--phase", phase],
