@@ -1,0 +1,25 @@
+"""`python -m racon_tpu.native` — build the native host library ahead of
+time (it otherwise builds on first import). `--debug` builds the
+ASan+UBSan variant (the reference's sanitizer target, Makefile:23-25);
+`--force` rebuilds even when fresh."""
+
+import argparse
+import sys
+
+from . import build
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="python -m racon_tpu.native")
+    ap.add_argument("--debug", action="store_true",
+                    help="ASan+UBSan debug build (libracon_host_debug.so)")
+    ap.add_argument("--force", action="store_true",
+                    help="rebuild even if up to date")
+    args = ap.parse_args(argv)
+    path = build(force=args.force, debug=args.debug)
+    print(path)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
