@@ -1,0 +1,140 @@
+"""On-chip smoke + timing sequence (run when the TPU tunnel is up).
+
+Runs, in order, each in its own guarded subprocess with wall-clock caps:
+  1. device probe — jax init + one matmul, timed;
+  2. session-engine precompile (4 bucket programs), timed;
+  3. fused-engine precompile (sample-depth buckets), timed;
+  4. an 8-window real-data polish per engine, timed, byte-checked
+     against the host engine;
+  5. the full bench (both engines + aligner smoke + host baseline).
+
+Usage: python tools/tpu_smoke.py [--skip-bench]
+Everything is logged to stderr; the bench JSON line goes to stdout.
+The script exists so a transient tunnel window can be exploited with one
+command — round-3's lesson is that on-chip time is scarce and the first
+run must collect everything needed to diagnose performance.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+PROBE = """
+import time; t0=time.time()
+import jax
+ds = jax.devices()
+import jax.numpy as jnp
+x = jnp.ones((512,512)); (x@x).block_until_ready()
+print(f"probe: devices={ds} init+matmul={time.time()-t0:.1f}s", flush=True)
+"""
+
+SESSION_PRE = """
+import time
+from racon_tpu.ops.poa_graph import DeviceGraphPOA
+eng = DeviceGraphPOA(5, -4, -8)
+t=time.time(); eng.precompile()
+print(f"session precompile ({len(eng.buckets)} buckets, "
+      f"batch_rows={eng.batch_rows}): {time.time()-t:.1f}s", flush=True)
+"""
+
+FUSED_PRE = """
+import time
+from racon_tpu.ops.poa_fused import FusedPOA
+eng = FusedPOA(5, -4, -8)
+t=time.time(); eng.precompile(max_depth=40)
+print(f"fused precompile (B={eng.B}): {time.time()-t:.1f}s", flush=True)
+"""
+
+MINI = """
+import time
+from racon_tpu.core.polisher import create_polisher, PolisherType
+from racon_tpu.native import poa_batch
+D = "/root/reference/test/data/"
+p = create_polisher(D+"sample_reads.fastq.gz", D+"sample_overlaps.paf.gz",
+                    D+"sample_layout.fasta.gz", PolisherType.kC, 500, 10.0,
+                    0.3, True, 5, -4, -8, num_threads=1)
+p.initialize()
+wins = [w for w in p.windows if len(w.sequences) >= 3][:8]
+packed = [[(w.sequences[i], w.qualities[i], w.positions[i][0],
+            w.positions[i][1]) for i in range(len(w.sequences))]
+          for w in wins]
+host = poa_batch(packed, 5, -4, -8)
+import os
+if os.environ.get("SMOKE_ENGINE") == "fused":
+    from racon_tpu.ops.poa_fused import FusedPOA
+    eng = FusedPOA(5, -4, -8, num_threads=1)
+    t=time.time(); res, st = eng.consensus(packed, fallback=False)
+else:
+    from racon_tpu.ops.poa_graph import DeviceGraphPOA
+    eng = DeviceGraphPOA(5, -4, -8, num_threads=1)
+    t=time.time(); res, st = eng.consensus(packed)
+dt=time.time()-t
+ok = sum(int(r is not None and r[0] == h[0]) for r, h in zip(res, host))
+on_dev = int((st == 0).sum())
+print(f"mini polish ({os.environ.get('SMOKE_ENGINE','session')}): "
+      f"{ok}/{len(wins)} byte-identical, {on_dev}/{len(wins)} device-built, "
+      f"{dt:.1f}s incl. compile", flush=True)
+# a smoke pass requires the DEVICE to have done the work — silent host
+# fallback must fail the step, or a dead device path green-lights
+assert ok == len(wins), "consensus diverged from host"
+assert on_dev == len(wins), "windows fell back off the device"
+"""
+
+
+def step(name: str, code: str, cap: float, env_extra=None) -> bool:
+    env = dict(os.environ, **(env_extra or {}))
+    env.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/racon_tpu_jax_cache")
+    t = time.time()
+    try:
+        proc = subprocess.run([sys.executable, "-c", code], timeout=cap,
+                              cwd=REPO, env=env, capture_output=True,
+                              text=True)
+    except subprocess.TimeoutExpired as e:
+        # the partial output is the diagnosis — never drop it
+        for stream in (e.stdout, e.stderr):
+            if stream:
+                text = (stream.decode(errors="replace")
+                        if isinstance(stream, bytes) else stream)
+                sys.stderr.write(text[-3000:])
+        print(f"[smoke] {name}: TIMEOUT after {cap:.0f}s", file=sys.stderr)
+        return False
+    sys.stderr.write(proc.stderr[-3000:])
+    for line in proc.stdout.splitlines():
+        print(f"[smoke] {name}: {line}", file=sys.stderr)
+    print(f"[smoke] {name}: rc={proc.returncode} wall={time.time()-t:.1f}s",
+          file=sys.stderr)
+    return proc.returncode == 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--skip-bench", action="store_true")
+    args = ap.parse_args()
+
+    if not step("probe", PROBE, 420):
+        print("[smoke] tunnel unreachable — aborting", file=sys.stderr)
+        return 1
+    ok = [
+        step("session-precompile", SESSION_PRE, 600),
+        step("fused-precompile", FUSED_PRE, 600),
+        step("mini-session", MINI, 600),
+        step("mini-fused", MINI, 600, {"SMOKE_ENGINE": "fused"}),
+    ]
+    if not args.skip_bench:
+        env = dict(os.environ)
+        env.setdefault("RACON_TPU_POA_BATCHES", "1")
+        proc = subprocess.run([sys.executable,
+                               os.path.join(REPO, "bench.py")], cwd=REPO,
+                              env=env)
+        return proc.returncode or int(not all(ok))
+    return int(not all(ok))
+
+
+if __name__ == "__main__":
+    sys.exit(main())
