@@ -22,7 +22,7 @@ vectorized scatters:
     alternate or new node in column, insertion -> new node + new column),
     prefix-sum node allocation, and conflict-free scatter wiring of edges,
     edge weights (w[i-1] + w[i], the endpoint-sum convention of
-    native/src/poa.cpp add_alignment), sequence counts and out-degrees.
+    native/src/poa.cpp add_alignment) and sequence counts.
     No sequential walk anywhere in the ingest;
   - windows that exceed any envelope (nodes, columns, in-degree P, key
     spacing) raise a per-window `failed` flag and fall back to the host
@@ -86,8 +86,8 @@ def fused_builder(n_nodes: int, seq_len: int, depth: int, max_pred: int,
     """Jitted whole-window POA builder for one (N, L, D, P) shape.
 
     State arrays (leading dim B): codes [B,N] i8 (-1 free), preds [B,N,P]
-    i16 node ids (-1 empty), predw [B,N,P] i32, nseq [B,N] i32, outdeg
-    [B,N] i16, col_of [B,N] i16, colkey [B,N] i64, colnodes [B,N,5] i16,
+    i16 node ids (-1 empty), predw [B,N,P] i32, nseq [B,N] i32,
+    col_of [B,N] i16, colkey [B,N] i64, colnodes [B,N,5] i16,
     bpos [B,N] i16, n_nodes/n_cols [B] i32. Layer inputs: seqs [B,D,L] i8
     (pad 5), lens [B,D] i32 (0 = no layer), wts [B,D,L] i8 (Phred-33
     weights <= 93; upcast on device — a quarter of the host->device
@@ -226,7 +226,7 @@ def fused_builder(n_nodes: int, seq_len: int, depth: int, max_pred: int,
                 (a[1] | b[1]))
 
     def one_layer(state, layer):
-        (codes, preds, predw, nseq, outdeg, col_of, colkey, colnodes,
+        (codes, preds, predw, nseq, col_of, colkey, colnodes,
          bpos, n_nodes, n_cols, failed) = state
         seq, slen, wts, rlo, rhi, band, lidx = layer
         B = codes.shape[0]
@@ -435,21 +435,17 @@ def fused_builder(n_nodes: int, seq_len: int, depth: int, max_pred: int,
         flat_w = flat_w.at[rows_b[:, None], ppos].add(ew, mode="drop")
         preds = flat_p.reshape(B, N, P)
         predw = flat_w.reshape(B, N, P)
-        tpos = jnp.where(eok & ~has_match,
-                         jnp.clip(tails, 0, N - 1), N + 1)
-        outdeg = outdeg.at[rows_b[:, None], tpos].add(1, mode="drop")
-
         n_nodes = jnp.where(
             ok, n_nodes + new_node.sum(axis=1, dtype=jnp.int32), n_nodes)
         n_cols = jnp.where(
             ok, n_cols + insertion.sum(axis=1, dtype=jnp.int32), n_cols)
-        return ((codes, preds, predw, nseq, outdeg, col_of, colkey,
+        return ((codes, preds, predw, nseq, col_of, colkey,
                  colnodes, bpos, n_nodes, n_cols, failed), None)
 
-    def run(codes, preds, predw, nseq, outdeg, col_of, colkey, colnodes,
+    def run(codes, preds, predw, nseq, col_of, colkey, colnodes,
             bpos, n_nodes, n_cols, failed, seqs, lens, wts, rlo, rhi,
             band, lbase):
-        state = (codes, preds, predw, nseq, outdeg, col_of, colkey,
+        state = (codes, preds, predw, nseq, col_of, colkey,
                  colnodes, bpos, n_nodes, n_cols, failed)
         state, _ = jax.lax.scan(
             one_layer, state,
@@ -460,7 +456,7 @@ def fused_builder(n_nodes: int, seq_len: int, depth: int, max_pred: int,
     # donate the state buffers on accelerators so chained calls mutate in
     # place instead of allocating a second copy of the graph arrays (the
     # CPU test backend can't donate and would warn on every call)
-    donate = () if jax.default_backend() == "cpu" else tuple(range(12))
+    donate = () if jax.default_backend() == "cpu" else tuple(range(11))
     return jax.jit(run, donate_argnums=donate)
 
 
@@ -578,7 +574,6 @@ class FusedPOA:
         preds = np.full((B, N, P), -1, dtype=np.int16)
         predw = np.zeros((B, N, P), dtype=np.int32)
         nseq = np.zeros((B, N), dtype=np.int32)
-        outdeg = np.zeros((B, N), dtype=np.int16)
         col_of = np.full((B, N), -1, dtype=np.int16)
         colkey = np.zeros((B, C), dtype=np.int64)
         colnodes = np.full((B, C, 5), -1, dtype=np.int16)
@@ -595,11 +590,10 @@ class FusedPOA:
             bpos[k, :m] = np.arange(m)
             preds[k, 1:m, 0] = np.arange(m - 1)
             predw[k, 1:m, 0] = w[:-1] + w[1:]
-            outdeg[k, :m - 1] = 1
             nseq[k, :m] = 1
             n_nodes[k] = m
             n_cols[k] = m
-        return (codes, preds, predw, nseq, outdeg, col_of, colkey,
+        return (codes, preds, predw, nseq, col_of, colkey,
                 colnodes, bpos, n_nodes, n_cols, failed)
 
     def consensus(self, windows, fallback: bool = True):
@@ -724,7 +718,7 @@ class FusedPOA:
     def _finalize_chunk(self, chunk, state, results, statuses):
         from ..native import poa_finish_arrays
 
-        (codes, preds, predw, nseq, outdeg, col_of, colkey, colnodes,
+        (codes, preds, predw, nseq, col_of, colkey, colnodes,
          bpos, n_nodes, n_cols, failed) = (np.asarray(x) for x in state)
         okrows = [k for k in range(len(chunk)) if not failed[k]]
         if okrows:
