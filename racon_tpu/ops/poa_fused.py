@@ -66,6 +66,7 @@ import time
 import numpy as np
 
 from ..utils.logger import Logger
+from .poa_graph import RING
 
 #: engine envelope: max nodes / columns per window graph, max layer len,
 #: max in-degree (same node budget as the session engine, measured on the
@@ -107,16 +108,26 @@ def fused_builder(n_nodes: int, seq_len: int, depth: int, max_pred: int,
 
     def dp_align(codes_r, preds_r, sinks_r, centers_r, band, seq, slen, B,
                  kmax):
+        # ring carry: only the last RING DP rows stay resident (slot 0 =
+        # virtual source) — valid because the caller fails any lane whose
+        # predecessor distance exceeds the ring (measured max on real
+        # data: 29); the score at each lane's sink column is collected
+        # into a side carry as rows retire
+        W = RING
         jidx = jnp.arange(L + 1, dtype=jnp.int32)
         h0 = jnp.where(jidx[None, :] <= slen[:, None], jidx[None, :] * gap,
                        NEG).astype(jnp.int32)
-        H = jnp.full((B, N + 1, L + 1), NEG, dtype=jnp.int32)
+        H = jnp.full((B, W + 1, L + 1), NEG, dtype=jnp.int32)
         H = H.at[:, 0, :].set(h0)
+        scores0 = jnp.full((B, N), NEG, dtype=jnp.int32)
         band2 = (band // 2).astype(jnp.int32)
 
-        def step(H, xs):
+        def step(carry, xs):
+            H, scores = carry
             code_k, preds_k, center_k, k = xs
-            pk = jnp.clip(preds_k, 0, N)
+            pk = jnp.where(preds_k > 0,
+                           1 + jax.lax.rem(preds_k - 1, jnp.int32(W)), 0)
+            pk = jnp.clip(pk, 0, W)
             rows = jnp.take_along_axis(H, pk[:, :, None], axis=1)
             rows = jnp.where((preds_k >= 0)[:, :, None], rows, NEG)
             sub = jnp.where(seq == code_k[:, None], match,
@@ -152,9 +163,13 @@ def fused_builder(n_nodes: int, seq_len: int, depth: int, max_pred: int,
             bp0 = P + jnp.argmax(is_v0, axis=1).astype(jnp.int32)
             bp_row = jnp.concatenate([bp0[:, None], bpc],
                                      axis=1).astype(jnp.int8)
+            slot = 1 + jax.lax.rem(k - 1, jnp.int32(W))
             H = jax.lax.dynamic_update_slice(
-                H, new_row[:, None, :], (jnp.int32(0), k, jnp.int32(0)))
-            return H, bp_row
+                H, new_row[:, None, :], (jnp.int32(0), slot, jnp.int32(0)))
+            sc = jnp.take_along_axis(new_row, slen[:, None], axis=1)
+            scores = jax.lax.dynamic_update_slice(
+                scores, sc, (jnp.int32(0), k - 1))
+            return (H, scores), bp_row
 
         # row loop bounded by the batch's real node count (graphs start at
         # backbone size ~N/4 and grow layer by layer — a static N-step
@@ -162,24 +177,21 @@ def fused_builder(n_nodes: int, seq_len: int, depth: int, max_pred: int,
         bps0 = jnp.zeros((N, B, L + 1), dtype=jnp.int8)
 
         def row(k, carry):
-            H, bps = carry
+            hs, bps = carry
             code_k = jax.lax.dynamic_slice_in_dim(
                 codes_r, k - 1, 1, axis=1)[:, 0]
             preds_k = jax.lax.dynamic_slice_in_dim(
                 preds_r, k - 1, 1, axis=1)[:, 0]
             center_k = jax.lax.dynamic_slice_in_dim(
                 centers_r, k - 1, 1, axis=1)[:, 0]
-            H, bp_row = step(H, (code_k, preds_k, center_k, k))
+            hs, bp_row = step(hs, (code_k, preds_k, center_k, k))
             bps = jax.lax.dynamic_update_slice(
                 bps, bp_row[None], (k - 1, jnp.int32(0), jnp.int32(0)))
-            return H, bps
+            return hs, bps
 
-        H, bps = jax.lax.fori_loop(jnp.int32(1), kmax + 1, row, (H, bps0))
+        (_, scores), bps = jax.lax.fori_loop(
+            jnp.int32(1), kmax + 1, row, ((H, scores0), bps0))
 
-        flat_h = H.reshape(B, (N + 1) * (L + 1))
-        ridx = (jnp.arange(1, N + 1, dtype=jnp.int32)[None, :] * (L + 1)
-                + slen[:, None])
-        scores = jnp.take_along_axis(flat_h, ridx, axis=1)
         cand = jnp.where(sinks_r, scores, NEG)
         best_rank = jnp.argmax(cand, axis=1).astype(jnp.int32)
 
@@ -268,6 +280,12 @@ def fused_builder(n_nodes: int, seq_len: int, depth: int, max_pred: int,
         no_pred = (~pr_ok).all(axis=2) & in_range_r
         pr_rank = pr_rank.at[:, :, 0].set(
             jnp.where(no_pred, 0, pr_rank[:, :, 0]))
+        # dp_align's carry holds only the last RING rows — a lane with a
+        # longer predecessor reach would read retired rows; fail it to
+        # the host engine (never seen on real data: measured max 29)
+        kk1 = jnp.arange(1, N + 1, dtype=jnp.int32)[None, :, None]
+        ring_fail = ((pr_rank > 0) &
+                     (kk1 - pr_rank > RING)).any(axis=(1, 2))
 
         has_succ = jnp.zeros((B, N + 2), dtype=bool)
         succ_pos = jnp.where(pr_ok & in_range_r[:, :, None],
@@ -378,7 +396,8 @@ def fused_builder(n_nodes: int, seq_len: int, depth: int, max_pred: int,
         cid = (n_cols[:, None] +
                jnp.cumsum(insertion.astype(jnp.int32), axis=1) - 1)
         overflow = (new_node & (nid >= N)) | (insertion & (cid >= C))
-        layer_fail = key_bad.any(axis=1) | overflow.any(axis=1)
+        layer_fail = (key_bad.any(axis=1) | overflow.any(axis=1)
+                      | ring_fail)
         ok = active & ~layer_fail
         okm = ok[:, None]
 

@@ -115,6 +115,57 @@ def test_graph_aligner_optimal_on_linear_graphs():
         assert got == optimal_score(q, t, 3, -5, -4), k
 
 
+def test_ring_and_full_carry_programs_identical():
+    """The ring-carry variant (last RING rows resident) must be
+    bit-identical to the full-carry program whenever predecessor
+    distances fit the ring — including banded jobs."""
+    from racon_tpu.ops.poa_graph import RING
+
+    rng = random.Random(17)
+    N, L = 192, 128
+    ts = [bytes(rng.choice(ACGT) for _ in range(rng.randrange(100, 180)))
+          for _ in range(8)]
+    qs = [(mutate(rng, t, 0.15) or b"A")[:L] for t in ts]
+    args = list(linear_graph_inputs(ts, qs, N, L))
+    full = graph_aligner(N, L, 4, 5, -4, -8, ring=0)
+    ringp = graph_aligner(N, L, 4, 5, -4, -8, ring=RING)
+    np.testing.assert_array_equal(np.asarray(ringp(*args)),
+                                  np.asarray(full(*args)))
+    args[6] = np.full(len(ts), 32, dtype=np.int32)  # banded
+    np.testing.assert_array_equal(np.asarray(ringp(*args)),
+                                  np.asarray(full(*args)))
+
+
+def test_ring_carry_boundary_distance():
+    """A back-edge of exactly RING ranks is the last ring-safe distance:
+    the ring program must still match the full program there, and the
+    dispatcher's distance measure must flag RING+1 for full-carry."""
+    from racon_tpu.ops.poa_graph import RING, max_pred_distance
+
+    rng = random.Random(23)
+    N, L = RING + 32, 96
+    t = bytes(rng.choice(ACGT) for _ in range(N - 8))
+    q = (mutate(rng, t, 0.1) or b"A")[:L]
+    args = list(linear_graph_inputs([t], [q], N, L))
+    # add a second pred with back-reach exactly RING: DP row k reads row
+    # k - RING (a deletion-like long edge)
+    k = RING + 4
+    args[1][0, k - 1, 1] = k - RING
+    assert max_pred_distance(args[1]) == RING
+    full = graph_aligner(N, L, 4, 5, -4, -8, ring=0)
+    ringp = graph_aligner(N, L, 4, 5, -4, -8, ring=RING)
+    np.testing.assert_array_equal(np.asarray(ringp(*args)),
+                                  np.asarray(full(*args)))
+    # one rank further is out of the ring: the dispatcher must see it
+    args[1][0, k - 1, 1] = k - RING - 1
+    assert max_pred_distance(args[1]) == RING + 1
+    eng = DeviceGraphPOA(5, -4, -8, max_nodes=N, max_len=L, max_pred=4,
+                         buckets=((N, L),), batch_rows=2)
+    fn_ring = eng._scan_kernel(N, L, ring_ok=True)
+    fn_full = eng._scan_kernel(N, L, ring_ok=False)
+    assert fn_full is full and fn_ring is not full
+
+
 def _make_windows(rng, n_windows, length=60, depth=6, rate=0.08,
                   spanning=True):
     windows = []
