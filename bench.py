@@ -162,21 +162,28 @@ def phase_aligner() -> int:
     return 0
 
 
-def _run_phase(phase: str, cap: float, strict: bool):
+def _run_phase(phase: str, cap: float, strict: bool, argv=None,
+               env_extra=None, expect_json: bool = True):
     """Run one phase in a subprocess under a wall-clock cap. Returns the
-    parsed JSON result dict or None."""
-    env = dict(os.environ)
+    parsed JSON result dict (or {"rc": 0} when expect_json=False), or
+    None on timeout/failure."""
+    env = dict(os.environ, **(env_extra or {}))
     if strict:
         env["RACON_TPU_STRICT"] = "1"
     # phases are separate processes; a persistent compilation cache lets
     # later phases (and warm re-runs) reuse earlier phases' XLA compiles
     env.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/racon_tpu_jax_cache")
+    cmd = argv or [sys.executable, os.path.abspath(__file__),
+                   "--phase", phase]
     try:
         proc = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), "--phase", phase],
-            capture_output=True, text=True, timeout=cap, env=env,
+            cmd, capture_output=True, text=True, timeout=cap, env=env,
             cwd=os.path.dirname(os.path.abspath(__file__)))
-    except subprocess.TimeoutExpired:
+    except subprocess.TimeoutExpired as e:
+        if e.stderr:
+            text = (e.stderr.decode(errors="replace")
+                    if isinstance(e.stderr, bytes) else e.stderr)
+            sys.stderr.write(text[-2000:])
         print(f"[bench] phase {phase}: TIMEOUT after {cap:.0f}s",
               file=sys.stderr)
         return None
@@ -185,12 +192,29 @@ def _run_phase(phase: str, cap: float, strict: bool):
         print(f"[bench] phase {phase}: rc={proc.returncode}; stdout tail: "
               f"{proc.stdout[-500:]!r}", file=sys.stderr)
         return None
+    if not expect_json:
+        return {"rc": 0}
     try:
         return json.loads(proc.stdout.strip().splitlines()[-1])
     except (ValueError, IndexError):
         print(f"[bench] phase {phase}: unparseable stdout "
               f"{proc.stdout[-500:]!r}", file=sys.stderr)
         return None
+
+
+def _run_scale(cap: float) -> None:
+    """Synthetic 250 kb / 20x polish on the fused device engine
+    (tools/synthbench.py) — a scale data point toward BASELINE.md's
+    E.-coli north star, reported on stderr only. STRICT so a device
+    failure cannot masquerade as a device scale number."""
+    tool = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "tools", "synthbench.py")
+    _run_phase("scale", cap, strict=True,
+               argv=[sys.executable, tool, "--genome-kb", "250",
+                     "--coverage", "20", "-c", "1"],
+               env_extra={"RACON_TPU_ENGINE": "fused",
+                          "RACON_TPU_FUSED_FALLBACK": "host"},
+               expect_json=False)
 
 
 def main() -> int:
@@ -232,6 +256,13 @@ def main() -> int:
             cap = min(_ALIGNER_CAP, room(_HOST_CAP + 60))
             if cap > 60:
                 _run_phase("aligner", cap, strict=True)
+            # scale phase (stderr only, never the JSON artifact): the
+            # north-star workload shape at ~5x the sample's window count,
+            # on the fused device engine — run only when THAT engine just
+            # proved itself and the budget has room
+            cap = min(480.0, room(_HOST_CAP + 60))
+            if fused_res is not None and cap > 240:
+                _run_scale(cap)
 
     # host engine measured in every run: the comparison point for the
     # device number (stderr only when a device phase succeeded); its cap
