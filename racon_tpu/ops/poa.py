@@ -113,7 +113,8 @@ class BatchPOA:
 
             fused = FusedPOA(self.match, self.mismatch, self.gap,
                              num_threads=self.num_threads,
-                             logger=self.logger)
+                             logger=self.logger,
+                             banded_only=self.banded_only)
             # RACON_TPU_FUSED_FALLBACK picks who polishes the windows the
             # fused engine cannot take (graph overflowed its envelope):
             # "session" (default) keeps the whole batch on device via the

@@ -33,13 +33,13 @@ vectorized scatters:
 
 Accuracy contract: the engine replicates the host's layer order
 (begin-sorted, window.cpp:84-85), band rule (256 when the layer fits,
-exact DP otherwise) and ingest semantics, and tests assert BYTE-IDENTITY
-to the host engine on spanning and non-spanning synthetic windows alike.
-The one intentional divergence is the banded clipped->full-DP retry
-(poa.cpp band_clipped), which this engine omits — a window whose banded
-alignment would have been clipped (rare: zero on the lambda sample) may
-differ, the reference's own GPU-divergence discipline
-(racon_test.cpp:292-496).
+exact DP otherwise), the banded clipped->full-DP retry (the host
+band_clipped rule, run on device under `lax.cond` so unclipped layers —
+the typical case — pay nothing) and ingest semantics, and tests assert
+BYTE-IDENTITY to the host engine on spanning, non-spanning and
+band-clipping windows alike. With `banded_only` (-b) the retry is
+skipped, the reference's GPU-only speed/accuracy trade
+(cudabatch.cpp:56-59).
 
 Non-spanning layers (reference window.cpp:87-103's subgraph case) are
 handled by MASKING, not extraction: every node carries its backbone
@@ -83,7 +83,8 @@ _NEG = -(1 << 29)
 
 @functools.lru_cache(maxsize=None)
 def fused_builder(n_nodes: int, seq_len: int, depth: int, max_pred: int,
-                  match: int, mismatch: int, gap: int):
+                  match: int, mismatch: int, gap: int,
+                  banded_only: bool = False):
     """Jitted whole-window POA builder for one (N, L, D, P) shape.
 
     State arrays (leading dim B): codes [B,N] i8 (-1 free), preds [B,N,P]
@@ -300,9 +301,33 @@ def fused_builder(n_nodes: int, seq_len: int, depth: int, max_pred: int,
         centers_r = (jnp.take_along_axis(bpos, order, axis=1).astype(
             jnp.int32) - origin[:, None] + 1)
 
+        kmax = jnp.max(n_nodes).astype(jnp.int32)
         ranks = dp_align(codes_r, pr_rank, sinks_r, centers_r,
-                         band.astype(jnp.int32), seq, slen, B,
-                         jnp.max(n_nodes).astype(jnp.int32))
+                         band.astype(jnp.int32), seq, slen, B, kmax)
+
+        if not banded_only:
+            # banded clipped -> full-DP retry, the host engine's rule
+            # (native/src/poa.cpp band_clipped): fewer than half the
+            # aligned columns matching means the in-band path is mismatch
+            # soup from band clipping; redo those lanes with the exact
+            # full DP. lax.cond skips the redo entirely on the (typical)
+            # layer where nothing clipped.
+            node_c = jnp.take_along_axis(
+                codes_r, jnp.clip(ranks, 0, N - 1), axis=1)
+            al = ranks >= 0
+            n_al = al.sum(axis=1)
+            n_ma = (al & (node_c == seq)).sum(axis=1)
+            clipped = (active & (band > 0) &
+                       ((n_al == 0) | (2 * n_ma < n_al)))
+
+            def _redo(_):
+                full = dp_align(codes_r, pr_rank, sinks_r, centers_r,
+                                jnp.zeros_like(band, jnp.int32), seq,
+                                slen, B, kmax)
+                return jnp.where(clipped[:, None], full, ranks)
+
+            ranks = jax.lax.cond(jnp.any(clipped), _redo,
+                                 lambda _: ranks, None)
 
         # ---- vectorized ingest
         iidx = jnp.arange(L, dtype=jnp.int32)
@@ -521,7 +546,7 @@ class FusedPOA:
                  num_threads: int = 1, logger: Logger | None = None,
                  max_nodes: int = MAX_NODES, max_len: int = MAX_LEN,
                  max_pred: int = MAX_PRED, batch_rows: int | None = None,
-                 depth_buckets=DEPTH_BUCKETS):
+                 depth_buckets=DEPTH_BUCKETS, banded_only: bool = False):
         self.match = match
         self.mismatch = mismatch
         self.gap = gap
@@ -534,6 +559,9 @@ class FusedPOA:
         self.depth_buckets = tuple(depth_buckets)
         self.last_stats = {"chunks": 0, "launches": 0,
                            "dispatch_s": 0.0, "finalize_s": 0.0}
+        # -b / banded-only: trust banded DP results (skip the clipped ->
+        # full-DP retry), the reference's GPU-only speed/accuracy trade
+        self.banded_only = banded_only
         self._code_of = np.full(256, 4, dtype=np.int8)
         for i, b in enumerate(b"ACGT"):
             self._code_of[b] = i
@@ -576,7 +604,8 @@ class FusedPOA:
                 needed.update(self._chain_plan(depth))
         for d in sorted(needed):
             fn = fused_builder(self.N, self.L, d, self.P, self.match,
-                               self.mismatch, self.gap)
+                               self.mismatch, self.gap,
+                               banded_only=self.banded_only)
             state = self._init_state([b"AC"], [np.ones(2, np.int32)])
             seqs = np.full((self.B, d, self.L), 5, np.int8)
             lens = np.zeros((self.B, d), np.int32)
@@ -726,7 +755,8 @@ class FusedPOA:
                     if abs(len(seq) - span) < 256 // 2 - 16:
                         band[k, dd] = 256
             fn = fused_builder(self.N, self.L, d, self.P, self.match,
-                               self.mismatch, self.gap)
+                               self.mismatch, self.gap,
+                               banded_only=self.banded_only)
             # state stays on device across chained calls (a fetch here
             # would round-trip ~5 MB of graph arrays per call); only the
             # final state is materialized for the host finalizer

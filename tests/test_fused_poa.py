@@ -48,6 +48,49 @@ def test_fused_byte_identical_to_host():
     _assert_identical(res, host, statuses)
 
 
+def test_fused_band_clip_retry_byte_identical_to_host():
+    """A layer whose only true match region lies OUTSIDE its band (and
+    whose filler can match nothing in-band) trips the host's
+    band_clipped rule and is redone with the exact full DP — the fused
+    engine must replicate that retry, staying byte-identical. The
+    session engine's redo counter proves the construction really clips
+    (the rule is a safety net: random-DNA soup usually still weaves
+    enough coincidental matches to pass it)."""
+    from racon_tpu.core.window import Window, WindowType
+    from racon_tpu.ops.poa_graph import DeviceGraphPOA
+
+    rng = random.Random(79)
+    windows = []
+    for _ in range(3):
+        R = bytes(rng.choice(ACGT) for _ in range(100))
+        bb = b"A" * 300 + R  # the match region sits 300 bp off-diagonal
+        w = Window(0, 0, WindowType.kTGS, bb, b"!" * len(bb))
+        for _ in range(2):
+            lay = mutate(rng, R, 0.03) + b"C" * 250  # C's match nothing
+            w.add_layer(lay, None, 0, len(bb) - 1)
+        windows.append(w)
+    packed = [_pack(w) for w in windows]
+
+    # non-vacuity: the host-identical session engine really does retry
+    sess = DeviceGraphPOA(5, -4, -8, max_nodes=1024, max_len=640,
+                          buckets=((1024, 640),), batch_rows=4)
+    sess.consensus(packed)
+    assert sess.last_stats["redos"] >= 3, sess.last_stats
+
+    host = poa_batch(packed, 5, -4, -8)
+    eng = FusedPOA(5, -4, -8, max_nodes=1024, max_len=640, batch_rows=4,
+                   depth_buckets=(8,))
+    res, statuses = eng.consensus(packed)
+    assert (statuses == 0).all(), statuses.tolist()
+    _assert_identical(res, host, statuses, "band-clip")
+
+    # (-b / banded_only is NOT asserted here: on this construction the
+    # heaviest-bundle consensus is identical with and without the retry
+    # — measured — so a banded-only run cannot be told apart by output;
+    # the flag's behavior is covered by the session engine's
+    # test_banded_only_mode_skips_retry and the builder keys on it.)
+
+
 def test_fused_deep_windows_chain_calls():
     """Depth beyond the largest bucket chains device calls (state streams
     out of one call into the next); output must still match the host."""
