@@ -68,6 +68,11 @@ usage: racon_tpu [options ...] <sequences> <overlaps> <target sequences>
             use banding approximation for alignment on TPU: banded POA
             results are trusted as-is (the clipped-result full-DP retry is
             skipped), trading exact host-engine parity for speed
+        --tpu-engine <session|fused>
+            default: session
+            device consensus engine: per-layer evolving-graph session or
+            single-launch whole-window fused (both byte-identical to the
+            host engine)
         --tpualigner-batches <int>
             default: 0
             number of device batches for TPU accelerated alignment
@@ -101,8 +106,16 @@ def parse_args(argv: list[str]) -> dict | None:
         "tpu_aligner_batches": 0,
         "tpu_aligner_band_width": 0,
         "tpu_banded_alignment": False,
+        "tpu_engine": None,
         "paths": [],
     }
+
+    def _engine_choice(v: str) -> str:
+        if v not in ("session", "fused"):
+            print("racon_tpu: --tpu-engine must be 'session' or 'fused'",
+                  file=sys.stderr)
+            sys.exit(1)
+        return v
 
     value_short = {"w": ("window_length", int),
                    "q": ("quality_threshold", float),
@@ -119,7 +132,8 @@ def parse_args(argv: list[str]) -> dict | None:
                   "gap": ("gap", int),
                   "threads": ("num_threads", int),
                   "tpualigner-batches": ("tpu_aligner_batches", int),
-                  "tpualigner-band-width": ("tpu_aligner_band_width", int)}
+                  "tpualigner-band-width": ("tpu_aligner_band_width", int),
+                  "tpu-engine": ("tpu_engine", _engine_choice)}
 
     def flag(name: str) -> bool:
         if name in ("u", "include-unpolished"):
@@ -240,7 +254,8 @@ def main(argv: list[str] | None = None) -> int:
             opts["error_threshold"], opts["trim"], opts["match"],
             opts["mismatch"], opts["gap"], opts["num_threads"],
             opts["tpu_poa_batches"], opts["tpu_banded_alignment"],
-            opts["tpu_aligner_batches"], opts["tpu_aligner_band_width"])
+            opts["tpu_aligner_batches"], opts["tpu_aligner_band_width"],
+            opts["tpu_engine"])
         polisher.initialize()
         polished = polisher.polish(opts["drop_unpolished_sequences"])
     except RaconError as exc:

@@ -44,7 +44,8 @@ def create_polisher(sequences_path: str, overlaps_path: str, target_path: str,
                     gap: int = -4, num_threads: int = 1,
                     tpu_poa_batches: int = 0, tpu_banded_alignment: bool = True,
                     tpu_aligner_batches: int = 0,
-                    tpu_aligner_band_width: int = 0) -> "Polisher":
+                    tpu_aligner_band_width: int = 0,
+                    tpu_engine: str | None = None) -> "Polisher":
     """Factory mirroring reference createPolisher (polisher.cpp:55-160).
 
     The tpu_* knobs parallel the reference's CUDA flags (main.cpp:36-41); the
@@ -63,7 +64,7 @@ def create_polisher(sequences_path: str, overlaps_path: str, target_path: str,
     return Polisher(sparser, oparser, tparser, type_, window_length,
                     quality_threshold, error_threshold, trim, match, mismatch,
                     gap, num_threads, tpu_poa_batches, tpu_banded_alignment,
-                    tpu_aligner_batches, tpu_aligner_band_width)
+                    tpu_aligner_batches, tpu_aligner_band_width, tpu_engine)
 
 
 class Polisher:
@@ -72,7 +73,8 @@ class Polisher:
                  error_threshold: float, trim: bool, match: int, mismatch: int,
                  gap: int, num_threads: int = 1, tpu_poa_batches: int = 0,
                  tpu_banded_alignment: bool = True, tpu_aligner_batches: int = 0,
-                 tpu_aligner_band_width: int = 0):
+                 tpu_aligner_band_width: int = 0,
+                 tpu_engine: str | None = None):
         self.sparser = sparser
         self.oparser = oparser
         self.tparser = tparser
@@ -89,6 +91,7 @@ class Polisher:
         self.tpu_banded_alignment = tpu_banded_alignment
         self.tpu_aligner_batches = tpu_aligner_batches
         self.tpu_aligner_band_width = tpu_aligner_band_width
+        self.tpu_engine = tpu_engine
 
         self.sequences: list[Sequence] = []
         self.windows: list[Window] = []
@@ -400,7 +403,7 @@ class Polisher:
                           device_batches=self.tpu_poa_batches,
                           banded=self.tpu_banded_alignment,
                           band_width=self.tpu_aligner_band_width,
-                          logger=self.logger)
+                          logger=self.logger, engine=self.tpu_engine)
         t_consensus = _time.perf_counter()
         with profile_ctx:
             engine.generate_consensus(self.windows, self.trim)

@@ -32,7 +32,8 @@ class BatchPOA:
     def __init__(self, match: int, mismatch: int, gap: int,
                  window_length: int, num_threads: int = 1,
                  device_batches: int = 0, banded: bool = False,
-                 band_width: int = 0, logger: Logger | None = None):
+                 band_width: int = 0, logger: Logger | None = None,
+                 engine: str | None = None):
         self.match = match
         self.mismatch = mismatch
         self.gap = gap
@@ -48,6 +49,10 @@ class BatchPOA:
         # pattern (racon_test.cpp:292-496 pins GPU numbers separately).
         self.banded_only = banded
         self.logger = logger
+        # device engine selection: explicit parameter (the CLI's
+        # --tpu-engine) wins over the RACON_TPU_ENGINE env var
+        self.engine = engine or os.environ.get("RACON_TPU_ENGINE",
+                                               "session")
 
     #: windows per host batch call (bounds peak packed-buffer memory)
     HOST_CHUNK = 4096
@@ -108,7 +113,7 @@ class BatchPOA:
         from .poa_graph import DeviceGraphPOA
 
         packed = [_pack(w) for w in todo]
-        if os.environ.get("RACON_TPU_ENGINE", "session") == "fused":
+        if self.engine == "fused":
             from .poa_fused import FusedPOA
 
             fused = FusedPOA(self.match, self.mismatch, self.gap,

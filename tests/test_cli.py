@@ -37,6 +37,15 @@ def test_full_option_mix():
     assert o["tpu_aligner_band_width"] == 64
 
 
+def test_tpu_engine_flag():
+    o = parse_args(["--tpu-engine", "fused", "r.fq", "o.paf", "t.fa"])
+    assert o["tpu_engine"] == "fused"
+    o = parse_args(["--tpu-engine=session", "r.fq", "o.paf", "t.fa"])
+    assert o["tpu_engine"] == "session"
+    with pytest.raises(SystemExit):
+        parse_args(["--tpu-engine", "warp", "r.fq", "o.paf", "t.fa"])
+
+
 def test_optional_c_argument():
     # -c with no value defaults to 1 (reference main.cpp:113-125)
     o = parse_args(["-ufc", "a.fq", "b.paf", "c.fa"])
