@@ -334,7 +334,8 @@ class BatchAligner:
                                  for ql, tl in zip(q_lens, t_lens)])
                 bp_packed, dist = runner.run(
                     kernel, q_arr, t_arr, q_lens.astype(np.int32),
-                    t_lens.astype(np.int32), offs)
+                    t_lens.astype(np.int32), offs,
+                    out_batch_axes=(1, 0))  # bp is [n_waves, B, band//4]
                 dist = np.asarray(dist).astype(np.int64)
                 bp = _unpack_bp(np.asarray(jax.device_get(bp_packed)))
                 runs, touched = _traceback(bp, offs, q_lens, t_lens)

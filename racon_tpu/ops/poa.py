@@ -104,10 +104,11 @@ class BatchPOA:
         """Device consensus over all of `todo`; unfit/failed windows are
         host-polished internally, so nothing is left over.
 
-        RACON_TPU_ENGINE selects the device engine: "session" (default,
-        the per-layer evolving-graph engine, byte-identical to host) or
-        "fused" (experimental whole-window single-launch engine,
-        ops/poa_fused.py — the cudapoa-shaped design)."""
+        `self.engine` selects the device engine — the explicit
+        constructor/CLI choice, falling back to RACON_TPU_ENGINE:
+        "session" (default, the per-layer evolving-graph engine) or
+        "fused" (whole-window single-launch engine, ops/poa_fused.py —
+        the cudapoa-shaped design); both byte-identical to host."""
         import sys
 
         from .poa_graph import DeviceGraphPOA

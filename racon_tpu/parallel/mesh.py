@@ -24,9 +24,21 @@ class BatchRunner:
     """
 
     def __init__(self, devices=None):
+        import os
+
         import jax
 
-        self.devices = list(devices) if devices is not None else jax.devices()
+        if devices is not None:
+            self.devices = list(devices)  # explicit list: caller decides
+        else:
+            self.devices = jax.devices()
+            # RACON_TPU_MAX_DEVICES caps the auto-discovered mesh
+            # (operators pinning chips; tests that don't exercise
+            # sharding keep the 8-virtual-device CPU mesh from
+            # multiplying their sequential work)
+            cap = int(os.environ.get("RACON_TPU_MAX_DEVICES", "0") or 0)
+            if cap > 0:
+                self.devices = self.devices[:cap]
         if len(self.devices) > 1:
             from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
@@ -35,6 +47,7 @@ class BatchRunner:
         else:
             self.mesh = None
             self.sharding = None
+        self._wrapped: dict = {}
 
     @property
     def n_devices(self) -> int:
@@ -45,15 +58,56 @@ class BatchRunner:
         n = self.n_devices
         return ((batch + n - 1) // n) * n
 
-    def run(self, fn, *arrays):
+    def run(self, fn, *arrays, out_batch_axes=0):
         """Invoke jitted `fn` on operands whose leading dim is the batch.
 
         All operands must share the same leading dimension, divisible by
-        the device count (use round_batch + padding).
+        the device count (use round_batch + padding). `out_batch_axes`
+        names the batch axis of each output: an int when every output
+        carries the batch on the same axis, or a tuple with one entry per
+        output of a tuple-returning kernel.
+
+        Multi-device dispatch goes through `shard_map`, so each device
+        runs an INDEPENDENT copy of the program on its batch shard — no
+        cross-device communication exists in the compiled module. Plain
+        sharded-jit would instead let XLA turn batch-wide reductions
+        (e.g. a while-loop's `jnp.any` exit test) into all-reduces, and
+        with several async batches in flight those collectives can
+        interleave across programs and deadlock the per-device rendezvous
+        (observed as an abort on the 8-virtual-device CPU test mesh; the
+        workload needs no collectives, per SURVEY.md §2c-5, so none
+        should be emitted). Per-shard loop exits are semantically
+        identical: finished lanes iterate as no-ops either way.
         """
         import jax
 
         if self.sharding is None:
             return fn(*arrays)
+        key = (fn, len(arrays), out_batch_axes)
+        shard_fn = self._wrapped.get(key)
+        if shard_fn is None:
+            from jax.sharding import PartitionSpec
+
+            def axis_spec(axis: int) -> PartitionSpec:
+                return PartitionSpec(*([None] * axis + ["batch"]))
+
+            spec = PartitionSpec("batch")
+            if isinstance(out_batch_axes, int):
+                out_specs = axis_spec(out_batch_axes)
+            else:
+                out_specs = tuple(axis_spec(a) for a in out_batch_axes)
+            # check_vma/check_rep off: the kernels mix literal-initialized
+            # and data-derived loop carries, which the varying-axes checker
+            # rejects even though every output is plainly batch-sharded
+            kwargs = dict(mesh=self.mesh, in_specs=(spec,) * len(arrays),
+                          out_specs=out_specs)
+            try:
+                shard_fn = jax.jit(jax.shard_map(fn, check_vma=False,
+                                                 **kwargs))
+            except AttributeError:  # pragma: no cover — older jax
+                from jax.experimental.shard_map import shard_map
+
+                shard_fn = jax.jit(shard_map(fn, check_rep=False, **kwargs))
+            self._wrapped[key] = shard_fn
         placed = [jax.device_put(a, self.sharding) for a in arrays]
-        return fn(*placed)
+        return shard_fn(*placed)

@@ -19,6 +19,16 @@ from racon_tpu.core.polisher import create_polisher, PolisherType
 
 DATA = "/root/reference/test/data/"
 
+
+@pytest.fixture(autouse=True)
+def _one_device_mesh(monkeypatch):
+    # real-data identity fixtures exercise the production envelope, not
+    # sharding (dedicated sharded tests cover that at small shapes) — on
+    # the 8-virtual-device CPU test mesh every shard re-runs the
+    # sequential DP, so pin this heavyweight module to one device
+    monkeypatch.setenv("RACON_TPU_MAX_DEVICES", "1")
+
+
 pytestmark = pytest.mark.skipif(
     not os.path.isdir(DATA), reason="reference sample data not available")
 

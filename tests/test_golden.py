@@ -24,6 +24,16 @@ from racon_tpu.errors import RaconError
 from racon_tpu.io.parsers import create_sequence_parser
 from racon_tpu.native import edit_distance
 
+
+@pytest.fixture(autouse=True)
+def _one_device_mesh(monkeypatch):
+    # real-data identity fixtures exercise the production envelope, not
+    # sharding (dedicated sharded tests cover that at small shapes) — on
+    # the 8-virtual-device CPU test mesh every shard re-runs the
+    # sequential DP, so pin this heavyweight module to one device
+    monkeypatch.setenv("RACON_TPU_MAX_DEVICES", "1")
+
+
 DATA = "/root/reference/test/data/"
 
 pytestmark = pytest.mark.skipif(
