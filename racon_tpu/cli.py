@@ -70,9 +70,10 @@ usage: racon_tpu [options ...] <sequences> <overlaps> <target sequences>
             skipped), trading exact host-engine parity for speed
         --tpu-engine <session|fused>
             default: session
-            device consensus engine: per-layer evolving-graph session or
-            single-launch whole-window fused (both byte-identical to the
-            host engine)
+            device consensus engine: per-layer evolving-graph session
+            (byte-identical to the host engine) or single-launch
+            whole-window fused (equal aggregate quality; rare tie-order
+            divergence possible on deep windows)
         --tpualigner-batches <int>
             default: 0
             number of device batches for TPU accelerated alignment

@@ -35,11 +35,18 @@ Accuracy contract: the engine replicates the host's layer order
 (begin-sorted, window.cpp:84-85), band rule (256 when the layer fits,
 exact DP otherwise), the banded clipped->full-DP retry (the host
 band_clipped rule, run on device under `lax.cond` so unclipped layers —
-the typical case — pay nothing) and ingest semantics, and tests assert
+the typical case — pay nothing) and ingest semantics; tests assert
 BYTE-IDENTITY to the host engine on spanning, non-spanning and
-band-clipping windows alike. With `banded_only` (-b) the retry is
-skipped, the reference's GPU-only speed/accuracy trade
-(cudabatch.cpp:56-59).
+band-clipping synthetic windows. On real data the guarantee is
+measurably weaker than the session engine's: deep windows can hit
+topo-order tie cases where the argsort-key order and the host graph's
+walk order rank equal-scoring paths differently (lambda sample: 95/96
+windows byte-equal, 1 diverges with identical aggregate quality —
+distance 1352 == host; pinned by tests/test_fused_poa.py). The session
+engine (ops/poa_graph.py) remains the byte-identical-everywhere engine;
+the reference itself pins diverging GPU numbers separately
+(racon_test.cpp:292-496). With `banded_only` (-b) the retry is skipped,
+the reference's GPU-only speed/accuracy trade (cudabatch.cpp:56-59).
 
 Non-spanning layers (reference window.cpp:87-103's subgraph case) are
 handled by MASKING, not extraction: every node carries its backbone
