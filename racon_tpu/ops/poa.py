@@ -106,9 +106,11 @@ class BatchPOA:
 
         `self.engine` selects the device engine — the explicit
         constructor/CLI choice, falling back to RACON_TPU_ENGINE:
-        "session" (default, the per-layer evolving-graph engine) or
-        "fused" (whole-window single-launch engine, ops/poa_fused.py —
-        the cudapoa-shaped design); both byte-identical to host."""
+        "session" (default, the per-layer evolving-graph engine —
+        byte-identical to the host engine) or "fused" (whole-window
+        single-launch engine, ops/poa_fused.py — the cudapoa-shaped
+        design; equal aggregate quality, rare topo-order tie divergence
+        possible on deep windows — see its module docstring)."""
         import sys
 
         from .poa_graph import DeviceGraphPOA

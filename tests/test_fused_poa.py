@@ -96,13 +96,14 @@ def test_fused_band_clip_retry_byte_identical_to_host():
                     reason="minutes-long real-data fixture")
 def test_fused_real_sample_window_identity_pinned():
     """The fused engine's real-data contract, pinned at its measured
-    value: on the lambda sample's 96 windows, >= 95 are byte-identical
-    to the host engine and every divergent window still carries the same
-    aggregate quality (whole-contig distance would stay 1352 — asserted
-    here as per-window consensus lengths staying equal-quality via the
-    identity count). A regression below 95/96 means a real tie-order or
-    DP change, not noise."""
+    values: on the lambda sample's 96 windows, >= 95 are byte-identical
+    to the host engine, and any divergent window's consensus stays
+    within edit distance 4 of the host's (measured: one window at
+    distance 3 — a topo-order tie, not a quality regression). A drop
+    below 95/96 or a bigger per-window distance means a real tie-order
+    or DP change, not noise."""
     from racon_tpu.core.polisher import PolisherType, create_polisher
+    from racon_tpu.native import edit_distance
 
     D = "/root/reference/test/data/"
     p = create_polisher(D + "sample_reads.fastq.gz",
@@ -111,6 +112,7 @@ def test_fused_real_sample_window_identity_pinned():
                         500, 10.0, 0.3, True, 5, -4, -8, num_threads=2)
     p.initialize()
     wins = [w for w in p.windows if len(w.sequences) >= 3]
+    assert len(wins) == 96  # the denominator the pins below assume
     packed = [[(w.sequences[i], w.qualities[i], w.positions[i][0],
                 w.positions[i][1]) for i in range(len(w.sequences))]
               for w in wins]
@@ -118,8 +120,13 @@ def test_fused_real_sample_window_identity_pinned():
     eng = FusedPOA(5, -4, -8, num_threads=2, batch_rows=16)
     res, statuses = eng.consensus(packed, fallback=False)
     assert (statuses == 0).all(), "every window must build on device"
-    same = sum(int(r[0] == h[0]) for r, h in zip(res, host))
-    assert same >= 95, f"only {same}/96 windows byte-identical to host"
+    diverged = [i for i, (r, h) in enumerate(zip(res, host))
+                if r[0] != h[0]]
+    assert len(diverged) <= 1, \
+        f"{len(diverged)}/96 windows diverged from host: {diverged}"
+    for i in diverged:
+        d = edit_distance(res[i][0], host[i][0])
+        assert d <= 4, f"window {i} diverged by distance {d}"
 
 
 def test_fused_deep_windows_chain_calls():
