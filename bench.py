@@ -53,22 +53,35 @@ _HOST_CAP = 300.0     # host run is ~20 s; generous margin
 _ALIGNER_CAP = 300.0
 
 
-def probe_device(timeout: float | None = None) -> bool:
+def probe_device(timeout: float | None = None, retries: int = 1) -> bool:
     """True when jax can reach an accelerator (TPU) without hanging.
 
-    The axon tunnel's first device claim can take minutes; the timeout is
-    env-tunable so a slow-but-alive tunnel is not mistaken for a dead one."""
+    The axon tunnel's first device claim can take minutes; the default
+    timeout matches tools/tpu_smoke.py's probe (420 s) and one retry is
+    attempted, because a probe that gives up early silently downgrades
+    the whole bench to host-only (round-4 failure mode). Env-tunable via
+    RACON_TPU_PROBE_TIMEOUT."""
     if timeout is None:
-        timeout = float(os.environ.get("RACON_TPU_PROBE_TIMEOUT", "180"))
-    try:
-        proc = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; ds = jax.devices(); "
-             "print('OK' if ds and ds[0].platform != 'cpu' else 'CPU')"],
-            capture_output=True, text=True, timeout=timeout)
-        return proc.returncode == 0 and "OK" in proc.stdout
-    except subprocess.TimeoutExpired:
-        return False
+        timeout = float(os.environ.get("RACON_TPU_PROBE_TIMEOUT", "420"))
+    for attempt in range(1 + max(0, retries)):
+        # retry gets a shorter slice: its job is catching a tunnel that
+        # came up between attempts, not doubling the dead-tunnel cost
+        t = timeout if attempt == 0 else min(timeout, 240.0)
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; ds = jax.devices(); "
+                 "print('OK' if ds and ds[0].platform != 'cpu' else 'CPU')"],
+                capture_output=True, text=True, timeout=t)
+            if proc.returncode == 0 and "OK" in proc.stdout:
+                return True
+            if proc.returncode == 0 and "CPU" in proc.stdout:
+                return False  # backend answered: no accelerator — final
+        except subprocess.TimeoutExpired:
+            pass
+        print(f"[bench] device probe attempt {attempt + 1} failed "
+              f"(timeout {t:.0f}s)", file=sys.stderr)
+    return False
 
 
 def build_polisher(device_batches: int, aligner_batches: int = 0):
@@ -101,6 +114,8 @@ def phase_consensus(mode: str) -> int:
     engine, failed/ineligible windows host-polished — the reference's own
     per-window GPU->CPU fallback discipline, cudapolisher.cpp:354-383)."""
     device = 0 if mode == "host" else 1
+    if device and _cpu_backend_refused():
+        return 3
     if mode == "fused":
         os.environ["RACON_TPU_ENGINE"] = "fused"
         os.environ.setdefault("RACON_TPU_FUSED_FALLBACK", "host")
@@ -120,7 +135,14 @@ def phase_consensus(mode: str) -> int:
 
             depth = max((len(w.sequences) - 1 for w in polisher.windows),
                         default=0)
-            FusedPOA(5, -4, -8).precompile(max_depth=depth)
+            # banded_only must match what the timed polish constructs
+            # (create_polisher's tpu_banded_alignment flows into
+            # FusedPOA(banded_only=...) and keys its compiled programs);
+            # a mismatch would recompile every depth bucket inside the
+            # timed loop and waste the precompile entirely
+            FusedPOA(5, -4, -8,
+                     banded_only=polisher.tpu_banded_alignment).precompile(
+                max_depth=depth)
         else:
             from racon_tpu.ops.poa_graph import DeviceGraphPOA
 
@@ -141,9 +163,29 @@ def phase_consensus(mode: str) -> int:
     print(f"[bench] edit distance vs reference assembly: {dist} "
           f"(identity {identity * 100:.2f}%; reference CPU fixture: 1312)",
           file=sys.stderr)
-    print(json.dumps({"mode": mode, "wps": wps, "windows": n_windows,
-                      "dist": dist}))
+    rec = {"mode": mode, "wps": wps, "windows": n_windows, "dist": dist}
+    if device:
+        rec["platform"] = _jax_platform()
+    print(json.dumps(rec))
     return 0
+
+
+def _jax_platform() -> str:
+    import jax
+
+    return jax.devices()[0].platform
+
+
+def _cpu_backend_refused() -> bool:
+    """Blind attempt (probe failed): a jax that silently fell back to the
+    CPU backend must not mislabel a CPU number as a device number."""
+    if not os.environ.get("RACON_TPU_REQUIRE_ACCELERATOR"):
+        return False
+    if _jax_platform() == "cpu":
+        print("[bench] blind device phase: backend is CPU — refusing to "
+              "report it as a device number", file=sys.stderr)
+        return True
+    return False
 
 
 def phase_aligner() -> int:
@@ -151,14 +193,22 @@ def phase_aligner() -> int:
     (initialize), device kernel mandatory (STRICT). Long overlaps host-
     align (counted as device skips, the cudaaligner exceeded_max_length
     discipline) so the smoke stays inside its wall cap."""
+    if _cpu_backend_refused():
+        return 3
     os.environ.setdefault("RACON_TPU_ALIGNER_MAXLEN", "16384")
     polisher = build_polisher(0, aligner_batches=1)
     t0 = time.perf_counter()
     polisher.initialize()
     t1 = time.perf_counter()
-    print(f"[bench] device aligner initialize: {t1 - t0:.2f}s",
+    print(f"[bench] device aligner initialize: {t1 - t0:.2f}s "
+          f"({polisher.n_aligner_device}/{polisher.n_aligner_pairs} pairs "
+          f"on device, {polisher.n_aligner_host_fallback} host fallbacks)",
           file=sys.stderr)
-    print(json.dumps({"mode": "aligner", "seconds": t1 - t0}))
+    print(json.dumps({"mode": "aligner", "seconds": round(t1 - t0, 2),
+                      "platform": _jax_platform(),
+                      "pairs": polisher.n_aligner_pairs,
+                      "device_pairs": polisher.n_aligner_device,
+                      "host_fallbacks": polisher.n_aligner_host_fallback}))
     return 0
 
 
@@ -168,6 +218,10 @@ def _run_phase(phase: str, cap: float, strict: bool, argv=None,
     parsed JSON result dict (or {"rc": 0} when expect_json=False), or
     None on timeout/failure."""
     env = dict(os.environ, **(env_extra or {}))
+    # a None value removes the variable (e.g. PYTHONPATH, where the axon
+    # shim lives — dropping it keeps a CPU-pinned child from hanging on a
+    # dead tunnel)
+    env = {k: v for k, v in env.items() if v is not None}
     if strict:
         env["RACON_TPU_STRICT"] = "1"
     # phases are separate processes; a persistent compilation cache lets
@@ -231,11 +285,21 @@ def main() -> int:
         return budget - (time.monotonic() - t_start) - reserve
 
     forced = os.environ.get("RACON_TPU_POA_BATCHES")
+    try_blind = False
     if forced is not None:
         want_device = int(forced) > 0
     else:
         want_device = probe_device()
-    print(f"[bench] device reachable: {want_device}", file=sys.stderr)
+        if not want_device:
+            # A failed probe must not silently downgrade the round to
+            # host-only (round-4 failure mode): attempt ONE capped STRICT
+            # fused phase anyway. On a dead tunnel this costs exactly one
+            # subprocess cap; on a slow-but-alive tunnel it saves the
+            # round's device number.
+            try_blind = True
+    print(f"[bench] device reachable: {want_device}"
+          + (" (probe failed; will attempt fused phase blind)"
+             if try_blind else ""), file=sys.stderr)
 
     # Two device engines, both measured when the chip is up: the fused
     # single-launch engine first (the cudapoa-shaped flagship; leftover
@@ -245,24 +309,55 @@ def main() -> int:
     # budget (the host phase's slice is always reserved).
     fused_res = None
     device_res = None
-    if want_device:
+    if want_device or try_blind:
         cap = min(_FUSED_CAP, room(_HOST_CAP + 60))
         if cap > 120:
-            fused_res = _run_phase("fused", cap, strict=True)
+            extra = ({"RACON_TPU_REQUIRE_ACCELERATOR": "1"}
+                     if try_blind else None)
+            fused_res = _run_phase("fused", cap, strict=True,
+                                   env_extra=extra)
+        if try_blind and fused_res is not None:
+            # the blind attempt reached the chip after all — the tunnel
+            # was slow, not dead; run the remaining device phases too
+            want_device = True
+    if want_device:
         cap = min(_DEVICE_CAP, room(_HOST_CAP + 60))
         if cap > 120:
             device_res = _run_phase("device", cap, strict=True)
-        if fused_res is not None or device_res is not None:
-            cap = min(_ALIGNER_CAP, room(_HOST_CAP + 60))
-            if cap > 60:
-                _run_phase("aligner", cap, strict=True)
-            # scale phase (stderr only, never the JSON artifact): the
-            # north-star workload shape at ~5x the sample's window count,
-            # on the fused device engine — run only when THAT engine just
-            # proved itself and the budget has room
-            cap = min(480.0, room(_HOST_CAP + 60))
-            if fused_res is not None and cap > 240:
-                _run_scale(cap)
+    # aligner phase: attempted whenever a device might exist, NOT gated on
+    # a consensus phase succeeding (round-4 verdict: the gate meant this
+    # kernel never produced a recorded number); its result lands in the
+    # final JSON artifact below
+    aligner_res = None
+    aligner_backend = "device"
+    if want_device or try_blind:
+        cap = min(_ALIGNER_CAP, room(_HOST_CAP + 60 + 180))
+        if cap > 60:
+            extra = ({"RACON_TPU_REQUIRE_ACCELERATOR": "1"}
+                     if not want_device else None)
+            aligner_res = _run_phase("aligner", cap, strict=True,
+                                     env_extra=extra)
+    if aligner_res is None and (forced is None or int(forced) > 0):
+        # no device-aligner number — record a CPU-backend one instead so
+        # the artifact always carries cudaaligner-role evidence (pinned to
+        # the CPU backend and labeled as such; PYTHONPATH dropped so a
+        # dead axon tunnel cannot hang the child). Skipped only when the
+        # operator explicitly forced the device off (tests do this).
+        aligner_backend = "cpu"
+        cap = min(240.0, room(_HOST_CAP + 60))
+        if cap > 60:
+            aligner_res = _run_phase(
+                "aligner", cap, strict=True,
+                env_extra={"JAX_PLATFORMS": "cpu", "PYTHONPATH": None,
+                           "RACON_TPU_REQUIRE_ACCELERATOR": None})
+    if want_device:
+        # scale phase (stderr only, never the JSON artifact): the
+        # north-star workload shape at ~5x the sample's window count,
+        # on the fused device engine — run only when THAT engine just
+        # proved itself and the budget has room
+        cap = min(480.0, room(_HOST_CAP + 60))
+        if fused_res is not None and cap > 240:
+            _run_scale(cap)
 
     # host engine measured in every run: the comparison point for the
     # device number (stderr only when a device phase succeeded); its cap
@@ -278,21 +373,44 @@ def main() -> int:
             print(f"[bench] {r['mode']} engine: {r['wps']:.2f} windows/sec",
                   file=sys.stderr)
 
+    # aligner evidence rides the artifact line as extra fields (round-4
+    # verdict #6: the cudaaligner-role kernel must produce a recorded
+    # number regardless of the consensus phases' outcome)
+    aligner_fields = {}
+    if aligner_res is not None:
+        aligner_fields = {
+            # the phase reports the platform jax actually ran on — a
+            # forced run on a silently-CPU jax is labeled cpu, not device
+            "aligner_backend": aligner_res.get("platform",
+                                               aligner_backend),
+            "aligner_seconds": aligner_res.get("seconds"),
+            "aligner_pairs": aligner_res.get("pairs"),
+            "aligner_device_pairs": aligner_res.get("device_pairs"),
+            "aligner_host_fallbacks": aligner_res.get("host_fallbacks"),
+        }
+
     on_device = [r for r in (fused_res, device_res) if r is not None]
     res = max(on_device, key=lambda r: r["wps"]) if on_device else host_res
     if res is None:
         print(json.dumps({
             "metric": "sample_polish_consensus_throughput_failed",
-            "value": 0.0, "unit": "windows/sec", "vs_baseline": 0.0}))
+            "value": 0.0, "unit": "windows/sec", "vs_baseline": 0.0,
+            **aligner_fields}))
         return 1
     wps = float(res["wps"])
     label = {"fused": "device_fused", "device": "device",
              "host": "host"}[res["mode"]]
+    # honesty clause: a device-engine phase that actually ran on the CPU
+    # backend (forced rehearsal, or jax silently falling back) must not
+    # be labeled as a device number
+    if res["mode"] != "host" and res.get("platform") == "cpu":
+        label += "_cpubackend"
     print(json.dumps({
         "metric": f"sample_polish_consensus_throughput_{label}",
         "value": round(wps, 2),
         "unit": "windows/sec",
         "vs_baseline": round(wps / REFERENCE_CPU_WINDOWS_PER_SEC, 3),
+        **aligner_fields,
     }))
     return 0
 

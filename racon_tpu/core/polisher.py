@@ -99,6 +99,10 @@ class Polisher:
         self.dummy_quality = b"!" * window_length
         self.logger = Logger()
         self._num_targets = 0
+        # alignment-phase accounting (reference cudapolisher.cpp:204-206)
+        self.n_aligner_pairs = 0
+        self.n_aligner_device = 0
+        self.n_aligner_host_fallback = 0
 
     # ------------------------------------------------------------------ init
     def initialize(self) -> None:
@@ -334,6 +338,7 @@ class Polisher:
                     self.logger.bar(bar_msg)
 
             runs = [None] * len(pairs)
+            self.n_aligner_pairs = len(pairs)
             if self.tpu_aligner_batches > 0:
                 from ..ops.align import BatchAligner
                 aligner = BatchAligner(band_width=self.tpu_aligner_band_width)
@@ -362,6 +367,11 @@ class Polisher:
             for o, r in zip(need, runs):
                 if r is not None:
                     o.cigar = cigar_from_ops(r).encode()
+            # skip accounting mirrors the reference's "Aligned overlaps ...
+            # on GPU" line (cudapolisher.cpp:204-206); exposed as counters
+            # so the bench can put them in its JSON artifact
+            self.n_aligner_host_fallback = len(rest)
+            self.n_aligner_device = len(pairs) - len(rest)
             if self.tpu_aligner_batches > 0 and rest:
                 print(f"[racon_tpu::Polisher.initialize] {len(rest)} overlaps "
                       "aligned on host (device capacity fallback)",

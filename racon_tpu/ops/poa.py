@@ -50,9 +50,18 @@ class BatchPOA:
         self.banded_only = banded
         self.logger = logger
         # device engine selection: explicit parameter (the CLI's
-        # --tpu-engine) wins over the RACON_TPU_ENGINE env var
-        self.engine = engine or os.environ.get("RACON_TPU_ENGINE",
-                                               "session")
+        # --tpu-engine) wins over the RACON_TPU_ENGINE env var; an empty
+        # env value means unset (the `VAR= cmd` idiom), not a typo
+        self.engine = (engine or os.environ.get("RACON_TPU_ENGINE")
+                       or "session")
+        # the CLI validates --tpu-engine; the env-var path must too, or a
+        # typo like RACON_TPU_ENGINE=Fused silently measures the session
+        # engine while the user believes they measured the fused one
+        if self.engine not in ("session", "fused"):
+            raise ValueError(
+                f"[racon_tpu::BatchPOA] invalid TPU engine "
+                f"{self.engine!r} (expected 'session' or 'fused'; set via "
+                "--tpu-engine or RACON_TPU_ENGINE)")
 
     #: windows per host batch call (bounds peak packed-buffer memory)
     HOST_CHUNK = 4096

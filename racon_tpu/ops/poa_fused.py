@@ -89,10 +89,14 @@ _NEG = -(1 << 29)
 
 
 @functools.lru_cache(maxsize=None)
-def fused_builder(n_nodes: int, seq_len: int, depth: int, max_pred: int,
-                  match: int, mismatch: int, gap: int,
-                  banded_only: bool = False):
-    """Jitted whole-window POA builder for one (N, L, D, P) shape.
+def fused_raw(n_nodes: int, seq_len: int, depth: int, max_pred: int,
+              match: int, mismatch: int, gap: int,
+              banded_only: bool = False):
+    """Raw (traceable, un-jitted) whole-window POA builder for one
+    (N, L, D, P) shape — `fused_builder` jits it for single-device
+    dispatch; FusedPOA's BatchRunner shard_maps it for multi-chip
+    dispatch (the batch-per-GPU loop of cudapolisher.cpp:228-240, as one
+    batch-sharded program per chip over the mesh).
 
     State arrays (leading dim B): codes [B,N] i8 (-1 free), preds [B,N,P]
     i16 node ids (-1 empty), predw [B,N,P] i32, nseq [B,N] i32,
@@ -101,8 +105,9 @@ def fused_builder(n_nodes: int, seq_len: int, depth: int, max_pred: int,
     (pad 5), lens [B,D] i32 (0 = no layer), wts [B,D,L] i8 (Phred-33
     weights <= 93; upcast on device — a quarter of the host->device
     bytes), rlo/rhi [B,D] i16 (the layer's bpos range; -32768/32767 =
-    spanning, full graph), lbase scalar i32. Returns the updated state +
-    failed [B] bool.
+    spanning, full graph), lbase [B] i32 (per-row layer-index base, so
+    every operand is batch-leading and shardable). Returns the updated
+    state + failed [B] bool.
     """
     import jax
     import jax.numpy as jnp
@@ -412,12 +417,13 @@ def fused_builder(n_nodes: int, seq_len: int, depth: int, max_pred: int,
         mrun = jnp.flip(mrev, 1)
 
         # insertion column keys: run-partitioned equal spacing, low 8 bits
-        # replaced with the layer salt for global uniqueness
+        # replaced with the layer salt for global uniqueness (lidx is per
+        # row, [B])
         span = nkey_next - pkey_prev
         spacing = span // (mrun.astype(jnp.int64) + 1)
         grid = pkey_prev + span * jrun.astype(jnp.int64) // (
             mrun.astype(jnp.int64) + 1)
-        salt = (lidx.astype(jnp.int64) + 1) & 0xFF
+        salt = ((lidx.astype(jnp.int64) + 1) & 0xFF)[:, None]
         ikey = (grid & ~jnp.int64(0xFF)) | salt
         key_bad = insertion & ((spacing <= 512) |
                                (ikey <= pkey_prev) | (ikey >= nkey_next))
@@ -498,12 +504,28 @@ def fused_builder(n_nodes: int, seq_len: int, depth: int, max_pred: int,
             band, lbase):
         state = (codes, preds, predw, nseq, col_of, colkey,
                  colnodes, bpos, n_nodes, n_cols, failed)
+        # per-step layer indices [D, B]: row base + step offset
+        lidx_all = (lbase[None, :].astype(jnp.int32)
+                    + jnp.arange(D, dtype=jnp.int32)[:, None])
         state, _ = jax.lax.scan(
             one_layer, state,
             (seqs.transpose(1, 0, 2), lens.T, wts.transpose(1, 0, 2),
-             rlo.T, rhi.T, band.T, lbase + jnp.arange(D, dtype=jnp.int32)))
+             rlo.T, rhi.T, band.T, lidx_all))
         return state
 
+    return run
+
+
+@functools.lru_cache(maxsize=None)
+def fused_builder(n_nodes: int, seq_len: int, depth: int, max_pred: int,
+                  match: int, mismatch: int, gap: int,
+                  banded_only: bool = False):
+    """Single-device jitted variant of `fused_raw` (multi-chip dispatch
+    goes through BatchRunner.run on the raw function instead)."""
+    import jax
+
+    run = fused_raw(n_nodes, seq_len, depth, max_pred, match, mismatch,
+                    gap, banded_only=banded_only)
     # donate the state buffers on accelerators so chained calls mutate in
     # place instead of allocating a second copy of the graph arrays (the
     # CPU test backend can't donate and would warn on every call)
@@ -553,7 +575,10 @@ class FusedPOA:
                  num_threads: int = 1, logger: Logger | None = None,
                  max_nodes: int = MAX_NODES, max_len: int = MAX_LEN,
                  max_pred: int = MAX_PRED, batch_rows: int | None = None,
-                 depth_buckets=DEPTH_BUCKETS, banded_only: bool = False):
+                 depth_buckets=DEPTH_BUCKETS, banded_only: bool = False,
+                 runner=None):
+        from ..parallel.mesh import BatchRunner
+
         self.match = match
         self.mismatch = mismatch
         self.gap = gap
@@ -562,7 +587,15 @@ class FusedPOA:
         self.N = max_nodes
         self.L = max_len
         self.P = max_pred
-        self.B = batch_rows if batch_rows else self._pin_rows()
+        # batch axis sharded over every device (the reference's
+        # batch-per-GPU loop, cudapolisher.cpp:228-240): B is sized PER
+        # DEVICE from the free-memory pin, times the mesh width, so each
+        # chip carries the width one chip's memory affords
+        self.runner = runner if runner is not None else BatchRunner()
+        if batch_rows:
+            self.B = self.runner.round_batch(batch_rows)
+        else:
+            self.B = self._pin_rows() * self.runner.n_devices
         self.depth_buckets = tuple(depth_buckets)
         self.last_stats = {"chunks": 0, "launches": 0,
                            "dispatch_s": 0.0, "finalize_s": 0.0}
@@ -575,6 +608,23 @@ class FusedPOA:
 
     def _pin_rows(self) -> int:
         return _pinned_rows(self.N, self.L, self.P)
+
+    def _call(self, d: int, state, seqs, lens, wts, rlo, rhi, band,
+              done: int):
+        """One chained builder call for depth bucket `d`: shard_mapped
+        over the mesh when one exists, plain donated jit on one device."""
+        lbase = np.full(self.B, done, dtype=np.int32)
+        if self.runner.sharding is not None:
+            raw = fused_raw(self.N, self.L, d, self.P, self.match,
+                            self.mismatch, self.gap,
+                            banded_only=self.banded_only)
+            return self.runner.run(raw, *state, seqs, lens, wts, rlo,
+                                   rhi, band, lbase,
+                                   donate_argnums=tuple(range(11)))
+        fn = fused_builder(self.N, self.L, d, self.P, self.match,
+                           self.mismatch, self.gap,
+                           banded_only=self.banded_only)
+        return fn(*state, seqs, lens, wts, rlo, rhi, band, lbase)
 
     def _eligible(self, win) -> bool:
         bb_len = len(win[0][0])
@@ -610,9 +660,6 @@ class FusedPOA:
             for depth in range(1, max(1, max_depth) + 1):
                 needed.update(self._chain_plan(depth))
         for d in sorted(needed):
-            fn = fused_builder(self.N, self.L, d, self.P, self.match,
-                               self.mismatch, self.gap,
-                               banded_only=self.banded_only)
             state = self._init_state([b"AC"], [np.ones(2, np.int32)])
             seqs = np.full((self.B, d, self.L), 5, np.int8)
             lens = np.zeros((self.B, d), np.int32)
@@ -620,7 +667,7 @@ class FusedPOA:
             rlo = np.full((self.B, d), -32768, np.int16)
             rhi = np.full((self.B, d), 32767, np.int16)
             band = np.zeros((self.B, d), np.int32)
-            out = fn(*state, seqs, lens, wts, rlo, rhi, band, 0)
+            out = self._call(d, state, seqs, lens, wts, rlo, rhi, band, 0)
             np.asarray(out[0])  # block
 
     def _init_state(self, backbones, bweights):
@@ -761,13 +808,11 @@ class FusedPOA:
                     # the layer fits, exact DP otherwise)
                     if abs(len(seq) - span) < 256 // 2 - 16:
                         band[k, dd] = 256
-            fn = fused_builder(self.N, self.L, d, self.P, self.match,
-                               self.mismatch, self.gap,
-                               banded_only=self.banded_only)
             # state stays on device across chained calls (a fetch here
             # would round-trip ~5 MB of graph arrays per call); only the
             # final state is materialized for the host finalizer
-            state = fn(*state, seqs, lens, wts, rlo, rhi, band, done)
+            state = self._call(d, state, seqs, lens, wts, rlo, rhi, band,
+                               done)
             done += d
         return state
 

@@ -58,14 +58,17 @@ class BatchRunner:
         n = self.n_devices
         return ((batch + n - 1) // n) * n
 
-    def run(self, fn, *arrays, out_batch_axes=0):
+    def run(self, fn, *arrays, out_batch_axes=0, donate_argnums=()):
         """Invoke jitted `fn` on operands whose leading dim is the batch.
 
         All operands must share the same leading dimension, divisible by
         the device count (use round_batch + padding). `out_batch_axes`
         names the batch axis of each output: an int when every output
         carries the batch on the same axis, or a tuple with one entry per
-        output of a tuple-returning kernel.
+        output of a tuple-returning kernel. `donate_argnums` is applied
+        to the outer jit on accelerator backends (state-carrying kernels
+        chain calls without duplicating their buffers); ignored on the
+        CPU test backend, which cannot donate.
 
         Multi-device dispatch goes through `shard_map`, so each device
         runs an INDEPENDENT copy of the program on its batch shard — no
@@ -83,7 +86,9 @@ class BatchRunner:
 
         if self.sharding is None:
             return fn(*arrays)
-        key = (fn, len(arrays), out_batch_axes)
+        donate = (tuple(donate_argnums)
+                  if jax.default_backend() != "cpu" else ())
+        key = (fn, len(arrays), out_batch_axes, donate)
         shard_fn = self._wrapped.get(key)
         if shard_fn is None:
             from jax.sharding import PartitionSpec
@@ -101,13 +106,17 @@ class BatchRunner:
             # rejects even though every output is plainly batch-sharded
             kwargs = dict(mesh=self.mesh, in_specs=(spec,) * len(arrays),
                           out_specs=out_specs)
+            # TypeError covers jax versions where jax.shard_map exists
+            # but takes check_rep instead of check_vma
             try:
-                shard_fn = jax.jit(jax.shard_map(fn, check_vma=False,
-                                                 **kwargs))
+                smapped = jax.shard_map(fn, check_vma=False, **kwargs)
             except AttributeError:  # pragma: no cover — older jax
                 from jax.experimental.shard_map import shard_map
 
-                shard_fn = jax.jit(shard_map(fn, check_rep=False, **kwargs))
+                smapped = shard_map(fn, check_rep=False, **kwargs)
+            except TypeError:  # pragma: no cover — check_rep-era jax
+                smapped = jax.shard_map(fn, check_rep=False, **kwargs)
+            shard_fn = jax.jit(smapped, donate_argnums=donate)
             self._wrapped[key] = shard_fn
         placed = [jax.device_put(a, self.sharding) for a in arrays]
         return shard_fn(*placed)

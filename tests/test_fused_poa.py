@@ -129,6 +129,33 @@ def test_fused_real_sample_window_identity_pinned():
         assert d <= 4, f"window {i} diverged by distance {d}"
 
 
+def test_fused_sharded_matches_single_device(monkeypatch):
+    """The fused engine's batch axis shards over the mesh (conftest's
+    8-virtual-device CPU mesh) through BatchRunner/shard_map — the
+    multi-chip analogue of the reference's batch-per-GPU loop
+    (cudapolisher.cpp:228-240). Sharded output must equal the
+    single-device output window-for-window, including chained calls."""
+    rng = random.Random(21)
+    windows, _ = _make_windows(rng, 10, length=220, depth=7, rate=0.12)
+    packed = [_pack(w) for w in windows]
+    kw = dict(max_nodes=768, max_len=384, batch_rows=8,
+              depth_buckets=(4,))  # depth 7 -> 2 chained calls
+
+    multi = FusedPOA(3, -5, -4, **kw)
+    assert multi.runner.n_devices > 1, \
+        "conftest should provide an 8-virtual-device mesh"
+    res_m, st_m = multi.consensus([list(p) for p in packed])
+
+    monkeypatch.setenv("RACON_TPU_MAX_DEVICES", "1")
+    one = FusedPOA(3, -5, -4, **kw)
+    assert one.runner.n_devices == 1
+    res_s, st_s = one.consensus([list(p) for p in packed])
+
+    np.testing.assert_array_equal(st_m, st_s)
+    assert (st_m == 0).all(), st_m.tolist()
+    _assert_identical(res_m, res_s, st_m, "sharded-vs-single")
+
+
 def test_fused_deep_windows_chain_calls():
     """Depth beyond the largest bucket chains device calls (state streams
     out of one call into the next); output must still match the host."""
