@@ -77,10 +77,12 @@ def probe_device(timeout: float | None = None, retries: int = 1) -> bool:
                 return True
             if proc.returncode == 0 and "CPU" in proc.stdout:
                 return False  # backend answered: no accelerator — final
+            why = (f"rc={proc.returncode}, stderr tail: "
+                   f"{proc.stderr[-300:]!r}")
         except subprocess.TimeoutExpired:
-            pass
-        print(f"[bench] device probe attempt {attempt + 1} failed "
-              f"(timeout {t:.0f}s)", file=sys.stderr)
+            why = f"timeout after {t:.0f}s"
+        print(f"[bench] device probe attempt {attempt + 1} failed ({why})",
+              file=sys.stderr)
     return False
 
 

@@ -15,6 +15,12 @@ import os
 import sys
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+# persistent XLA compile cache: the real-data device/fused fixtures compile
+# full-envelope programs (minutes of XLA on this 1-core host); caching them
+# across runs keeps the default suite affordable (same mechanism bench.py
+# uses between its phases)
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      "/tmp/racon_tpu_jax_cache")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

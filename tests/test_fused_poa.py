@@ -92,7 +92,55 @@ def test_fused_band_clip_retry_byte_identical_to_host():
     # test_banded_only_mode_skips_retry and the builder keys on it.)
 
 
-@pytest.mark.skipif(not os.environ.get("RACON_TPU_FULL_GOLDENS"),
+@pytest.mark.skipif(not os.path.isdir("/root/reference/test/data"),
+                    reason="reference sample data not available")
+def test_fused_real_sample_slice_identity_pinned(monkeypatch):
+    """Default-suite regression guard for the fused engine's REAL-DATA
+    behavior (round-4 verdict: the strongest contracts must not live only
+    behind RACON_TPU_FULL_GOLDENS): on the 24 shallowest real windows of
+    the lambda sample, ALL build on device, every consensus is
+    byte-identical to the host engine, and coverages match exactly on
+    >= 23/24 — the measured state is ONE window (depth 17) whose final
+    two coverage values are transposed (17,16 vs 16,17): a
+    heaviest-bundle tie at the consensus tail resolved differently by
+    the argsort-key topo order, same bases and same total coverage. Any
+    byte divergence, a second coverage-divergent window, or a
+    non-permutation coverage change fails the pin."""
+    from racon_tpu.core.polisher import PolisherType, create_polisher
+
+    monkeypatch.setenv("RACON_TPU_MAX_DEVICES", "1")
+    D = "/root/reference/test/data/"
+    p = create_polisher(D + "sample_reads.fastq.gz",
+                        D + "sample_overlaps.paf.gz",
+                        D + "sample_layout.fasta.gz", PolisherType.kC,
+                        500, 10.0, 0.3, True, 5, -4, -8, num_threads=2)
+    p.initialize()
+    wins = sorted((w for w in p.windows if len(w.sequences) >= 3),
+                  key=lambda w: len(w.sequences))[:24]
+    assert len(wins) == 24
+    packed = [[(w.sequences[i], w.qualities[i], w.positions[i][0],
+                w.positions[i][1]) for i in range(len(w.sequences))]
+              for w in wins]
+    host = poa_batch(packed, 5, -4, -8, n_threads=2)
+    eng = FusedPOA(5, -4, -8, num_threads=2, batch_rows=8)
+    res, statuses = eng.consensus(packed, fallback=False)
+    assert (statuses == 0).all(), \
+        "every shallow window must build on device"
+    cov_diverged = []
+    for i, ((dc, dcov), (hc, hcov)) in enumerate(zip(res, host)):
+        assert dc == hc, f"window {i} consensus bytes diverged"
+        if not np.array_equal(dcov, hcov):
+            # tie-class divergence only: same multiset of coverages
+            assert sorted(np.asarray(dcov).tolist()) == \
+                sorted(np.asarray(hcov).tolist()), \
+                f"window {i} coverage changed beyond a tie permutation"
+            cov_diverged.append(i)
+    assert len(cov_diverged) <= 1, \
+        f"coverage tie-divergence grew: windows {cov_diverged}"
+
+
+@pytest.mark.skipif(not os.environ.get("RACON_TPU_FULL_GOLDENS")
+                    or not os.path.isdir("/root/reference/test/data"),
                     reason="minutes-long real-data fixture")
 def test_fused_real_sample_window_identity_pinned():
     """The fused engine's real-data contract, pinned at its measured
