@@ -347,6 +347,21 @@ static Alignment align_nw_impl(const Graph& g, const uint8_t* seq,
                     }
                 }
             }
+            // RACON_TPU_TIEBREAK=dhv flips the equal-score indel
+            // preference to horizontal-before-vertical (quality-gap
+            // attribution experiment, PARITY.md); default dvh is the
+            // order the device kernels replicate bit-for-bit
+            static const bool kHorizFirst = [] {
+                const char* e = std::getenv("RACON_TPU_TIEBREAK");
+                return e != nullptr && std::strcmp(e, "dhv") == 0;
+            }();
+            if (!moved && kHorizFirst && j > 0 &&
+                static_cast<S>(H[static_cast<size_t>(r) * stride + j - 1] +
+                               sgap) == cur) {
+                out.push_back(AlnPair{-1, j - 1});
+                --j;
+                moved = true;
+            }
             if (!moved) {
                 for (int32_t pr : pred_rows) {
                     if (static_cast<S>(
@@ -475,23 +490,84 @@ std::vector<uint8_t> Graph::consensus(std::vector<uint32_t>& coverages) const {
         }
     }
 
-    // extend to a sink along the heaviest out-edges so the consensus spans
-    // the full graph (the reference engine completes branches similarly)
+    // extend to a sink so the consensus spans the full graph. Two modes:
+    //   greedy (default): follow the heaviest out-edge step by step;
+    //   branch (RACON_TPU_CONSENSUS_EXT=branch): spoa-style branch
+    //     completion — re-run the accumulated-score pass on the subgraph
+    //     beyond the current bundle end, restricted to paths leaving it,
+    //     jump to the new best-scoring node, iterate. Measured on the
+    //     reference fixtures for the quality-gap attribution (PARITY.md).
+    static const bool kBranchExt = [] {
+        const char* e = std::getenv("RACON_TPU_CONSENSUS_EXT");
+        return e != nullptr && std::strcmp(e, "branch") == 0;
+    }();
     int32_t tip = max_node;
-    while (!nodes[tip].out.empty()) {
-        int64_t best_w = -1;
-        int32_t best_h = -1;
-        for (int32_t ei : nodes[tip].out) {
-            const Edge& e = edges[ei];
-            if (e.weight > best_w ||
-                (e.weight == best_w &&
-                 (best_h < 0 || score[e.head] >= score[best_h]))) {
-                best_w = e.weight;
-                best_h = e.head;
-            }
+    if (kBranchExt) {
+        std::vector<int32_t> rank_of(n);
+        for (int32_t r = 0; r < n; ++r) {
+            rank_of[order[r]] = r;
         }
-        pred[best_h] = tip;
-        tip = best_h;
+        while (!nodes[tip].out.empty()) {
+            // restrict the re-scan to paths THROUGH the bundle end: every
+            // node ranked at or before `tip` except `tip` itself becomes
+            // unreachable, so deep nodes cannot attach to tails that
+            // bypass the bundle
+            for (int32_t r = 0; r <= rank_of[tip]; ++r) {
+                if (order[r] != tip) {
+                    score[order[r]] = -1;
+                }
+            }
+            score[tip] = std::max<int64_t>(score[tip], 0);
+            int64_t ext_best = -1;
+            int32_t ext_node = -1;
+            for (int32_t r = rank_of[tip] + 1; r < n; ++r) {
+                const int32_t v = order[r];
+                score[v] = -1;
+                pred[v] = -1;
+                int64_t best_w = -1;
+                int32_t best_p = -1;
+                for (int32_t ei : nodes[v].in) {
+                    const Edge& e = edges[ei];
+                    if (score[e.tail] < 0) {
+                        continue;  // unreachable from the bundle end
+                    }
+                    if (e.weight > best_w ||
+                        (e.weight == best_w &&
+                         (best_p < 0 || score[e.tail] >= score[best_p]))) {
+                        best_w = e.weight;
+                        best_p = e.tail;
+                    }
+                }
+                if (best_p >= 0) {
+                    score[v] = best_w + score[best_p];
+                    pred[v] = best_p;
+                    if (score[v] > ext_best) {
+                        ext_best = score[v];
+                        ext_node = v;
+                    }
+                }
+            }
+            if (ext_node < 0) {
+                break;  // no path forward (tip is effectively a sink)
+            }
+            tip = ext_node;
+        }
+    } else {
+        while (!nodes[tip].out.empty()) {
+            int64_t best_w = -1;
+            int32_t best_h = -1;
+            for (int32_t ei : nodes[tip].out) {
+                const Edge& e = edges[ei];
+                if (e.weight > best_w ||
+                    (e.weight == best_w &&
+                     (best_h < 0 || score[e.head] >= score[best_h]))) {
+                    best_w = e.weight;
+                    best_h = e.head;
+                }
+            }
+            pred[best_h] = tip;
+            tip = best_h;
+        }
     }
 
     std::vector<int32_t> path;
@@ -549,8 +625,14 @@ std::vector<uint8_t> window_consensus(
     const bool anchored = prealigned != nullptr;
     // static band (the cudapoa band-256 contract, cudabatch.cpp:56-59);
     // a layer whose length diverges from its graph span by close to the
-    // half-band cannot fit the band and gets the exact full DP instead
-    constexpr int32_t kBand = 256;
+    // half-band cannot fit the band and gets the exact full DP instead.
+    // RACON_TPU_HOST_BAND overrides the width (0 = exact full DP always,
+    // the reference spoa behavior) — the accuracy/speed knob behind the
+    // banding attribution measured in PARITY.md.
+    static const int32_t kBand = [] {
+        const char* e = std::getenv("RACON_TPU_HOST_BAND");
+        return e != nullptr ? std::atoi(e) : 256;
+    }();
     // banded-result sanity: if fewer than half the aligned columns match,
     // the in-band path is mismatch soup from band clipping (e.g. balanced
     // indels with small net length change) — redo with the exact full DP,

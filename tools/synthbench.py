@@ -140,6 +140,14 @@ def main(argv=None):
           f"polished error {d_pol / genome_len * 100:.2f}%  "
           f"(identity {100 - d_pol / genome_len * 100:.3f}%)",
           file=sys.stderr)
+    try:
+        import resource
+
+        rss_kb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        print(f"[synthbench] peak host RSS {rss_kb / 1024:.0f} MiB",
+              file=sys.stderr)
+    except Exception:
+        pass
     return 0
 
 
