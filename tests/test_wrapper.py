@@ -134,6 +134,69 @@ def test_wrapper_shards_concatenate_to_unsharded(tmp_path):
     assert sharded == whole
 
 
+@needs_data
+def test_wrapper_shards_across_processes(tmp_path):
+    """The multi-host dress rehearsal (round-4 verdict #10): the same
+    scatter/gather as test_wrapper_shards_concatenate_to_unsharded, but
+    each shard runs in its OWN OS process through the real CLI entry
+    (`python -m racon_tpu.wrapper`) — the way two hosts would actually
+    run it, DCN being a shared filesystem here. Concatenating the two
+    processes' stdout in shard order must reproduce a third, unsharded
+    process's stdout byte-for-byte."""
+    import random
+    import subprocess
+    import sys as _sys
+
+    layout = _load(DATA + "sample_layout.fasta.gz")[0].data
+    rng = random.Random(3)
+    contigs, reads, paf = [], [], []
+    for c in range(4):
+        tig = layout[c * 9000:(c + 1) * 9000]
+        name = f"tig{c}".encode()
+        contigs.append((name, tig))
+        for r in range(12):
+            beg = rng.randrange(0, len(tig) - 2000)
+            end = beg + 2000
+            rname = f"read{c}_{r}".encode()
+            reads.append((rname, tig[beg:end]))
+            paf.append(f"read{c}_{r}\t2000\t0\t2000\t+\t{name.decode()}\t"
+                       f"{len(tig)}\t{beg}\t{end}\t2000\t2000\t255")
+    tgt = tmp_path / "tigs.fasta"
+    rds = tmp_path / "reads.fasta"
+    ovl = tmp_path / "ovl.paf"
+    write_fasta(tgt, contigs)
+    write_fasta(rds, reads)
+    ovl.write_text("\n".join(paf) + "\n")
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, PYTHONPATH=repo, JAX_PLATFORMS="cpu")
+
+    def polish_proc(extra):
+        proc = subprocess.run(
+            [_sys.executable, "-m", "racon_tpu.wrapper", str(rds),
+             str(ovl), str(tgt), "--split", "9500", "-t", "1"] + extra,
+            capture_output=True, timeout=300, env=env, cwd=str(tmp_path))
+        assert proc.returncode == 0, proc.stderr.decode()[-2000:]
+        return proc.stdout
+
+    # the two shard processes run CONCURRENTLY, like real hosts would
+    procs = [subprocess.Popen(
+        [_sys.executable, "-m", "racon_tpu.wrapper", str(rds), str(ovl),
+         str(tgt), "--split", "9500", "-t", "1", "--num-shards", "2",
+         "--shard-id", str(s)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env,
+        cwd=str(tmp_path)) for s in (0, 1)]
+    outs = []
+    for p in procs:
+        out, err = p.communicate(timeout=300)
+        assert p.returncode == 0, err.decode()[-2000:]
+        outs.append(out)
+
+    whole = polish_proc([])
+    assert whole.count(b">") == 4
+    assert outs[0] + outs[1] == whole
+
+
 def test_wrapper_shard_validation(tmp_path):
     from racon_tpu.errors import RaconError
     from racon_tpu.wrapper import run
