@@ -54,6 +54,45 @@ t=time.time(); eng.precompile(max_depth=40)
 print(f"fused precompile (B={eng.B}): {time.time()-t:.1f}s", flush=True)
 """
 
+PALLAS_PROFILE = """
+# XLA-scan vs Pallas per bucket on synthetic jobs: the measurement that
+# decides which DP program is the on-chip default (round-4 verdict #9).
+import time
+import numpy as np
+import jax
+from __graft_entry__ import _poa_example
+from racon_tpu.ops.poa_graph import BUCKETS, graph_aligner
+from racon_tpu.ops.poa_pallas import fits_vmem, window_sweep
+
+B = 32
+for (nb, lb) in BUCKETS:
+    args = _poa_example(nb, lb, B, seed=7)
+    xla = graph_aligner(nb, lb, 4, 5, -4, -8,
+                        ring=64 if nb > 64 else 0)
+    t = time.time(); r_x = np.asarray(xla(*args)); tx_c = time.time() - t
+    t = time.time()
+    for _ in range(3):
+        r_x = np.asarray(xla(*args))
+    tx = (time.time() - t) / 3
+    line = f"bucket ({nb},{lb}) B={B}: xla {tx*1e3:.1f}ms (compile {tx_c:.1f}s)"
+    if fits_vmem(nb, lb):
+        interp = jax.default_backend() == "cpu"
+        pal = window_sweep(nb, lb, 4, 5, -4, -8, interpret=interp)
+        nn = np.full(B, nb, np.int32)
+        t = time.time(); r_p = np.asarray(pal(*args, nn)); tp_c = time.time() - t
+        t = time.time()
+        for _ in range(3):
+            r_p = np.asarray(pal(*args, nn))
+        tp = (time.time() - t) / 3
+        same = np.array_equal(r_x, r_p)
+        line += (f"  pallas {tp*1e3:.1f}ms (compile {tp_c:.1f}s) "
+                 f"identical={same} winner="
+                 f"{'pallas' if tp < tx else 'xla'}")
+    else:
+        line += "  pallas: exceeds VMEM budget"
+    print(line, flush=True)
+"""
+
 MINI = """
 import time
 from racon_tpu.core.polisher import create_polisher, PolisherType
@@ -138,6 +177,9 @@ def main() -> int:
         step("mini-session", MINI, 600),
         step("mini-fused", MINI, 600, {"SMOKE_ENGINE": "fused"}),
     ]
+    # informational: the XLA-vs-Pallas per-bucket decision data (never
+    # gates the smoke — its output picks the default DP path later)
+    step("pallas-profile", PALLAS_PROFILE, 900)
     if not args.skip_bench:
         env = dict(os.environ)
         env.setdefault("RACON_TPU_POA_BATCHES", "1")
