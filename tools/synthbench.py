@@ -45,6 +45,65 @@ def mutate(rng, s, rate):
     return bytes(out)
 
 
+def mutate_fast(nrng, s, rate):
+    """Vectorized mutate() twin (numpy RNG, different stream — only used
+    under --fast-sim, never for the seed-pinned goldens): same error
+    model, dels/ins/subs each at rate/3, insertions placed before the
+    kept base like mutate()."""
+    import numpy as np
+
+    arr = np.frombuffer(s, dtype=np.uint8).copy()
+    n = len(arr)
+    u = nrng.random(n)
+    dele = u < rate / 3
+    ins = (u >= rate / 3) & (u < 2 * rate / 3)
+    sub = (u >= 2 * rate / 3) & (u < rate)
+    bases = np.frombuffer(ACGT, dtype=np.uint8)
+    arr[sub] = bases[nrng.integers(0, 4, int(sub.sum()))]
+    out_len = np.where(dele, 0, np.where(ins, 2, 1))
+    off = np.zeros(n, dtype=np.int64)
+    np.cumsum(out_len[:-1], out=off[1:])
+    total = int(off[-1] + out_len[-1]) if n else 0
+    out = np.empty(total, dtype=np.uint8)
+    keep = ~dele
+    out[off[keep] + ins[keep]] = arr[keep]
+    ins_keep = ins & keep
+    out[off[ins_keep]] = bases[nrng.integers(0, 4, int(ins_keep.sum()))]
+    return out.tobytes()
+
+
+def simulate_fast(seed, genome_len, coverage, read_len, read_err,
+                  draft_err):
+    """Vectorized simulate() for multi-Mb genomes (numpy RNG stream;
+    deterministic for a seed but NOT byte-compatible with simulate())."""
+    import numpy as np
+
+    nrng = np.random.default_rng(seed)
+    bases = np.frombuffer(ACGT, dtype=np.uint8)
+    truth = bases[nrng.integers(0, 4, genome_len)].tobytes()
+    draft = mutate_fast(nrng, truth, draft_err)
+
+    comp = bytes.maketrans(b"ACGT", b"TGCA")
+    reads, paf = [], []
+    n_reads = genome_len * coverage // read_len
+    scale = len(draft) / len(truth)
+    starts = nrng.integers(0, max(1, genome_len - read_len // 2), n_reads)
+    strands = nrng.random(n_reads) < 0.5
+    for i in range(n_reads):
+        start = int(starts[i])
+        end = min(genome_len, start + read_len)
+        fwd = mutate_fast(nrng, truth[start:end], read_err)
+        read = fwd.translate(comp)[::-1] if strands[i] else fwd
+        name = f"read{i}"
+        t_begin = int(start * scale)
+        t_end = min(len(draft), int(end * scale))
+        reads.append((name, read))
+        paf.append(f"{name}\t{len(read)}\t0\t{len(read)}\t"
+                   f"{'-' if strands[i] else '+'}\tdraft\t{len(draft)}\t"
+                   f"{t_begin}\t{t_end}\t{end - start}\t{end - start}\t60")
+    return truth, draft, reads, paf
+
+
 def simulate(rng, genome_len, coverage, read_len, read_err, draft_err):
     truth = bytes(rng.choice(ACGT) for _ in range(genome_len))
     draft = mutate(rng, truth, draft_err)
@@ -84,6 +143,11 @@ def main(argv=None):
     ap.add_argument("-c", "--tpupoa-batches", type=int, default=0)
     ap.add_argument("--tpualigner-batches", type=int, default=0)
     ap.add_argument("--seed", type=int, default=42)
+    ap.add_argument("--fast-sim", action="store_true",
+                    help="vectorized simulator for multi-Mb genomes "
+                         "(deterministic per seed, but a different RNG "
+                         "stream than the default — goldens pin the "
+                         "default)")
     ap.add_argument("--golden-out", default=None,
                     help="write the polished FASTA here (golden artifact; "
                          "deterministic for a given seed/params)")
@@ -96,9 +160,14 @@ def main(argv=None):
     genome_len = args.genome_kb * 1000
     print(f"[synthbench] simulating {args.genome_kb} kb genome at "
           f"{args.coverage}x ...", file=sys.stderr)
-    truth, draft, reads, paf = simulate(rng, genome_len, args.coverage,
-                                        args.read_len, args.read_err,
-                                        args.draft_err)
+    if args.fast_sim:
+        truth, draft, reads, paf = simulate_fast(
+            args.seed, genome_len, args.coverage, args.read_len,
+            args.read_err, args.draft_err)
+    else:
+        truth, draft, reads, paf = simulate(rng, genome_len, args.coverage,
+                                            args.read_len, args.read_err,
+                                            args.draft_err)
 
     with tempfile.TemporaryDirectory() as d:
         reads_path = os.path.join(d, "reads.fasta.gz")
