@@ -267,7 +267,7 @@ def _run_scale(cap: float) -> None:
                         "tools", "synthbench.py")
     _run_phase("scale", cap, strict=True,
                argv=[sys.executable, tool, "--genome-kb", "250",
-                     "--coverage", "20", "-c", "1"],
+                     "--coverage", "20", "-c", "1", "--fast-sim"],
                env_extra={"RACON_TPU_ENGINE": "fused",
                           "RACON_TPU_FUSED_FALLBACK": "host"},
                expect_json=False)
