@@ -123,8 +123,9 @@ def fused_raw(n_nodes: int, seq_len: int, depth: int, max_pred: int,
                  kmax):
         # ring carry: only the last RING DP rows stay resident (slot 0 =
         # virtual source) — valid because the caller fails any lane whose
-        # predecessor distance exceeds the ring (measured max on real
-        # data: 29); the score at each lane's sink column is collected
+        # predecessor distance exceeds the ring (measured: 29 on the
+        # lambda sample, 72 on synthbench 250 kb — see poa_graph.RING);
+        # the score at each lane's sink column is collected
         # into a side carry as rows retire
         W = RING
         jidx = jnp.arange(L + 1, dtype=jnp.int32)
@@ -295,7 +296,8 @@ def fused_raw(n_nodes: int, seq_len: int, depth: int, max_pred: int,
             jnp.where(no_pred, 0, pr_rank[:, :, 0]))
         # dp_align's carry holds only the last RING rows — a lane with a
         # longer predecessor reach would read retired rows; fail it to
-        # the host engine (never seen on real data: measured max 29)
+        # the host engine (measured: 29 lambda / 72 synthbench, both
+        # within RING=128 — see poa_graph.RING)
         kk1 = jnp.arange(1, N + 1, dtype=jnp.int32)[None, :, None]
         ring_fail = ((pr_rank > 0) &
                      (kk1 - pr_rank > RING)).any(axis=(1, 2))

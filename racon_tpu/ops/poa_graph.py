@@ -175,14 +175,17 @@ _budget_logged: set = set()
 
 
 #: DP-carry ring depth for the ringed program variant: covers the
-#: measured max predecessor rank distance on real data (29 on the lambda
-#: sample; 99.95% of edges within 16) with >2x headroom. Batches that
-#: exceed it are routed to the full-carry program — compiled lazily on
-#: first occurrence (a one-time, cache-persisted cost taken only on
-#: inputs with >RING-rank back-edges, which the sample never produces —
-#: precompiling both variants for every bucket would double the upfront
-#: compile bill every run instead).
-RING = 64
+#: measured max predecessor rank distance across BOTH measured datasets
+#: (lambda sample: 29, 99.95% of edges within 16; synthbench 250 kb x
+#: 20x ONT-like: 72 — measured via RACON_TPU_ENVELOPE_STATS in round 5)
+#: with ~1.8x headroom over the worst observation. Round 4 shipped
+#: RING=64, which the second dataset EXCEEDED — that would have fired
+#: the round-3 failure mode (lazy mid-run full-carry compile) on chip.
+#: Batches that still exceed it are routed to the full-carry program —
+#: compiled lazily on first occurrence (one-time, cache-persisted).
+#: The fused engine fails >RING lanes to the host engine per window, so
+#: this constant bounds its real-data eligibility too.
+RING = 128
 
 
 def max_pred_distance(preds: np.ndarray) -> int:
@@ -675,8 +678,8 @@ class DeviceGraphPOA:
                                     seqs, lens, band,
                                     take(jobs["nnodes"], 0))
         # ring validity: every predecessor within RING ranks of its node
-        # (measured max on real data: 29; the full-carry program covers
-        # the rare batch that exceeds it)
+        # (measured: 29 lambda / 72 synthbench, see RING; the full-carry
+        # program covers the rare batch that exceeds it)
         fn = self._scan_kernel(nb, lb,
                                ring_ok=max_pred_distance(preds) <= RING)
         return self.runner.run(fn, codes, preds, centers, sinks, seqs,
