@@ -73,14 +73,13 @@ import time
 import numpy as np
 
 from ..utils.logger import Logger
-from .poa_graph import RING
-
-#: engine envelope: max nodes / columns per window graph, max layer len,
-#: max in-degree (same node budget as the session engine, measured on the
-#: lambda sample in round 4: graphs reach ~2000 nodes at depth 38)
-MAX_NODES = 2048
-MAX_LEN = 640
-MAX_PRED = 8
+#: envelope shared with the session engine (ONE source of truth, incl.
+#: the construction-time RACON_TPU_MAX_NODES override; measured: ~2000
+#: nodes at depth 38 on the lambda sample, and the default envelope
+#: device-builds 98.7% of windows at 30x coverage — see
+#: poa_graph.MAX_NODES and PARITY.md)
+from .poa_graph import (MAX_LEN, MAX_NODES, MAX_PRED, RING,
+                        env_max_nodes)
 
 #: layers per call; deeper windows chain calls with carried state
 DEPTH_BUCKETS = (8, 16, 32, 64)
@@ -575,12 +574,14 @@ class FusedPOA:
 
     def __init__(self, match: int, mismatch: int, gap: int,
                  num_threads: int = 1, logger: Logger | None = None,
-                 max_nodes: int = MAX_NODES, max_len: int = MAX_LEN,
+                 max_nodes: int | None = None, max_len: int = MAX_LEN,
                  max_pred: int = MAX_PRED, batch_rows: int | None = None,
                  depth_buckets=DEPTH_BUCKETS, banded_only: bool = False,
                  runner=None):
         from ..parallel.mesh import BatchRunner
 
+        if max_nodes is None:
+            max_nodes = env_max_nodes()
         self.match = match
         self.mismatch = mismatch
         self.gap = gap

@@ -54,6 +54,7 @@ parallel/mesh.py — the multi-chip analogue of cudapoa's batch-per-GPU loop
 from __future__ import annotations
 
 import functools
+import os
 from collections import deque
 
 import numpy as np
@@ -65,10 +66,42 @@ from ..utils.logger import Logger
 #: (lambda sample, depth <= 38: graphs grow to ~2000 nodes with layer
 #: insertions, layer slices <= 634 bp, in-degree <= 8 — envelope sweep in
 #: round 4 gave 0/96 host fallbacks at 2048/640/8 vs 39/96 at 1280);
-#: larger windows host-fallback per window.
+#: larger windows host-fallback per window. Round-5 measurement: at 30x
+#: coverage the default envelope device-builds 98.7% of windows (500 kb
+#: x 30x with exact overlap coordinates; a 2048-vs-3072 sweep changed
+#: NOTHING — the once-suspected "node envelope binds at 30x" was a
+#: synthbench coordinate-drift artifact, see PARITY.md). For workloads
+#: whose graphs genuinely exceed the envelope, RACON_TPU_MAX_NODES
+#: overrides it at ~linear per-row memory cost; the override resolves
+#: at ENGINE CONSTRUCTION (like every other RACON_TPU_* knob), not at
+#: import.
 MAX_NODES = 2048
 MAX_LEN = 640
 MAX_PRED = 8
+
+
+def env_max_nodes(default: int = MAX_NODES) -> int:
+    """The node envelope both engines use when the caller doesn't pass
+    one: RACON_TPU_MAX_NODES when set to a sane positive integer, else
+    `default`. Invalid values warn and fall back instead of crashing
+    the import or silently emptying the bucket ladder."""
+    import sys
+
+    raw = os.environ.get("RACON_TPU_MAX_NODES")
+    if not raw:
+        return default
+    try:
+        v = int(raw)
+    except ValueError:
+        v = -1
+    # upper bound: beyond 32k nodes a single DP row costs ~100 MB and a
+    # typo'd extra digit should warn, not OOM the device
+    if v < 512 or v > 32768:
+        print(f"[racon_tpu::env_max_nodes] warning: ignoring invalid "
+              f"RACON_TPU_MAX_NODES={raw!r} (want an integer in "
+              "[512, 32768])", file=sys.stderr)
+        return default
+    return v
 
 #: the full (nodes, len) bucket grid — every job shape is padded up into
 #: one of these four compiled programs (plus one batch size each). Graphs
@@ -123,7 +156,6 @@ def _device_budget(devices) -> int:
     recorded which path sized the batches). The chosen branch is logged
     on stderr once per process so every run's artifact shows whether a
     real free-memory reading drove the batch widths."""
-    import os
     import sys
 
     dev = devices[0]
@@ -403,18 +435,18 @@ class DeviceGraphPOA:
 
     def __init__(self, match: int, mismatch: int, gap: int,
                  num_threads: int = 1, logger: Logger | None = None,
-                 max_nodes: int = MAX_NODES, max_len: int = MAX_LEN,
+                 max_nodes: int | None = None, max_len: int = MAX_LEN,
                  max_pred: int = MAX_PRED, buckets=None,
                  batch_rows: int | None = None, cycle_jobs: int = _CYCLE_JOBS,
                  banded_only: bool = False, use_pallas: bool | None = None):
-        import os as _os
-
         from ..parallel.mesh import BatchRunner
 
+        if max_nodes is None:
+            max_nodes = env_max_nodes()
         #: RACON_TPU_PALLAS=1 routes VMEM-sized buckets through the
         #: resident pallas window-sweep kernel (ops/poa_pallas.py) instead
         #: of the XLA scan program — experimental until profiled on chip
-        self.use_pallas = (bool(_os.environ.get("RACON_TPU_PALLAS"))
+        self.use_pallas = (bool(os.environ.get("RACON_TPU_PALLAS"))
                            if use_pallas is None else use_pallas)
 
         self.match = match
@@ -441,7 +473,7 @@ class DeviceGraphPOA:
         self._env_stats = (
             {"max_nodes": 0, "max_len": 0, "max_pred_distance": 0,
              "max_in_degree": 0, "max_depth": 0}
-            if _os.environ.get("RACON_TPU_ENVELOPE_STATS") else None)
+            if os.environ.get("RACON_TPU_ENVELOPE_STATS") else None)
 
     def _pin_batch(self, bucket, forced) -> int:
         """ONE batch size per bucket: the largest power of two whose peak

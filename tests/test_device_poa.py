@@ -346,3 +346,31 @@ def test_graft_entry_dryrun():
     ranks = fn(*args)
     assert np.asarray(ranks).shape[0] == args[0].shape[0]
     __graft_entry__.dryrun_multichip(8)
+
+
+def test_max_nodes_env_knob_resolves_at_construction(monkeypatch, capsys):
+    """RACON_TPU_MAX_NODES must take effect at ENGINE CONSTRUCTION (a
+    late setenv — e.g. from a fixture or driver — must not be silently
+    ignored as an import-time read would), be shared by both engines,
+    and fall back with a warning on invalid values instead of crashing
+    or degenerating the bucket ladder."""
+    from racon_tpu.ops.poa_fused import FusedPOA
+    from racon_tpu.ops.poa_graph import MAX_NODES, DeviceGraphPOA
+
+    monkeypatch.setenv("RACON_TPU_MAX_NODES", "3072")
+    sess = DeviceGraphPOA(5, -4, -8, batch_rows=8)
+    fused = FusedPOA(5, -4, -8, batch_rows=8)
+    assert sess.max_nodes == 3072
+    assert sess.buckets[-1] == (3072, 640)
+    assert fused.N == 3072
+
+    for bad in ("bogus", "0", "-5", "999999999"):
+        monkeypatch.setenv("RACON_TPU_MAX_NODES", bad)
+        eng = DeviceGraphPOA(5, -4, -8, batch_rows=8)
+        assert eng.max_nodes == MAX_NODES, bad
+        assert "ignoring invalid" in capsys.readouterr().err
+
+    # explicit constructor argument always beats the env var
+    monkeypatch.setenv("RACON_TPU_MAX_NODES", "3072")
+    eng = DeviceGraphPOA(5, -4, -8, max_nodes=768, batch_rows=8)
+    assert eng.max_nodes == 768

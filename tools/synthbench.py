@@ -45,11 +45,19 @@ def mutate(rng, s, rate):
     return bytes(out)
 
 
-def mutate_fast(nrng, s, rate):
+def mutate_fast(nrng, s, rate, with_offsets=False):
     """Vectorized mutate() twin (numpy RNG, different stream — only used
     under --fast-sim, never for the seed-pinned goldens): same error
     model, dels/ins/subs each at rate/3, insertions placed before the
-    kept base like mutate()."""
+    kept base like mutate(). `with_offsets` additionally returns the
+    exact input→output coordinate maps — land[i] = output position of
+    input base i itself (for a deleted base: where it would have been)
+    and seg[i] = output start of base i's segment with seg[n] = total
+    output length, so seg[e] is the exclusive output end of span
+    [b, e). Callers use these to emit drift-free coordinates — at
+    multi-Mb scale the global-length-ratio approximation drifts by
+    hundreds of bases (indel-count fluctuation grows with length) and
+    distorts every derived overlap."""
     import numpy as np
 
     arr = np.frombuffer(s, dtype=np.uint8).copy()
@@ -69,6 +77,10 @@ def mutate_fast(nrng, s, rate):
     out[off[keep] + ins[keep]] = arr[keep]
     ins_keep = ins & keep
     out[off[ins_keep]] = bases[nrng.integers(0, 4, int(ins_keep.sum()))]
+    if with_offsets:
+        land = off + (ins & keep)
+        seg = np.append(off, total)
+        return out.tobytes(), land, seg
     return out.tobytes()
 
 
@@ -81,12 +93,16 @@ def simulate_fast(seed, genome_len, coverage, read_len, read_err,
     nrng = np.random.default_rng(seed)
     bases = np.frombuffer(ACGT, dtype=np.uint8)
     truth = bases[nrng.integers(0, 4, genome_len)].tobytes()
-    draft = mutate_fast(nrng, truth, draft_err)
+    # exact truth→draft coordinate map: PAF coordinates must be the
+    # draft positions where the read's truth span actually lands, not a
+    # global-length-ratio guess (which drifts ±hundreds of bases at
+    # multi-Mb scale and distorts every window layer derived from it)
+    draft, t_land, t_seg = mutate_fast(nrng, truth, draft_err,
+                                       with_offsets=True)
 
     comp = bytes.maketrans(b"ACGT", b"TGCA")
     reads, paf = [], []
     n_reads = genome_len * coverage // read_len
-    scale = len(draft) / len(truth)
     starts = nrng.integers(0, max(1, genome_len - read_len // 2), n_reads)
     strands = nrng.random(n_reads) < 0.5
     for i in range(n_reads):
@@ -95,8 +111,8 @@ def simulate_fast(seed, genome_len, coverage, read_len, read_err,
         fwd = mutate_fast(nrng, truth[start:end], read_err)
         read = fwd.translate(comp)[::-1] if strands[i] else fwd
         name = f"read{i}"
-        t_begin = int(start * scale)
-        t_end = min(len(draft), int(end * scale))
+        t_begin = int(t_land[start])
+        t_end = int(t_seg[end]) if end > start else t_begin
         reads.append((name, read))
         paf.append(f"{name}\t{len(read)}\t0\t{len(read)}\t"
                    f"{'-' if strands[i] else '+'}\tdraft\t{len(draft)}\t"
