@@ -94,8 +94,21 @@ def build_polisher(device_batches: int, aligner_batches: int = 0):
         DATA + "sample_layout.fasta.gz", PolisherType.kC, 500, 10.0, 0.3,
         True, 5, -4, -8, num_threads=os.cpu_count() or 1,
         tpu_poa_batches=device_batches,
-        tpu_aligner_batches=aligner_batches)
+        tpu_aligner_batches=aligner_batches,
+        # the async dispatch pipeline depth (0 = synchronous, for A/B
+        # bisection of the overlap win on the same data)
+        tpu_pipeline_depth=int(
+            os.environ.get("RACON_TPU_PIPELINE_DEPTH", "2")))
     return polisher
+
+
+def _stage_fields(polisher) -> dict:
+    """The polisher's per-stage pipeline counters, rounded for the JSON
+    artifact. Overlap evidence: pack+device+unpack stage seconds exceeding
+    the phase wall time means the stages really ran concurrently; device
+    seconds ~ 0 means the pipeline is silently dead."""
+    return {k: (round(v, 3) if isinstance(v, float) else v)
+            for k, v in polisher.stage_stats.items()}
 
 
 def _identity(polished) -> tuple[int, float]:
@@ -165,7 +178,8 @@ def phase_consensus(mode: str) -> int:
     print(f"[bench] edit distance vs reference assembly: {dist} "
           f"(identity {identity * 100:.2f}%; reference CPU fixture: 1312)",
           file=sys.stderr)
-    rec = {"mode": mode, "wps": wps, "windows": n_windows, "dist": dist}
+    rec = {"mode": mode, "wps": wps, "windows": n_windows, "dist": dist,
+           "stages": _stage_fields(polisher)}
     if device:
         rec["platform"] = _jax_platform()
     print(json.dumps(rec))
@@ -210,7 +224,8 @@ def phase_aligner() -> int:
                       "platform": _jax_platform(),
                       "pairs": polisher.n_aligner_pairs,
                       "device_pairs": polisher.n_aligner_device,
-                      "host_fallbacks": polisher.n_aligner_host_fallback}))
+                      "host_fallbacks": polisher.n_aligner_host_fallback,
+                      "stages": _stage_fields(polisher)}))
     return 0
 
 
@@ -311,9 +326,11 @@ def main() -> int:
     # budget (the host phase's slice is always reserved).
     fused_res = None
     device_res = None
+    fused_attempted = False
     if want_device or try_blind:
         cap = min(_FUSED_CAP, room(_HOST_CAP + 60))
         if cap > 120:
+            fused_attempted = True
             extra = ({"RACON_TPU_REQUIRE_ACCELERATOR": "1"}
                      if try_blind else None)
             fused_res = _run_phase("fused", cap, strict=True,
@@ -326,13 +343,19 @@ def main() -> int:
         cap = min(_DEVICE_CAP, room(_HOST_CAP + 60))
         if cap > 120:
             device_res = _run_phase("device", cap, strict=True)
-    # aligner phase: attempted whenever a device might exist, NOT gated on
-    # a consensus phase succeeding (round-4 verdict: the gate meant this
-    # kernel never produced a recorded number); its result lands in the
-    # final JSON artifact below
+    # aligner phase: attempted whenever a device is KNOWN to exist (probe
+    # success, forced, or the blind fused phase reached the chip — which
+    # sets want_device), NOT gated on a consensus phase succeeding
+    # (round-4 verdict: the gate meant this kernel never produced a
+    # recorded number). A blind fused phase that RAN and failed means the
+    # tunnel is dead: skip the blind aligner attempt too, so a dead
+    # tunnel costs exactly one subprocess cap and the CPU-pinned fallback
+    # below runs immediately (ADVICE round-5). A blind fused phase that
+    # never ran (budget too tight) proves nothing, so the blind aligner
+    # attempt is still made then.
     aligner_res = None
     aligner_backend = "device"
-    if want_device or try_blind:
+    if want_device or (try_blind and not fused_attempted):
         cap = min(_ALIGNER_CAP, room(_HOST_CAP + 60 + 180))
         if cap > 60:
             extra = ({"RACON_TPU_REQUIRE_ACCELERATOR": "1"}
@@ -400,6 +423,10 @@ def main() -> int:
             **aligner_fields}))
         return 1
     wps = float(res["wps"])
+    # per-stage pipeline counters of the headline phase: the overlap win
+    # is measurable (pack+device+unpack > phase wall) and a silently-dead
+    # pipeline is visible (device seconds ~ 0)
+    stage_fields = ({"stages": res["stages"]} if "stages" in res else {})
     label = {"fused": "device_fused", "device": "device",
              "host": "host"}[res["mode"]]
     # honesty clause: a device-engine phase that actually ran on the CPU
@@ -412,6 +439,7 @@ def main() -> int:
         "value": round(wps, 2),
         "unit": "windows/sec",
         "vs_baseline": round(wps / REFERENCE_CPU_WINDOWS_PER_SEC, 3),
+        **stage_fields,
         **aligner_fields,
     }))
     return 0

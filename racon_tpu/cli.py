@@ -74,6 +74,12 @@ usage: racon_tpu [options ...] <sequences> <overlaps> <target sequences>
             (byte-identical to the host engine) or single-launch
             whole-window fused (equal aggregate quality; rare tie-order
             divergence possible on deep windows)
+        --tpu-pipeline-depth <int>
+            default: 2
+            async dispatch pipeline depth: chunks packed/in flight ahead
+            of the one being unpacked (host pack, device compute, host
+            unpack and host-fallback work all overlap); 0 disables the
+            overlap entirely (synchronous path, for bisection)
         --tpualigner-batches <int>
             default: 0
             number of device batches for TPU accelerated alignment
@@ -108,6 +114,7 @@ def parse_args(argv: list[str]) -> dict | None:
         "tpu_aligner_band_width": 0,
         "tpu_banded_alignment": False,
         "tpu_engine": None,
+        "tpu_pipeline_depth": 2,
         "paths": [],
     }
 
@@ -134,7 +141,8 @@ def parse_args(argv: list[str]) -> dict | None:
                   "threads": ("num_threads", int),
                   "tpualigner-batches": ("tpu_aligner_batches", int),
                   "tpualigner-band-width": ("tpu_aligner_band_width", int),
-                  "tpu-engine": ("tpu_engine", _engine_choice)}
+                  "tpu-engine": ("tpu_engine", _engine_choice),
+                  "tpu-pipeline-depth": ("tpu_pipeline_depth", int)}
 
     def flag(name: str) -> bool:
         if name in ("u", "include-unpolished"):
@@ -256,7 +264,7 @@ def main(argv: list[str] | None = None) -> int:
             opts["mismatch"], opts["gap"], opts["num_threads"],
             opts["tpu_poa_batches"], opts["tpu_banded_alignment"],
             opts["tpu_aligner_batches"], opts["tpu_aligner_band_width"],
-            opts["tpu_engine"])
+            opts["tpu_engine"], opts["tpu_pipeline_depth"])
         polisher.initialize()
         polished = polisher.polish(opts["drop_unpolished_sequences"])
     except RaconError as exc:

@@ -45,12 +45,15 @@ def create_polisher(sequences_path: str, overlaps_path: str, target_path: str,
                     tpu_poa_batches: int = 0, tpu_banded_alignment: bool = True,
                     tpu_aligner_batches: int = 0,
                     tpu_aligner_band_width: int = 0,
-                    tpu_engine: str | None = None) -> "Polisher":
+                    tpu_engine: str | None = None,
+                    tpu_pipeline_depth: int = 2) -> "Polisher":
     """Factory mirroring reference createPolisher (polisher.cpp:55-160).
 
     The tpu_* knobs parallel the reference's CUDA flags (main.cpp:36-41); the
     device path is always available, so they tune batching rather than select
-    a different subclass.
+    a different subclass. `tpu_pipeline_depth` sizes the async dispatch
+    pipeline (pipeline.DispatchPipeline) both hot phases run through;
+    0 disables the overlap entirely (the synchronous path, for bisection).
     """
     if not isinstance(type_, PolisherType):
         raise RaconError("createPolisher", "invalid polisher type!")
@@ -64,7 +67,8 @@ def create_polisher(sequences_path: str, overlaps_path: str, target_path: str,
     return Polisher(sparser, oparser, tparser, type_, window_length,
                     quality_threshold, error_threshold, trim, match, mismatch,
                     gap, num_threads, tpu_poa_batches, tpu_banded_alignment,
-                    tpu_aligner_batches, tpu_aligner_band_width, tpu_engine)
+                    tpu_aligner_batches, tpu_aligner_band_width, tpu_engine,
+                    tpu_pipeline_depth)
 
 
 class Polisher:
@@ -74,7 +78,8 @@ class Polisher:
                  gap: int, num_threads: int = 1, tpu_poa_batches: int = 0,
                  tpu_banded_alignment: bool = True, tpu_aligner_batches: int = 0,
                  tpu_aligner_band_width: int = 0,
-                 tpu_engine: str | None = None):
+                 tpu_engine: str | None = None,
+                 tpu_pipeline_depth: int = 2):
         self.sparser = sparser
         self.oparser = oparser
         self.tparser = tparser
@@ -92,6 +97,14 @@ class Polisher:
         self.tpu_aligner_batches = tpu_aligner_batches
         self.tpu_aligner_band_width = tpu_aligner_band_width
         self.tpu_engine = tpu_engine
+        self.tpu_pipeline_depth = max(0, tpu_pipeline_depth)
+        # per-stage wall-clock counters shared by both hot phases' dispatch
+        # pipelines (pack / device / unpack / fallback seconds, launch and
+        # chunk counts) — the observability half of the overlap design;
+        # bench.py emits the snapshot in its JSON artifact
+        from ..pipeline import PipelineStats
+
+        self.pipeline_stats = PipelineStats()
 
         self.sequences: list[Sequence] = []
         self.windows: list[Window] = []
@@ -103,6 +116,21 @@ class Polisher:
         self.n_aligner_pairs = 0
         self.n_aligner_device = 0
         self.n_aligner_host_fallback = 0
+
+    def _make_pipeline(self):
+        """One DispatchPipeline per hot phase, all feeding the shared
+        stage counters. depth 0 = the synchronous path (bisection)."""
+        from ..pipeline import DispatchPipeline
+
+        return DispatchPipeline(depth=self.tpu_pipeline_depth,
+                                stats=self.pipeline_stats,
+                                fallback_workers=max(
+                                    1, min(4, self.num_threads)))
+
+    @property
+    def stage_stats(self) -> dict:
+        """Snapshot of the per-stage pipeline counters (both phases)."""
+        return self.pipeline_stats.snapshot()
 
     # ------------------------------------------------------------------ init
     def initialize(self) -> None:
@@ -339,11 +367,44 @@ class Polisher:
 
             runs = [None] * len(pairs)
             self.n_aligner_pairs = len(pairs)
+            handled: set[int] = set()
             if self.tpu_aligner_batches > 0:
                 from ..ops.align import BatchAligner
                 aligner = BatchAligner(band_width=self.tpu_aligner_band_width)
+                pipeline = self._make_pipeline()
+                fb: list[tuple[list[int], object]] = []
+                # concurrent fallback jobs split the thread budget so the
+                # pool never oversubscribes the host beyond num_threads;
+                # at depth 0 jobs run inline (serial) and keep the full
+                # budget — the synchronous bisection path must not be
+                # slower than the pre-pipeline code
+                fb_threads = (self.num_threads if pipeline.depth == 0
+                              else max(1, self.num_threads
+                                       // pipeline.fallback_workers))
+
+                def on_reject(idxs):
+                    # rejected pairs (too long for any bucket, or band-
+                    # clipped) start host-aligning the moment they are
+                    # known — the reference's GPU->CPU fallback
+                    # (cudapolisher.cpp:203-213), overlapped with the
+                    # device pass instead of serialized after it
+                    fb.extend(pipeline.map_fallback(
+                        idxs,
+                        lambda sub: nw_cigar_batch(
+                            [pairs[i] for i in sub], n_threads=fb_threads,
+                            progress=bar_n),
+                        chunk=512))
+
                 try:
-                    runs = aligner.align(pairs, progress=bar_n)
+                    with pipeline:
+                        runs = aligner.align(pairs, progress=bar_n,
+                                             pipeline=pipeline,
+                                             on_reject=on_reject)
+                        pipeline.drain_fallback()
+                    for sub, fut in fb:
+                        for i, c in zip(sub, fut.result()):
+                            need[i].cigar = c
+                        handled.update(sub)
                 except Exception as exc:  # device init/OOM: host completes
                     # the cudautils-style device error check with graceful
                     # degradation instead of exit (cudautils.hpp:10-18)
@@ -353,11 +414,13 @@ class Polisher:
                           f"alignment failed ({type(exc).__name__}: {exc}); "
                           "falling back to host aligner", file=sys.stderr)
                     runs = [None] * len(pairs)
+                    handled = set()  # in-flight fallback results discarded
                     self.logger.bar_total(len(pairs))  # restart progress
 
-            # host exact aligner for everything the device didn't take —
-            # the reference's GPU->CPU fallback (cudapolisher.cpp:203-213)
-            rest = [i for i, r in enumerate(runs) if r is None]
+            # host exact aligner for everything the device didn't take and
+            # the fallback pool didn't already finish
+            rest = [i for i, r in enumerate(runs)
+                    if r is None and i not in handled]
             if rest:
                 cigars = nw_cigar_batch([pairs[i] for i in rest],
                                         n_threads=self.num_threads,
@@ -370,10 +433,11 @@ class Polisher:
             # skip accounting mirrors the reference's "Aligned overlaps ...
             # on GPU" line (cudapolisher.cpp:204-206); exposed as counters
             # so the bench can put them in its JSON artifact
-            self.n_aligner_host_fallback = len(rest)
-            self.n_aligner_device = len(pairs) - len(rest)
-            if self.tpu_aligner_batches > 0 and rest:
-                print(f"[racon_tpu::Polisher.initialize] {len(rest)} overlaps "
+            self.n_aligner_host_fallback = len(rest) + len(handled)
+            self.n_aligner_device = len(pairs) - self.n_aligner_host_fallback
+            if self.tpu_aligner_batches > 0 and self.n_aligner_host_fallback:
+                print(f"[racon_tpu::Polisher.initialize] "
+                      f"{self.n_aligner_host_fallback} overlaps "
                       "aligned on host (device capacity fallback)",
                       file=sys.stderr)
 
@@ -408,19 +472,33 @@ class Polisher:
         else:
             profile_ctx = contextlib.nullcontext()
 
+        pipeline = self._make_pipeline()
+        # stage counters accumulate across phases (bench artifact wants
+        # the run total); the diagnostic line below must describe THIS
+        # phase only, so delta against the pre-phase snapshot
+        stats_base = self.pipeline_stats.snapshot()
         engine = BatchPOA(self.match, self.mismatch, self.gap,
                           self.window_length, num_threads=self.num_threads,
                           device_batches=self.tpu_poa_batches,
                           banded=self.tpu_banded_alignment,
                           band_width=self.tpu_aligner_band_width,
-                          logger=self.logger, engine=self.tpu_engine)
+                          logger=self.logger, engine=self.tpu_engine,
+                          pipeline=pipeline)
         t_consensus = _time.perf_counter()
-        with profile_ctx:
+        with profile_ctx, pipeline:
             engine.generate_consensus(self.windows, self.trim)
         dt = _time.perf_counter() - t_consensus
         if dt > 0 and self.windows:
             print(f"[racon_tpu::Polisher.polish] consensus throughput: "
                   f"{len(self.windows) / dt:.1f} windows/s", file=sys.stderr)
+        ss = {k: v - stats_base[k] for k, v in self.stage_stats.items()}
+        # overlap evidence: with the pipeline live, pack+device+unpack
+        # stage seconds exceed the phase wall time; additive means dead
+        print(f"[racon_tpu::Polisher.polish] pipeline stages (depth "
+              f"{self.tpu_pipeline_depth}): pack {ss['pack_s']:.2f}s "
+              f"device {ss['device_s']:.2f}s unpack {ss['unpack_s']:.2f}s "
+              f"fallback {ss['fallback_s']:.2f}s, {ss['chunks']} chunks / "
+              f"{ss['launches']} launches", file=sys.stderr)
 
         dst: list[Sequence] = []
         polished_data = bytearray()

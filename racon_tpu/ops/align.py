@@ -296,64 +296,107 @@ class BatchAligner:
                        for i in idxs) / len(idxs)
         return max(128, (int(mean_len * 0.1) + 127) // 128 * 128)
 
-    def align(self, pairs: list[tuple[bytes, bytes]],
-              progress=None) -> list[list[tuple[int, str]] | None]:
+    def align(self, pairs: list[tuple[bytes, bytes]], progress=None,
+              pipeline=None,
+              on_reject=None) -> list[list[tuple[int, str]] | None]:
         """Globally align each (query, target) pair. Returns per-pair op runs,
-        or None for rejected pairs (see class docstring)."""
+        or None for rejected pairs (see class docstring).
+
+        `pipeline` (pipeline.DispatchPipeline) overlaps host pack (operand
+        encoding + band offsets) and unpack (backpointer traceback) with
+        device compute; omitted, the stages run synchronously as before.
+        `on_reject(idx_list)` fires as soon as pairs are known to need the
+        host aligner — unbucketable pairs up front, band-clipped pairs per
+        chunk as tracebacks land — so the caller can start fallback work
+        concurrently with the device pass instead of scanning for None
+        afterwards.
+        """
         import jax
 
         from .encode import encode_padded
         from ..parallel.mesh import BatchRunner
+        from ..pipeline import DispatchPipeline
 
         runner = self.runner if self.runner is not None else BatchRunner()
+        pl = pipeline if pipeline is not None else DispatchPipeline(depth=0)
         results: list[list[tuple[int, str]] | None] = [None] * len(pairs)
         groups: dict[int, list[int]] = {}
+        unbucketed: list[int] = []
         for idx, (qs, ts) in enumerate(pairs):
             edge = self._bucket_of(max(len(qs), len(ts)))
             if edge is None or not qs or not ts:
-                continue  # host aligner handles these
+                unbucketed.append(idx)  # host aligner handles these
+                continue
             groups.setdefault(edge, []).append(idx)
+        if on_reject is not None and unbucketed:
+            on_reject(unbucketed)
 
+        chunks: list[tuple[int, int, int, list[int]]] = []
         for edge, idxs in sorted(groups.items()):
             band = self._band_for(pairs, idxs)
             n_waves = 2 * edge + 1
-            kernel = _kernel_for(band, n_waves)
-
             lane_bytes = n_waves * (band // 4)
             max_lanes = max(runner.n_devices,
                             self.MAX_BP_BYTES // lane_bytes)
-
             for s in range(0, len(idxs), max_lanes):
-                chunk = idxs[s:s + max_lanes]
-                qs = [pairs[i][0] for i in chunk]
-                ts = [pairs[i][1] for i in chunk]
-                lanes = runner.round_batch(len(chunk))
-                q_arr, q_lens = encode_padded(qs + [b"A"] * (lanes - len(chunk)), edge)
-                t_arr, t_lens = encode_padded(ts + [b"A"] * (lanes - len(chunk)), edge)
-                offs = np.stack([band_offsets(int(ql), int(tl), band, n_waves)
-                                 for ql, tl in zip(q_lens, t_lens)])
-                bp_packed, dist = runner.run(
-                    kernel, q_arr, t_arr, q_lens.astype(np.int32),
-                    t_lens.astype(np.int32), offs,
-                    out_batch_axes=(1, 0))  # bp is [n_waves, B, band//4]
-                dist = np.asarray(dist).astype(np.int64)
-                bp = _unpack_bp(np.asarray(jax.device_get(bp_packed)))
-                runs, touched = _traceback(bp, offs, q_lens, t_lens)
-                # second clipping signal: an in-band cost far above what a
-                # <=30%-error overlap can produce means the true (off-band)
-                # path was clipped — e.g. a large balanced indel whose
-                # in-band "alignment" is a run of mismatches
-                suspicious = dist > 0.4 * np.maximum(q_lens, t_lens)
-                accepted = 0
-                for lane, i_pair in enumerate(chunk):
-                    if touched[lane] or suspicious[lane]:
-                        self.n_band_rejects += 1  # clipped: host re-aligns
-                    else:
-                        results[i_pair] = runs[lane]
-                        accepted += 1
-                if progress is not None:
-                    # rejected pairs tick when the host fallback aligns them
-                    progress(accepted)
+                chunks.append((edge, band, n_waves, idxs[s:s + max_lanes]))
+
+        def pack(chunk):
+            edge, band, n_waves, idx = chunk
+            qs = [pairs[i][0] for i in idx]
+            ts = [pairs[i][1] for i in idx]
+            lanes = runner.round_batch(len(idx))
+            q_arr, q_lens = encode_padded(qs + [b"A"] * (lanes - len(idx)),
+                                          edge)
+            t_arr, t_lens = encode_padded(ts + [b"A"] * (lanes - len(idx)),
+                                          edge)
+            offs = np.stack([band_offsets(int(ql), int(tl), band, n_waves)
+                             for ql, tl in zip(q_lens, t_lens)])
+            return q_arr, t_arr, q_lens, t_lens, offs
+
+        def dispatch(chunk, ops):
+            edge, band, n_waves, idx = chunk
+            q_arr, t_arr, q_lens, t_lens, offs = ops
+            kernel = _kernel_for(band, n_waves)
+            bp_packed, dist = runner.run(
+                kernel, q_arr, t_arr, q_lens.astype(np.int32),
+                t_lens.astype(np.int32), offs,
+                out_batch_axes=(1, 0))  # bp is [n_waves, B, band//4]
+            pl.stats.bump("launches")
+            return bp_packed, dist, q_lens, t_lens, offs
+
+        def wait(handle):
+            bp_packed, dist, q_lens, t_lens, offs = handle
+            dist = np.asarray(dist).astype(np.int64)
+            bp = np.asarray(jax.device_get(bp_packed))
+            return bp, dist, q_lens, t_lens, offs
+
+        def unpack(chunk, res):
+            edge, band, n_waves, idx = chunk
+            bp_packed, dist, q_lens, t_lens, offs = res
+            bp = _unpack_bp(bp_packed)
+            runs, touched = _traceback(bp, offs, q_lens, t_lens)
+            # second clipping signal: an in-band cost far above what a
+            # <=30%-error overlap can produce means the true (off-band)
+            # path was clipped — e.g. a large balanced indel whose
+            # in-band "alignment" is a run of mismatches
+            suspicious = dist > 0.4 * np.maximum(q_lens, t_lens)
+            accepted = 0
+            rejected: list[int] = []
+            for lane, i_pair in enumerate(idx):
+                if touched[lane] or suspicious[lane]:
+                    self.n_band_rejects += 1  # clipped: host re-aligns
+                    rejected.append(i_pair)
+                else:
+                    results[i_pair] = runs[lane]
+                    accepted += 1
+            if on_reject is not None and rejected:
+                on_reject(rejected)
+            if progress is not None:
+                # rejected pairs tick when the host fallback aligns them
+                progress(accepted)
+
+        pl.run(chunks, pack, dispatch, wait, unpack)
         return results
 
 

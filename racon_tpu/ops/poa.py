@@ -33,7 +33,7 @@ class BatchPOA:
                  window_length: int, num_threads: int = 1,
                  device_batches: int = 0, banded: bool = False,
                  band_width: int = 0, logger: Logger | None = None,
-                 engine: str | None = None):
+                 engine: str | None = None, pipeline=None):
         self.match = match
         self.mismatch = mismatch
         self.gap = gap
@@ -49,6 +49,11 @@ class BatchPOA:
         # pattern (racon_test.cpp:292-496 pins GPU numbers separately).
         self.banded_only = banded
         self.logger = logger
+        # the polisher's async dispatch pipeline (pipeline.DispatchPipeline
+        # or None): overlaps host pack/unpack with compute in both the
+        # fused device path and the host chunk loop; None keeps every
+        # stage synchronous (direct callers, tests)
+        self.pipeline = pipeline
         # device engine selection: explicit parameter (the CLI's
         # --tpu-engine) wins over the RACON_TPU_ENGINE env var; an empty
         # env value means unset (the `VAR= cmd` idiom), not a typo
@@ -98,16 +103,38 @@ class BatchPOA:
         if self.logger is not None:
             self.logger.bar_total(len(host))
 
-        for s in range(0, len(host), self.HOST_CHUNK):
-            chunk = host[s:s + self.HOST_CHUNK]
-            packed = [_pack(w) for w in chunk]
-            results = poa_batch(packed, self.match, self.mismatch, self.gap,
-                                n_threads=self.num_threads)
+        # the host engine runs through the same staged pipeline: the
+        # native POA call (GIL released inside the C++ batch entry point)
+        # computes chunk k on the dispatch thread while a pack worker
+        # builds chunk k+1's window lists and the unpack worker trims
+        # chunk k-1
+        from ..pipeline import DispatchPipeline
+
+        pl = (self.pipeline if self.pipeline is not None
+              else DispatchPipeline(depth=0))
+        chunks = [host[s:s + self.HOST_CHUNK]
+                  for s in range(0, len(host), self.HOST_CHUNK)]
+
+        def pack(chunk):
+            return [_pack(w) for w in chunk]
+
+        def dispatch(chunk, packed):
+            results = poa_batch(packed, self.match, self.mismatch,
+                                self.gap, n_threads=self.num_threads)
+            pl.stats.bump("launches")
+            return results
+
+        def wait(results):
+            return results
+
+        def unpack(chunk, results):
             for w, (cons, cov) in zip(chunk, results):
                 w.apply_trim(cons, cov, trim)
             if bar is not None:
                 for _ in chunk:
                     bar("[racon_tpu::Polisher.polish] generating consensus")
+
+        pl.run(chunks, pack, dispatch, wait, unpack)
 
     def _device_consensus(self, todo, trim):
         """Device consensus over all of `todo`; unfit/failed windows are
@@ -140,14 +167,16 @@ class BatchPOA:
             # (cudapolisher.cpp:354-383), no second device engine compile
             to_host = (os.environ.get("RACON_TPU_FUSED_FALLBACK",
                                       "session") == "host")
-            results, statuses = fused.consensus(packed, fallback=to_host)
+            results, statuses = fused.consensus(packed, fallback=to_host,
+                                                pipeline=self.pipeline)
             rest = [i for i, r in enumerate(results) if r is None]
             fs = fused.last_stats
             print(f"[racon_tpu::BatchPOA] fused engine built "
                   f"{int((statuses == 0).sum())} windows "
                   f"({fs['chunks']} chunks, {fs['launches']} device "
-                  f"launches, dispatch {fs['dispatch_s']:.2f}s, finalize "
-                  f"{fs['finalize_s']:.2f}s); {fused.n_fallback} to "
+                  f"launches, pack {fs['pack_s']:.2f}s, device "
+                  f"{fs['device_s']:.2f}s, finalize {fs['unpack_s']:.2f}s); "
+                  f"{fused.n_fallback} to "
                   f"{'host' if to_host else 'session'} engine",
                   file=sys.stderr)
             if rest:

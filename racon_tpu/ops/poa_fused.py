@@ -68,7 +68,8 @@ Requires jax x64 (the order keys are int64); enabled at kernel build.
 from __future__ import annotations
 
 import functools
-import time
+import os
+import sys
 
 import numpy as np
 
@@ -600,8 +601,8 @@ class FusedPOA:
         else:
             self.B = self._pin_rows() * self.runner.n_devices
         self.depth_buckets = tuple(depth_buckets)
-        self.last_stats = {"chunks": 0, "launches": 0,
-                           "dispatch_s": 0.0, "finalize_s": 0.0}
+        self.last_stats = {"chunks": 0, "launches": 0, "pack_s": 0.0,
+                           "device_s": 0.0, "unpack_s": 0.0}
         # -b / banded-only: trust banded DP results (skip the clipped ->
         # full-DP retry), the reference's GPU-only speed/accuracy trade
         self.banded_only = banded_only
@@ -701,11 +702,25 @@ class FusedPOA:
         return (codes, preds, predw, nseq, col_of, colkey,
                 colnodes, bpos, n_nodes, n_cols, failed)
 
-    def consensus(self, windows, fallback: bool = True):
+    def consensus(self, windows, fallback: bool = True, pipeline=None):
         """fallback=False leaves ineligible/failed windows as (None,
         status 1) for the caller to polish (e.g. with the session engine,
-        which handles non-spanning layers via subgraphs)."""
+        which handles non-spanning layers via subgraphs).
+
+        `pipeline` (pipeline.DispatchPipeline) drives the chunk loop:
+        while chunk k's chained calls compute on device, a pack worker
+        builds chunk k+1's layer operands, an unpack worker fetches and
+        C++-finalizes chunk k-1, and fused-ineligible windows are host-
+        polished on the fallback pool concurrently with the device pass —
+        the stream-overlap role of the reference's per-batch CUDA streams
+        (cudapolisher.cpp:165-199). Omitted, an internal depth-1 pipeline
+        reproduces the engine's historical one-chunk lookahead. A chunk
+        whose device call raises is routed to the host fallback (per-chunk
+        GPU->CPU discipline, cudapolisher.cpp:354-383) unless
+        RACON_TPU_STRICT is set, in which case the error propagates.
+        """
         from ..native import poa_batch
+        from ..pipeline import DispatchPipeline
 
         n = len(windows)
         results: list = [None] * n
@@ -720,43 +735,109 @@ class FusedPOA:
         # windows are processed deepest-first so each batch chunk chains
         # a similar number of calls (padding layers are not free)
         fused_idx.sort(key=lambda i: -len(windows[i]))
+        fused_set = set(fused_idx)
 
         bar = self.logger.bar if self.logger is not None else None
         if self.logger is not None and fused_idx:
             self.logger.bar_total(len(fused_idx))
 
-        self.last_stats = stats = {"chunks": 0, "launches": 0,
-                                   "dispatch_s": 0.0, "finalize_s": 0.0}
+        self.last_stats = stats = {"chunks": 0, "launches": 0, "pack_s": 0.0,
+                                   "device_s": 0.0, "unpack_s": 0.0}
+        own_pipeline = pipeline is None
+        pl = pipeline if pipeline is not None else DispatchPipeline(depth=1)
 
-        def _done(chunk, state):
-            t = time.perf_counter()
-            self._finalize_chunk(chunk, state, results, statuses)
-            stats["finalize_s"] += time.perf_counter() - t
+        # upfront-known host work overlaps the device pass: windows the
+        # fused engine cannot take are submitted to the fallback pool NOW
+        # instead of serialized after every device chunk retires;
+        # concurrent jobs split the thread budget so the pool never
+        # oversubscribes the host beyond num_threads
+        prefall: list[tuple[list[int], object]] = []
+        if fallback and pl.depth > 0:
+            ineligible = [i for i in range(n)
+                          if statuses[i] == 1 and i not in fused_set]
+            fb_threads = max(1, self.num_threads // pl.fallback_workers)
+            prefall = pl.map_fallback(
+                ineligible,
+                lambda sub: poa_batch([windows[i] for i in sub],
+                                      self.match, self.mismatch, self.gap,
+                                      n_threads=fb_threads))
+
+        def pack(chunk):
+            return self._pack_chunk(windows, chunk)
+
+        def dispatch(chunk, packed):
+            state, calls = packed
+            # state stays on device across chained calls (a fetch here
+            # would round-trip ~5 MB of graph arrays per call); only the
+            # final state is materialized for the host finalizer
+            for d, ops, done in calls:
+                state = self._call(d, state, *ops, done)
+            pl.stats.bump("launches", len(calls))
+            return state
+
+        def wait(state):
+            return tuple(np.asarray(x) for x in state)
+
+        def _tick(chunk):
             if bar is not None:
                 for _ in chunk:
                     bar("[racon_tpu::Polisher.polish] "
                         "building whole-window POA graphs on device")
 
-        # pipelined: chunk k+1's layer packing + dispatch happen while
-        # chunk k computes on device (jax dispatch is async; only the
-        # finalize's fetch blocks) — the stream-overlap role of the
-        # reference's per-batch CUDA streams (cudapolisher.cpp:165-199)
-        pending = None
-        for s in range(0, len(fused_idx), self.B):
-            chunk = fused_idx[s:s + self.B]
-            t = time.perf_counter()
-            state = self._dispatch_chunk(windows, chunk)
-            stats["dispatch_s"] += time.perf_counter() - t
-            stats["chunks"] += 1
-            if pending is not None:
-                _done(*pending)
-            pending = (chunk, state)
-        if pending is not None:
-            _done(*pending)
+        def unpack(chunk, np_state):
+            self._finalize_chunk(chunk, np_state, results, statuses)
+            streak["n"] = 0
+            _tick(chunk)
 
-        # everything left is ineligible or device-failed
+        #: consecutive-chunk-failure circuit breaker: one flaky chunk is
+        #: routed to the host fallback, but a device that fails every
+        #: chunk (dead tunnel, OOM) must not burn a pack+dispatch attempt
+        #: per chunk — after MAX_STREAK in a row the whole pass aborts,
+        #: restoring the old first-exception whole-batch fallback
+        streak = {"n": 0}
+        MAX_STREAK = 3
+
+        def on_error(chunk, exc):
+            # the chunk's windows stay unbuilt; the fallback tail below
+            # polishes every one of them on host
+            streak["n"] += 1
+            print(f"[racon_tpu::FusedPOA] warning: device chunk failed "
+                  f"({type(exc).__name__}: {exc}); {len(chunk)} windows "
+                  "to fallback", file=sys.stderr)
+            if streak["n"] >= MAX_STREAK:
+                raise RuntimeError(
+                    f"{streak['n']} consecutive device chunk failures; "
+                    "aborting the device pass") from exc
+            _tick(chunk)
+
+        chunk_items = [fused_idx[s:s + self.B]
+                       for s in range(0, len(fused_idx), self.B)]
+        strict = bool(os.environ.get("RACON_TPU_STRICT"))
+        try:
+            # the pipeline already counts and times every stage callback;
+            # this run's share is the delta against the (possibly
+            # phase-shared) counters — nothing else runs on the pipeline
+            # meanwhile
+            base = pl.stats.snapshot()
+            pl.run(chunk_items, pack, dispatch, wait, unpack,
+                   on_error=None if strict else on_error)
+            after = pl.stats.snapshot()
+            for key in ("pack_s", "device_s", "unpack_s", "chunks",
+                        "launches"):
+                stats[key] = after[key] - base[key]
+
+            pl.drain_fallback()
+            for sub, fut in prefall:
+                for i, r in zip(sub, fut.result()):
+                    results[i] = r
+                    statuses[i] = 1
+        finally:
+            if own_pipeline:
+                pl.close()
+
+        # everything left is ineligible (depth-0 path) or device-failed
         rest = [i for i in range(n) if results[i] is None]
-        self.n_fallback = len(rest)
+        self.n_fallback = len(rest) + sum(len(s) for s, _ in prefall)
         if rest and fallback:
             host = poa_batch([windows[i] for i in rest], self.match,
                              self.mismatch, self.gap,
@@ -766,9 +847,12 @@ class FusedPOA:
                 statuses[i] = 1
         return results, statuses
 
-    def _dispatch_chunk(self, windows, chunk):
-        """Build and dispatch every chained call for one window chunk;
-        returns the (device-resident, in-flight) final state."""
+    def _pack_chunk(self, windows, chunk):
+        """Host-only packing for one window chunk: the init state plus
+        every chained call's padded layer operands. Returns (state,
+        [(depth_bucket, operand_arrays, layer_base), ...]) — no device
+        interaction, so a pipeline pack worker can run it while an older
+        chunk computes."""
         backbones = [windows[i][0][0] for i in chunk]
         bweights = [_weights_of(windows[i][0][1], len(windows[i][0][0]))
                     for i in chunk]
@@ -776,12 +860,12 @@ class FusedPOA:
         depth = max(len(windows[i]) - 1 for i in chunk)
         done = 0
         plan = self._chain_plan(depth)
-        self.last_stats["launches"] += len(plan)
         # per-window constants, hoisted out of the chained-call loop:
         # layer order is a stable sort by begin, the host engine's visit
         # order (reference window.cpp:84-85)
         metas = [(sorted(windows[i][1:], key=lambda s: s[2]),
                   len(windows[i][0][0])) for i in chunk]
+        calls = []
         for d in plan:
             seqs = np.full((self.B, d, self.L), 5, np.int8)
             lens = np.zeros((self.B, d), np.int32)
@@ -811,13 +895,9 @@ class FusedPOA:
                     # the layer fits, exact DP otherwise)
                     if abs(len(seq) - span) < 256 // 2 - 16:
                         band[k, dd] = 256
-            # state stays on device across chained calls (a fetch here
-            # would round-trip ~5 MB of graph arrays per call); only the
-            # final state is materialized for the host finalizer
-            state = self._call(d, state, seqs, lens, wts, rlo, rhi, band,
-                               done)
+            calls.append((d, (seqs, lens, wts, rlo, rhi, band), done))
             done += d
-        return state
+        return state, calls
 
     def _finalize_chunk(self, chunk, state, results, statuses):
         from ..native import poa_finish_arrays

@@ -1,0 +1,303 @@
+"""Double-buffered async dispatch pipeline.
+
+The reference keeps every CUDA device saturated by running several batch
+objects per GPU off a shared work index, so host-side fill/fetch of one
+batch overlaps device compute of another (cudapolisher.cpp:165-199,
+228-345). Both of our hot phases were strictly synchronous instead: pack a
+chunk on host, block on the device call, unpack on host, repeat — with all
+host-fallback work serialized after the device pass. `DispatchPipeline` is
+the TPU-shaped equivalent of the reference's per-device batch threads:
+
+  - a PACK worker thread builds chunk k+1's padded operands while
+  - the caller's thread DISPATCHES chunk k to the device (JAX dispatch is
+    async — the call returns as soon as the program is enqueued) while
+  - an UNPACK worker thread blocks on chunk k-1's results and finishes
+    them on host (CIGAR traceback for the aligner, C++ consensus for the
+    fused POA engine) while
+  - a small FALLBACK thread pool chews host-only work (envelope-tail
+    windows, band-clipped overlaps) as soon as it is discovered instead
+    of after the device pass.
+
+`depth` bounds how many chunks sit packed-but-undispatched and
+dispatched-but-unwaited (double buffering at the default depth=2);
+`depth=0` is the fully synchronous single-threaded path — byte-identical
+output, kept for bisection — in which `submit_fallback` also runs inline.
+
+Stage wall-clock is accumulated into a `PipelineStats` (shareable across
+phases): pack / device / unpack / fallback seconds plus chunk, launch and
+error counts. "device seconds" is time spent against the compute stage:
+dispatching (which for a host compute engine is the blocking native call
+itself) plus the time the unpack worker spends blocked on results — with
+real overlap, pack+unpack+device stage seconds exceed the phase's wall
+time; in a dead (synchronous) pipeline they are additive. bench.py
+publishes the counters in its JSON artifact so the overlap is measurable,
+not anecdotal.
+
+Error discipline: without `on_error`, the first stage exception aborts the
+run and re-raises (the RACON_TPU_STRICT posture). With `on_error(item,
+exc)`, the failed chunk is skipped and the run continues — callers route
+the chunk's items to their host fallback, the per-window GPU->CPU
+discipline of cudapolisher.cpp:354-383 at chunk granularity. `on_error`
+itself raising aborts the run with that exception.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from concurrent.futures import Future, ThreadPoolExecutor
+
+_STOP = object()
+
+
+class PipelineStats:
+    """Thread-safe per-stage counters, shareable across pipeline phases."""
+
+    _FLOAT_KEYS = ("pack_s", "device_s", "unpack_s", "fallback_s")
+    _INT_KEYS = ("launches", "chunks", "errors")
+    KEYS = _FLOAT_KEYS + _INT_KEYS
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._v = {k: 0.0 for k in self._FLOAT_KEYS}
+        self._v.update({k: 0 for k in self._INT_KEYS})
+
+    def bump(self, key: str, amount=1) -> None:
+        with self._lock:
+            self._v[key] += amount
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return dict(self._v)
+
+
+class DispatchPipeline:
+    """Stage driver for one device-batched loop (see module docstring).
+
+    run(items, pack, dispatch, wait, unpack):
+      pack(item) -> operands            host work, pack worker thread
+      dispatch(item, operands) -> h     caller's thread (async device call)
+      wait(h) -> result                 blocks on the device, unpack thread
+      unpack(item, result) -> None      host work, unpack worker thread
+
+    Items flow through the stages in order; unpack order equals dispatch
+    order (FIFO), so result assembly is deterministic. All device calls
+    stay on the caller's thread — the only JAX interaction off it is
+    blocking on/fetching finished results in `wait`.
+    """
+
+    def __init__(self, depth: int = 2, fallback_workers: int = 2,
+                 stats: PipelineStats | None = None):
+        self.depth = max(0, int(depth))
+        self.fallback_workers = max(1, int(fallback_workers))
+        self.stats = stats if stats is not None else PipelineStats()
+        self._executor: ThreadPoolExecutor | None = None
+        self._futures: list[Future] = []
+
+    # ------------------------------------------------------------ stages
+    def run(self, items, pack, dispatch, wait, unpack, on_error=None) -> None:
+        items = list(items)
+        if self.depth == 0:
+            self._run_sync(items, pack, dispatch, wait, unpack, on_error)
+            return
+        self._run_async(items, pack, dispatch, wait, unpack, on_error)
+
+    def _run_sync(self, items, pack, dispatch, wait, unpack, on_error):
+        stats = self.stats
+        for item in items:
+            try:
+                t0 = time.perf_counter()
+                ops = pack(item)
+                stats.bump("pack_s", time.perf_counter() - t0)
+                t0 = time.perf_counter()
+                handle = dispatch(item, ops)
+                stats.bump("device_s", time.perf_counter() - t0)
+                stats.bump("chunks")
+                t0 = time.perf_counter()
+                res = wait(handle)
+                stats.bump("device_s", time.perf_counter() - t0)
+                t0 = time.perf_counter()
+                unpack(item, res)
+                stats.bump("unpack_s", time.perf_counter() - t0)
+            except Exception as exc:
+                stats.bump("errors")
+                if on_error is None:
+                    raise
+                on_error(item, exc)
+
+    def _run_async(self, items, pack, dispatch, wait, unpack, on_error):
+        stats = self.stats
+        fatal: list[BaseException] = []
+        abort = threading.Event()
+
+        def guard(item, exc):
+            stats.bump("errors")
+            if on_error is None:
+                fatal.append(exc)
+                abort.set()
+                return
+            try:
+                on_error(item, exc)
+            except BaseException as handler_exc:
+                fatal.append(handler_exc)
+                abort.set()
+
+        packed_q: queue.Queue = queue.Queue(maxsize=self.depth)
+        waiting_q: queue.Queue = queue.Queue(maxsize=self.depth)
+
+        def packer():
+            try:
+                for item in items:
+                    if abort.is_set():
+                        break
+                    try:
+                        t0 = time.perf_counter()
+                        ops = pack(item)
+                        stats.bump("pack_s", time.perf_counter() - t0)
+                    except Exception as exc:
+                        guard(item, exc)
+                        continue
+                    packed_q.put((item, ops))
+            finally:
+                packed_q.put(_STOP)
+
+        def unpacker():
+            while True:
+                entry = waiting_q.get()
+                if entry is _STOP:
+                    return
+                if abort.is_set():
+                    continue
+                item, handle = entry
+                try:
+                    t0 = time.perf_counter()
+                    res = wait(handle)
+                    stats.bump("device_s", time.perf_counter() - t0)
+                    t0 = time.perf_counter()
+                    unpack(item, res)
+                    stats.bump("unpack_s", time.perf_counter() - t0)
+                except Exception as exc:
+                    guard(item, exc)
+
+        def drain(q):
+            while True:
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    return
+
+        t_pack = threading.Thread(target=packer, name="racon-tpu-pack",
+                                  daemon=True)
+        t_unpack = threading.Thread(target=unpacker, name="racon-tpu-unpack",
+                                    daemon=True)
+        t_pack.start()
+        t_unpack.start()
+        try:
+            # the dispatch loop always drains packed_q to its sentinel and
+            # waiting_q always gets one, so neither worker can deadlock on
+            # a bounded-queue put even when abort fires mid-stream
+            while True:
+                entry = packed_q.get()
+                if entry is _STOP:
+                    break
+                if abort.is_set():
+                    continue
+                item, ops = entry
+                try:
+                    t0 = time.perf_counter()
+                    handle = dispatch(item, ops)
+                    stats.bump("device_s", time.perf_counter() - t0)
+                    stats.bump("chunks")
+                except Exception as exc:
+                    guard(item, exc)
+                    continue
+                waiting_q.put((item, handle))
+        except BaseException:
+            # exceptional exit (KeyboardInterrupt is the real case): the
+            # workers may be blocked on the bounded queues, so a plain
+            # join would deadlock. Set abort, keep the queues draining
+            # while the packer winds down, and never block indefinitely —
+            # an unpacker stuck inside a hung device wait() is a daemon
+            # thread and is abandoned rather than hanging the caller.
+            abort.set()
+            while t_pack.is_alive():
+                drain(packed_q)
+                t_pack.join(timeout=0.1)
+            drain(waiting_q)
+            try:
+                waiting_q.put_nowait(_STOP)
+            except queue.Full:
+                pass
+            t_unpack.join(timeout=2.0)
+            raise
+        waiting_q.put(_STOP)
+        t_unpack.join()
+        t_pack.join()
+        if fatal:
+            raise fatal[0]
+
+    # ---------------------------------------------------- fallback pool
+    def submit_fallback(self, fn, *args, **kwargs) -> Future:
+        """Schedule host-only work concurrently with the device stages
+        (inline at depth 0). Returns a Future; collect with `.result()`
+        after `drain_fallback()`."""
+        stats = self.stats
+
+        def timed():
+            t0 = time.perf_counter()
+            try:
+                return fn(*args, **kwargs)
+            finally:
+                stats.bump("fallback_s", time.perf_counter() - t0)
+
+        if self.depth == 0:
+            fut: Future = Future()
+            try:
+                fut.set_result(timed())
+            except BaseException as exc:
+                fut.set_exception(exc)
+        else:
+            if self._executor is None:
+                self._executor = ThreadPoolExecutor(
+                    max_workers=self.fallback_workers,
+                    thread_name_prefix="racon-tpu-fallback")
+            fut = self._executor.submit(timed)
+        self._futures.append(fut)
+        return fut
+
+    def map_fallback(self, idxs, fn, chunk: int = 256) -> list:
+        """Submit `fn(sub)` for successive `chunk`-sized slices of `idxs`.
+        Returns [(sub, future), ...]; collect each future's result (one
+        entry per index in `sub`) after drain_fallback() — the shared
+        submit half of the reject-fallback protocol both hot phases use."""
+        out = []
+        for s in range(0, len(idxs), chunk):
+            sub = list(idxs[s:s + chunk])
+            out.append((sub, self.submit_fallback(fn, sub)))
+        return out
+
+    def drain_fallback(self, ignore_errors: bool = False) -> None:
+        """Block until every submitted fallback job finished; re-raises
+        the first failure unless `ignore_errors` (the abandon path)."""
+        futures, self._futures = self._futures, []
+        first: BaseException | None = None
+        for fut in futures:
+            try:
+                fut.result()
+            except BaseException as exc:
+                if first is None:
+                    first = exc
+        if first is not None and not ignore_errors:
+            raise first
+
+    def close(self) -> None:
+        if self._executor is not None:
+            self._executor.shutdown(wait=True)
+            self._executor = None
+
+    def __enter__(self) -> "DispatchPipeline":
+        return self
+
+    def __exit__(self, *exc_info) -> None:
+        self.close()

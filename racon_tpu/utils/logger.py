@@ -8,6 +8,7 @@ fixed 20-bin progress bar, `total(msg)` prints cumulative elapsed time.
 from __future__ import annotations
 
 import sys
+import threading
 import time
 
 
@@ -18,6 +19,10 @@ class Logger:
         self._bar_count = 0
         self._bar_total = 0
         self._total = 0.0
+        # bar() is ticked concurrently by the dispatch pipeline's unpack
+        # worker and fallback pool (pipeline/__init__.py); the tick
+        # read-modify-write needs the lock or progress is lost
+        self._bar_lock = threading.Lock()
 
     def log(self, msg: str | None = None) -> None:
         now = time.perf_counter()
@@ -31,28 +36,30 @@ class Logger:
 
     def bar_total(self, total: int) -> None:
         """Arm the 20-bin progress bar for `total` upcoming bar() calls."""
-        self._bar_total = max(total, 1)
-        self._bar_count = 0
-        self._bar = 0
+        with self._bar_lock:
+            self._bar_total = max(total, 1)
+            self._bar_count = 0
+            self._bar = 0
 
     def bar(self, msg: str) -> None:
-        self._bar_count += 1
-        bins = min(20 * self._bar_count // self._bar_total, 20)
-        if bins == self._bar and bins < 20:
-            return
-        self._bar = bins
-        filled = "=" * bins + (">" if bins < 20 else "")
-        sys.stderr.write(f"{msg} [{filled:<20}] {bins * 5}%")
-        if bins == 20 and self._bar_count >= self._bar_total:
-            elapsed = time.perf_counter() - self._time
-            self._total += elapsed
-            sys.stderr.write(f" {elapsed:.5f} s\n")
-            self._bar = 0
-            self._bar_count = 0
-            self._time = time.perf_counter()
-        else:
-            sys.stderr.write("\r")
-        sys.stderr.flush()
+        with self._bar_lock:
+            self._bar_count += 1
+            bins = min(20 * self._bar_count // self._bar_total, 20)
+            if bins == self._bar and bins < 20:
+                return
+            self._bar = bins
+            filled = "=" * bins + (">" if bins < 20 else "")
+            sys.stderr.write(f"{msg} [{filled:<20}] {bins * 5}%")
+            if bins == 20 and self._bar_count >= self._bar_total:
+                elapsed = time.perf_counter() - self._time
+                self._total += elapsed
+                sys.stderr.write(f" {elapsed:.5f} s\n")
+                self._bar = 0
+                self._bar_count = 0
+                self._time = time.perf_counter()
+            else:
+                sys.stderr.write("\r")
+            sys.stderr.flush()
 
     def total(self, msg: str) -> None:
         elapsed = self._total + (time.perf_counter() - self._time if self._bar else 0)

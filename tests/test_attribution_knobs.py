@@ -1,0 +1,132 @@
+"""C++ attribution-knob coverage (native/src/poa.cpp; PARITY.md).
+
+The three quality-gap attribution knobs — RACON_TPU_HOST_BAND,
+RACON_TPU_CONSENSUS_EXT, RACON_TPU_TIEBREAK — were measured once for
+PARITY.md and then left untested (ADVICE round-5): a regression in, e.g.,
+the branch-completion re-scan would go unnoticed while the knobs stay
+documented in README. Each knob latches from getenv in a static
+initializer (one read per process), so every configuration runs in its
+own subprocess.
+"""
+
+import os
+import random
+import subprocess
+import sys
+
+from racon_tpu.native import edit_distance
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+ACGT = b"ACGT"
+
+#: child: build one deterministic spanning window (seed 11; length 400 so
+#: the default band 256 is genuinely narrower than the DP matrix), run the
+#: host POA, print truth / backbone / consensus / coverages
+SNIPPET = """\
+import os, random
+from racon_tpu.native import poa_batch
+
+ACGT = b"ACGT"
+rng = random.Random(11)
+
+
+def mutate(s, rate):
+    out = bytearray()
+    for c in s:
+        r = rng.random()
+        if r < rate / 3:
+            continue
+        if r < 2 * rate / 3:
+            out.append(rng.choice(ACGT))
+            out.append(c)
+            continue
+        if r < rate:
+            out.append(rng.choice(ACGT))
+            continue
+        out.append(c)
+    return bytes(out)
+
+
+truth = bytes(rng.choice(ACGT) for _ in range(400))
+clean = os.environ.get("RACON_KNOB_WINDOW") == "clean"
+bb = truth if clean else mutate(truth, 0.08)
+win = [(bb, None, 0, len(bb) - 1)]
+for _ in range(5):
+    lay = truth if clean else mutate(truth, 0.08)
+    win.append((lay, None, 0, len(bb) - 1))
+cons, cov = poa_batch([win], 3, -5, -4)[0]
+print(truth.decode())
+print(bb.decode())
+print(cons.decode())
+print(",".join(str(x) for x in cov.tolist()))
+"""
+
+
+def run_poa(env_extra=None, window="mut"):
+    env = dict(os.environ, RACON_KNOB_WINDOW=window, **(env_extra or {}))
+    proc = subprocess.run([sys.executable, "-c", SNIPPET], cwd=REPO,
+                          env=env, capture_output=True, text=True,
+                          timeout=120)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    truth, bb, cons, cov = proc.stdout.strip().splitlines()[-4:]
+    return (truth.encode(), bb.encode(), cons.encode(),
+            [int(x) for x in cov.split(",")])
+
+
+def test_host_band_zero_matches_default():
+    """HOST_BAND=0 (exact full DP always) must equal the default banded
+    run on a fixture window — the PARITY.md exoneration pinned as a test:
+    the clip-retry rule recovers everything banding could lose."""
+    t0, b0, cons_default, cov_default = run_poa()
+    t1, b1, cons_full, cov_full = run_poa({"RACON_TPU_HOST_BAND": "0"})
+    assert (t0, b0) == (t1, b1)  # same deterministic window
+    assert cons_full == cons_default
+    assert cov_full == cov_default
+
+
+def test_consensus_ext_branch_yields_valid_spanning_path():
+    """CONSENSUS_EXT=branch (spoa-style branch completion) must still
+    produce a valid spanning consensus: ACGT-only, window-scale length,
+    and at least as close to the truth as the unpolished backbone."""
+    truth, bb, cons, cov = run_poa({"RACON_TPU_CONSENSUS_EXT": "branch"})
+    assert cons and set(cons) <= set(ACGT)
+    assert 0.8 * len(bb) <= len(cons) <= 1.2 * len(bb)
+    assert len(cov) == len(cons)
+    assert all(1 <= c <= 6 for c in cov)
+    assert edit_distance(cons, truth) <= edit_distance(bb, truth)
+
+
+def test_tiebreak_dhv_identical_on_tie_free_window():
+    """On a window whose layers equal the backbone exactly, the all-match
+    diagonal path is strictly optimal — no equal-score indel choice exists
+    for the tie order to flip — so dhv must reproduce the default
+    byte-for-byte (a changed output would mean the knob alters more than
+    equal-score tie selection)."""
+    _, _, cons_default, cov_default = run_poa(window="clean")
+    _, _, cons_dhv, cov_dhv = run_poa({"RACON_TPU_TIEBREAK": "dhv"},
+                                      window="clean")
+    assert cons_dhv == cons_default
+    assert cov_dhv == cov_default
+
+
+def test_tiebreak_dhv_valid_on_noisy_window():
+    """On a noisy window dhv may legitimately pick different equal-score
+    indel placements (PARITY.md: tie-class noise); the output must still
+    be a valid consensus of the same quality class."""
+    truth, bb, cons, cov = run_poa({"RACON_TPU_TIEBREAK": "dhv"})
+    assert cons and set(cons) <= set(ACGT)
+    assert 0.8 * len(bb) <= len(cons) <= 1.2 * len(bb)
+    assert len(cov) == len(cons)
+    assert edit_distance(cons, truth) <= edit_distance(bb, truth)
+
+
+def test_knob_defaults_are_inert():
+    """Setting every knob to its documented default value must be a
+    no-op vs an env-free run (guards against the getenv comparisons
+    drifting from the documented defaults)."""
+    base = run_poa()
+    pinned = run_poa({"RACON_TPU_HOST_BAND": "256",
+                      "RACON_TPU_TIEBREAK": "dvh",
+                      "RACON_TPU_CONSENSUS_EXT": "greedy"})
+    assert pinned == base

@@ -38,6 +38,16 @@ def test_bench_host_only_emits_json_line():
     # 3 dp) — compare with an absolute tolerance covering both roundings
     assert rec["vs_baseline"] == pytest.approx(rec["value"] / 50.0,
                                                abs=1.1e-3)
+    # the artifact must carry the per-stage pipeline counters so CI can
+    # see a silently-dead pipeline: the compute stage reading ~0 seconds
+    # while the phase reported a throughput would be the tell
+    stages = rec["stages"]
+    for key in ("pack_s", "device_s", "unpack_s", "fallback_s",
+                "launches", "chunks", "errors"):
+        assert key in stages
+    assert stages["device_s"] > 0
+    assert stages["launches"] >= 1
+    assert stages["errors"] == 0
 
 
 def test_bench_emits_json_even_when_phases_cannot_run():

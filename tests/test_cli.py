@@ -55,6 +55,15 @@ def test_optional_c_argument():
     assert o["paths"] == ["a.fq", "b.paf", "c.fa"]
 
 
+def test_tpu_pipeline_depth_flag():
+    o = parse_args(["r.fq", "o.paf", "t.fa"])
+    assert o["tpu_pipeline_depth"] == 2  # default: double buffering
+    o = parse_args(["--tpu-pipeline-depth", "0", "r.fq", "o.paf", "t.fa"])
+    assert o["tpu_pipeline_depth"] == 0  # synchronous bisection path
+    o = parse_args(["--tpu-pipeline-depth=3", "r.fq", "o.paf", "t.fa"])
+    assert o["tpu_pipeline_depth"] == 3
+
+
 def test_missing_inputs_exit_code():
     assert main([]) == 1
 

@@ -61,14 +61,17 @@ import time
 import numpy as np
 import jax
 from __graft_entry__ import _poa_example
-from racon_tpu.ops.poa_graph import BUCKETS, graph_aligner
+from racon_tpu.ops.poa_graph import BUCKETS, RING, graph_aligner
 from racon_tpu.ops.poa_pallas import fits_vmem, window_sweep
 
 B = 32
 for (nb, lb) in BUCKETS:
     args = _poa_example(nb, lb, B, seed=7)
+    # the ring width production runs (_scan_kernel), so the XLA-vs-Pallas
+    # decision times the shipped configuration (ADVICE round-5: a
+    # hardcoded ring=64 went stale when RING was raised to 128)
     xla = graph_aligner(nb, lb, 4, 5, -4, -8,
-                        ring=64 if nb > 64 else 0)
+                        ring=RING if nb > RING else 0)
     t = time.time(); r_x = np.asarray(xla(*args)); tx_c = time.time() - t
     t = time.time()
     for _ in range(3):
