@@ -106,7 +106,14 @@ def _stage_fields(polisher) -> dict:
     """The polisher's per-stage pipeline counters, rounded for the JSON
     artifact. Overlap evidence: pack+device+unpack stage seconds exceeding
     the phase wall time means the stages really ran concurrently; device
-    seconds ~ 0 means the pipeline is silently dead."""
+    seconds ~ 0 means the pipeline is silently dead.
+
+    The snapshot also carries the resilience degradation report (faults /
+    retries / timeouts / backoff_s / breaker_trips / quarantined /
+    cancelled — racon_tpu/resilience/): all zero on a clean run, and a
+    nonzero `quarantined` or `breaker_trips` on a STRICT-less phase means
+    the throughput number was earned on a degraded path — CI should read
+    these next to the stage counters before trusting a comparison."""
     return {k: (round(v, 3) if isinstance(v, float) else v)
             for k, v in polisher.stage_stats.items()}
 

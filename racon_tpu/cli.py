@@ -80,6 +80,23 @@ usage: racon_tpu [options ...] <sequences> <overlaps> <target sequences>
             of the one being unpacked (host pack, device compute, host
             unpack and host-fallback work all overlap); 0 disables the
             overlap entirely (synchronous path, for bisection)
+        --tpu-device-timeout <float>
+            default: 0 (off)
+            watchdog deadline in seconds for each device-stage call; a
+            call past the deadline raises a timeout and the chunk is
+            retried with exponential backoff (RACON_TPU_DEVICE_RETRIES,
+            default 1) before routing to the host fallback
+        --tpu-strict
+            re-raise device failures instead of degrading to the host
+            fallback / per-window quarantine (mirrors RACON_TPU_STRICT;
+            the bench/CI discipline)
+        --tpu-fault-plan <spec>
+            default: none
+            deterministic fault injection for resilience testing
+            (mirrors RACON_TPU_FAULT_PLAN): comma-separated
+            <stage>:chunk=<N>:<action> entries with stage one of
+            pack|device|unpack|fallback and action raise | corrupt |
+            hang=<seconds>, e.g. 'device:chunk=3:raise,unpack:chunk=2:corrupt'
         --tpualigner-batches <int>
             default: 0
             number of device batches for TPU accelerated alignment
@@ -115,6 +132,9 @@ def parse_args(argv: list[str]) -> dict | None:
         "tpu_banded_alignment": False,
         "tpu_engine": None,
         "tpu_pipeline_depth": 2,
+        "tpu_device_timeout": 0.0,
+        "tpu_strict": False,
+        "tpu_fault_plan": None,
         "paths": [],
     }
 
@@ -142,7 +162,9 @@ def parse_args(argv: list[str]) -> dict | None:
                   "tpualigner-batches": ("tpu_aligner_batches", int),
                   "tpualigner-band-width": ("tpu_aligner_band_width", int),
                   "tpu-engine": ("tpu_engine", _engine_choice),
-                  "tpu-pipeline-depth": ("tpu_pipeline_depth", int)}
+                  "tpu-pipeline-depth": ("tpu_pipeline_depth", int),
+                  "tpu-device-timeout": ("tpu_device_timeout", float),
+                  "tpu-fault-plan": ("tpu_fault_plan", str)}
 
     def flag(name: str) -> bool:
         if name in ("u", "include-unpolished"):
@@ -153,6 +175,8 @@ def parse_args(argv: list[str]) -> dict | None:
             opts["trim"] = False
         elif name in ("b", "tpu-banded-alignment"):
             opts["tpu_banded_alignment"] = True
+        elif name == "tpu-strict":
+            opts["tpu_strict"] = True
         else:
             return False
         return True
@@ -256,6 +280,20 @@ def main(argv: list[str] | None = None) -> int:
     from .core.polisher import create_polisher, PolisherType
 
     try:
+        # posture flags mirror their env knobs (env-only knobs are
+        # invisible in --help): set the env so every layer — pipelines
+        # constructed anywhere, strict checks in the ops — sees them
+        if opts["tpu_strict"]:
+            import os
+
+            os.environ["RACON_TPU_STRICT"] = "1"
+        if opts["tpu_fault_plan"]:
+            import os
+
+            from .resilience import FaultPlan
+
+            FaultPlan.parse(opts["tpu_fault_plan"])  # fail fast on typos
+            os.environ["RACON_TPU_FAULT_PLAN"] = opts["tpu_fault_plan"]
         polisher = create_polisher(
             opts["paths"][0], opts["paths"][1], opts["paths"][2],
             PolisherType.kF if opts["fragment_correction"] else PolisherType.kC,
@@ -264,7 +302,8 @@ def main(argv: list[str] | None = None) -> int:
             opts["mismatch"], opts["gap"], opts["num_threads"],
             opts["tpu_poa_batches"], opts["tpu_banded_alignment"],
             opts["tpu_aligner_batches"], opts["tpu_aligner_band_width"],
-            opts["tpu_engine"], opts["tpu_pipeline_depth"])
+            opts["tpu_engine"], opts["tpu_pipeline_depth"],
+            opts["tpu_device_timeout"])
         polisher.initialize()
         polished = polisher.polish(opts["drop_unpolished_sequences"])
     except RaconError as exc:

@@ -22,7 +22,8 @@ import sys
 
 import numpy as np
 
-from ..errors import RaconError
+from ..errors import DeviceError, RaconError, as_device_error
+from ..resilience import degradation_summary, strict_mode
 from ..io.parsers import create_sequence_parser, create_overlap_parser
 from ..utils.logger import Logger
 from ..utils.cigar import cigar_from_ops
@@ -46,7 +47,8 @@ def create_polisher(sequences_path: str, overlaps_path: str, target_path: str,
                     tpu_aligner_batches: int = 0,
                     tpu_aligner_band_width: int = 0,
                     tpu_engine: str | None = None,
-                    tpu_pipeline_depth: int = 2) -> "Polisher":
+                    tpu_pipeline_depth: int = 2,
+                    tpu_device_timeout: float = 0.0) -> "Polisher":
     """Factory mirroring reference createPolisher (polisher.cpp:55-160).
 
     The tpu_* knobs parallel the reference's CUDA flags (main.cpp:36-41); the
@@ -54,6 +56,9 @@ def create_polisher(sequences_path: str, overlaps_path: str, target_path: str,
     a different subclass. `tpu_pipeline_depth` sizes the async dispatch
     pipeline (pipeline.DispatchPipeline) both hot phases run through;
     0 disables the overlap entirely (the synchronous path, for bisection).
+    `tpu_device_timeout` (seconds, 0 = off) arms the resilience watchdog:
+    device-stage calls run under that deadline with bounded retry +
+    backoff before a chunk routes to host fallback.
     """
     if not isinstance(type_, PolisherType):
         raise RaconError("createPolisher", "invalid polisher type!")
@@ -68,7 +73,7 @@ def create_polisher(sequences_path: str, overlaps_path: str, target_path: str,
                     quality_threshold, error_threshold, trim, match, mismatch,
                     gap, num_threads, tpu_poa_batches, tpu_banded_alignment,
                     tpu_aligner_batches, tpu_aligner_band_width, tpu_engine,
-                    tpu_pipeline_depth)
+                    tpu_pipeline_depth, tpu_device_timeout)
 
 
 class Polisher:
@@ -79,7 +84,8 @@ class Polisher:
                  tpu_banded_alignment: bool = True, tpu_aligner_batches: int = 0,
                  tpu_aligner_band_width: int = 0,
                  tpu_engine: str | None = None,
-                 tpu_pipeline_depth: int = 2):
+                 tpu_pipeline_depth: int = 2,
+                 tpu_device_timeout: float = 0.0):
         self.sparser = sparser
         self.oparser = oparser
         self.tparser = tparser
@@ -98,6 +104,7 @@ class Polisher:
         self.tpu_aligner_band_width = tpu_aligner_band_width
         self.tpu_engine = tpu_engine
         self.tpu_pipeline_depth = max(0, tpu_pipeline_depth)
+        self.tpu_device_timeout = max(0.0, tpu_device_timeout)
         # per-stage wall-clock counters shared by both hot phases' dispatch
         # pipelines (pack / device / unpack / fallback seconds, launch and
         # chunk counts) — the observability half of the overlap design;
@@ -119,13 +126,21 @@ class Polisher:
 
     def _make_pipeline(self):
         """One DispatchPipeline per hot phase, all feeding the shared
-        stage counters. depth 0 = the synchronous path (bisection)."""
+        stage counters. depth 0 = the synchronous path (bisection).
+        The resilience posture rides along: the device watchdog (CLI
+        --tpu-device-timeout winning over the env knobs) and the armed
+        fault plan, both usually None — the zero-overhead clean path."""
         from ..pipeline import DispatchPipeline
+        from ..resilience import Watchdog, get_fault_plan
 
         return DispatchPipeline(depth=self.tpu_pipeline_depth,
                                 stats=self.pipeline_stats,
                                 fallback_workers=max(
-                                    1, min(4, self.num_threads)))
+                                    1, min(4, self.num_threads)),
+                                watchdog=Watchdog.from_env(
+                                    timeout=self.tpu_device_timeout
+                                    or None),
+                                faults=get_fault_plan())
 
     @property
     def stage_stats(self) -> dict:
@@ -395,27 +410,44 @@ class Polisher:
                             progress=bar_n),
                         chunk=512))
 
+                def degrade(exc: DeviceError):
+                    # the cudautils-style device error check with graceful
+                    # degradation instead of exit (cudautils.hpp:10-18).
+                    # Before the host re-align pass restarts, the fallback
+                    # pool must be emptied — cancel the queued jobs and
+                    # drain the running ones — or orphaned fallback
+                    # threads would keep aligning (and bumping the
+                    # just-restarted progress bar) underneath it
+                    cancelled, drained = pipeline.cancel_fallback()
+                    print("[racon_tpu::Polisher.initialize] warning: device "
+                          f"alignment failed ({exc}); falling back to host "
+                          f"aligner ({cancelled} fallback jobs cancelled, "
+                          f"{drained} drained)", file=sys.stderr)
+                    self.logger.bar_total(len(pairs))  # restart progress
+                    return [None] * len(pairs), set()
+
                 try:
-                    with pipeline:
-                        runs = aligner.align(pairs, progress=bar_n,
-                                             pipeline=pipeline,
-                                             on_reject=on_reject)
-                        pipeline.drain_fallback()
+                    runs = aligner.align(pairs, progress=bar_n,
+                                         pipeline=pipeline,
+                                         on_reject=on_reject)
+                    pipeline.drain_fallback()
                     for sub, fut in fb:
                         for i, c in zip(sub, fut.result()):
                             need[i].cigar = c
                         handled.update(sub)
-                except Exception as exc:  # device init/OOM: host completes
-                    # the cudautils-style device error check with graceful
-                    # degradation instead of exit (cudautils.hpp:10-18)
-                    if os.environ.get("RACON_TPU_STRICT"):
+                except DeviceError as exc:
+                    if strict_mode():
                         raise
-                    print("[racon_tpu::Polisher.initialize] warning: device "
-                          f"alignment failed ({type(exc).__name__}: {exc}); "
-                          "falling back to host aligner", file=sys.stderr)
-                    runs = [None] * len(pairs)
-                    handled = set()  # in-flight fallback results discarded
-                    self.logger.bar_total(len(pairs))  # restart progress
+                    runs, handled = degrade(exc)
+                except RaconError:
+                    raise  # user-facing input error: never degraded away
+                except Exception as exc:  # device init/OOM: host completes
+                    if strict_mode():
+                        raise
+                    runs, handled = degrade(as_device_error(
+                        exc, "Polisher.initialize"))
+                finally:
+                    pipeline.close()
 
             # host exact aligner for everything the device didn't take and
             # the fallback pool didn't already finish
@@ -499,6 +531,13 @@ class Polisher:
               f"device {ss['device_s']:.2f}s unpack {ss['unpack_s']:.2f}s "
               f"fallback {ss['fallback_s']:.2f}s, {ss['chunks']} chunks / "
               f"{ss['launches']} launches", file=sys.stderr)
+        # degradation report: what the resilience layer absorbed across
+        # the whole run (silent on a clean run); the same counters ride
+        # stage_stats into bench.py's JSON artifact
+        degraded = degradation_summary(self.stage_stats)
+        if degraded:
+            print(f"[racon_tpu::Polisher.polish] degradation report: "
+                  f"{degraded}", file=sys.stderr)
 
         dst: list[Sequence] = []
         polished_data = bytearray()

@@ -19,10 +19,16 @@ from __future__ import annotations
 
 import gzip
 import io
+import zlib
 
 from ..errors import RaconError
 from ..core.sequence import Sequence
 from ..core.overlap import Overlap
+
+#: what a truncated or corrupt gzip member raises mid-stream; mapped to a
+#: RaconError naming the offending file so the CLI reports it cleanly
+#: instead of leaking a raw traceback
+_GZIP_ERRORS = (EOFError, zlib.error, gzip.BadGzipFile)
 
 
 def _open(path: str):
@@ -59,11 +65,17 @@ class _StreamingParser:
         if self._gen is None:
             self.reset()
         total = 0
-        for record, nbytes in self._gen:
-            dst.append(record)
-            total += nbytes
-            if max_bytes != -1 and total >= max_bytes:
-                return True
+        try:
+            for record, nbytes in self._gen:
+                dst.append(record)
+                total += nbytes
+                if max_bytes != -1 and total >= max_bytes:
+                    return True
+        except _GZIP_ERRORS as exc:
+            raise RaconError(
+                type(self).__name__,
+                f"truncated or corrupt gzip input {self.path}! "
+                f"({type(exc).__name__}: {exc})") from None
         return False
 
     def _records(self, f):  # pragma: no cover - abstract

@@ -309,13 +309,20 @@ class BatchAligner:
         host aligner — unbucketable pairs up front, band-clipped pairs per
         chunk as tracebacks land — so the caller can start fallback work
         concurrently with the device pass instead of scanning for None
-        afterwards.
+        afterwards. With `on_reject` armed and strict mode off, a device
+        chunk that still fails after the pipeline's watchdog/retry policy
+        is routed the same way — its pairs host-align and the device pass
+        continues (chunk-granularity GPU->CPU discipline,
+        cudapolisher.cpp:354-383) instead of aborting the whole phase.
         """
+        import sys
+
         import jax
 
         from .encode import encode_padded
         from ..parallel.mesh import BatchRunner
         from ..pipeline import DispatchPipeline
+        from ..resilience import strict_mode
 
         runner = self.runner if self.runner is not None else BatchRunner()
         pl = pipeline if pipeline is not None else DispatchPipeline(depth=0)
@@ -372,6 +379,7 @@ class BatchAligner:
             return bp, dist, q_lens, t_lens, offs
 
         def unpack(chunk, res):
+            streak["n"] = 0  # a chunk came all the way back: device alive
             edge, band, n_waves, idx = chunk
             bp_packed, dist, q_lens, t_lens, offs = res
             bp = _unpack_bp(bp_packed)
@@ -396,7 +404,37 @@ class BatchAligner:
                 # rejected pairs tick when the host fallback aligns them
                 progress(accepted)
 
-        pl.run(chunks, pack, dispatch, wait, unpack)
+        #: consecutive-chunk-failure circuit breaker (the FusedPOA
+        #: discipline): one flaky chunk degrades to the host fallback,
+        #: but a wedged device must not cost a watchdog deadline + retry
+        #: per chunk for the whole phase — after MAX_STREAK in a row the
+        #: pass aborts and the polisher's whole-phase host fallback runs
+        streak = {"n": 0}
+        MAX_STREAK = 3
+
+        def chunk_error(chunk, exc):
+            # a chunk dead after watchdog/retry: its pairs host-align via
+            # the reject protocol; results stay complete, never crash
+            edge, band, n_waves, idx = chunk
+            streak["n"] += 1
+            print(f"[racon_tpu::BatchAligner] warning: device chunk "
+                  f"failed ({type(exc).__name__}: {exc}); {len(idx)} "
+                  "pairs to host fallback", file=sys.stderr)
+            if streak["n"] >= MAX_STREAK:
+                from ..errors import DeviceError
+
+                pl.stats.bump("breaker_trips")
+                err = DeviceError(
+                    "BatchAligner",
+                    f"{streak['n']} consecutive device chunk failures; "
+                    "aborting the device alignment pass")
+                err.__cause__ = exc
+                raise err
+            on_reject(list(idx))
+
+        pl.run(chunks, pack, dispatch, wait, unpack,
+               on_error=(chunk_error if on_reject is not None
+                         and not strict_mode() else None))
         return results
 
 

@@ -18,6 +18,15 @@ reference. Two engines:
 
 Windows with fewer than 3 sequences keep their backbone (reference
 window.cpp:68-71); TGS windows are coverage-trimmed (window.cpp:118-139).
+
+Failure ladder (racon_tpu/resilience/): device consensus falls back to
+the host engine (whole-batch or, in the fused path, per chunk); a HOST
+chunk that fails is retried window by window; a window that still fails
+alone is QUARANTINED — it keeps its draft backbone as consensus, counts
+as unpolished (so the XC ratio reflects it, mirroring the reference's
+`ratio > 0` handling, polisher.cpp:515) and bumps the `quarantined`
+degradation counter. Only strict mode turns any of these back into a
+raise. The run never aborts on a single poisoned window.
 """
 
 from __future__ import annotations
@@ -25,6 +34,7 @@ from __future__ import annotations
 import os
 
 from ..native import poa_batch
+from ..resilience import strict_mode
 from ..utils.logger import Logger
 
 
@@ -86,16 +96,32 @@ class BatchPOA:
         if self.device_batches > 0:
             import sys
 
+            from ..errors import DeviceError, RaconError
+
+            def degrade(msg):
+                # the device pass died mid-flight: before the host pass
+                # reruns the unpolished windows, empty the shared
+                # fallback pool — a queued/running prefall job would
+                # keep polishing those same windows underneath it
+                if self.pipeline is not None:
+                    self.pipeline.cancel_fallback()
+                print(f"[racon_tpu::BatchPOA] warning: device consensus "
+                      f"failed ({msg}); falling back to host engine",
+                      file=sys.stderr)
+                return [w for w in todo if not w.polished]
+
             try:
-                self._device_consensus(todo, trim)
-                host = []
-            except Exception as exc:  # device init/OOM: host completes all
-                if os.environ.get("RACON_TPU_STRICT"):
+                host = self._device_consensus(todo, trim)
+            except RaconError as exc:
+                # device failures degrade; genuine user-facing errors
+                # (bad input discovered late) propagate regardless
+                if not isinstance(exc, DeviceError) or strict_mode():
                     raise
-                print("[racon_tpu::BatchPOA] warning: device consensus "
-                      f"failed ({type(exc).__name__}: {exc}); falling back "
-                      "to host engine", file=sys.stderr)
-                host = [w for w in todo if not w.polished]
+                host = degrade(str(exc))
+            except Exception as exc:  # device init/OOM: host completes all
+                if strict_mode():
+                    raise
+                host = degrade(f"{type(exc).__name__}: {exc}")
 
         if not host:
             return
@@ -134,11 +160,40 @@ class BatchPOA:
                 for _ in chunk:
                     bar("[racon_tpu::Polisher.polish] generating consensus")
 
-        pl.run(chunks, pack, dispatch, wait, unpack)
+        def chunk_error(chunk, exc):
+            # host-chunk failure: retry each window on its own; a window
+            # that fails alone is poisoned — quarantine it (draft
+            # backbone as consensus, counted) and keep the run alive
+            import sys
 
-    def _device_consensus(self, todo, trim):
-        """Device consensus over all of `todo`; unfit/failed windows are
-        host-polished internally, so nothing is left over.
+            print(f"[racon_tpu::BatchPOA] warning: host consensus chunk "
+                  f"failed ({type(exc).__name__}: {exc}); retrying "
+                  f"{len(chunk)} windows individually", file=sys.stderr)
+            for w in chunk:
+                try:
+                    (cons, cov), = poa_batch([_pack(w)], self.match,
+                                             self.mismatch, self.gap,
+                                             n_threads=1)
+                    w.apply_trim(cons, cov, trim)
+                except Exception as wexc:
+                    w.backbone_fallback()
+                    pl.stats.bump("quarantined")
+                    print("[racon_tpu::BatchPOA] warning: window "
+                          f"quarantined (kept draft backbone; "
+                          f"{type(wexc).__name__}: {wexc})",
+                          file=sys.stderr)
+                if bar is not None:
+                    bar("[racon_tpu::Polisher.polish] generating consensus")
+
+        pl.run(chunks, pack, dispatch, wait, unpack,
+               on_error=None if strict_mode() else chunk_error)
+
+    def _device_consensus(self, todo, trim) -> list:
+        """Device consensus over `todo`; unfit/failed windows are
+        host-polished internally when possible. Returns the windows left
+        unbuilt (normally none) — the caller routes them through the
+        host chunk loop, whose per-window quarantine is the last rung of
+        the failure ladder.
 
         `self.engine` selects the device engine — the explicit
         constructor/CLI choice, falling back to RACON_TPU_ENGINE:
@@ -198,8 +253,12 @@ class BatchPOA:
                                     logger=self.logger,
                                     banded_only=self.banded_only)
             results, statuses = engine.consensus(packed)
-        for w, (cons, cov) in zip(todo, results):
-            w.apply_trim(cons, cov, trim)
+        leftover = []
+        for w, r in zip(todo, results):
+            if r is None:  # neither engine built it: host loop's turn
+                leftover.append(w)
+            else:
+                w.apply_trim(r[0], r[1], trim)
         stats = getattr(engine, "last_stats", None) or {}
         if "committed" in stats:
             print(f"[racon_tpu::BatchPOA] device layer alignments: "
@@ -211,6 +270,7 @@ class BatchPOA:
             # (cudapolisher.cpp:204-206)
             print(f"[racon_tpu::BatchPOA] {n_fallback} windows polished on "
                   "host (outside device kernel envelope)", file=sys.stderr)
+        return leftover
 
 
 def _pack(w):

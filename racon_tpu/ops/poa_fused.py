@@ -73,6 +73,8 @@ import sys
 
 import numpy as np
 
+from ..errors import DeviceError
+from ..resilience import strict_mode
 from ..utils.logger import Logger
 #: envelope shared with the session engine (ONE source of truth, incl.
 #: the construction-time RACON_TPU_MAX_NODES override; measured: ~2000
@@ -805,14 +807,18 @@ class FusedPOA:
                   f"({type(exc).__name__}: {exc}); {len(chunk)} windows "
                   "to fallback", file=sys.stderr)
             if streak["n"] >= MAX_STREAK:
-                raise RuntimeError(
+                pl.stats.bump("breaker_trips")
+                err = DeviceError(
+                    "FusedPOA",
                     f"{streak['n']} consecutive device chunk failures; "
-                    "aborting the device pass") from exc
+                    "aborting the device pass")
+                err.__cause__ = exc
+                raise err
             _tick(chunk)
 
         chunk_items = [fused_idx[s:s + self.B]
                        for s in range(0, len(fused_idx), self.B)]
-        strict = bool(os.environ.get("RACON_TPU_STRICT"))
+        strict = strict_mode()
         try:
             # the pipeline already counts and times every stage callback;
             # this run's share is the delta against the (possibly
@@ -826,9 +832,20 @@ class FusedPOA:
                         "launches"):
                 stats[key] = after[key] - base[key]
 
-            pl.drain_fallback()
+            pl.drain_fallback(ignore_errors=not strict)
             for sub, fut in prefall:
-                for i, r in zip(sub, fut.result()):
+                try:
+                    sub_res = fut.result()
+                except Exception as exc:
+                    # this fallback job died even after its bounded
+                    # retry: its windows stay None for the caller's
+                    # per-window quarantine path
+                    print("[racon_tpu::FusedPOA] warning: fallback job "
+                          f"failed ({type(exc).__name__}: {exc}); "
+                          f"{len(sub)} windows left to the caller",
+                          file=sys.stderr)
+                    continue
+                for i, r in zip(sub, sub_res):
                     results[i] = r
                     statuses[i] = 1
         finally:
@@ -839,12 +856,24 @@ class FusedPOA:
         rest = [i for i in range(n) if results[i] is None]
         self.n_fallback = len(rest) + sum(len(s) for s, _ in prefall)
         if rest and fallback:
-            host = poa_batch([windows[i] for i in rest], self.match,
-                             self.mismatch, self.gap,
-                             n_threads=self.num_threads)
-            for i, r in zip(rest, host):
-                results[i] = r
-                statuses[i] = 1
+            try:
+                host = poa_batch([windows[i] for i in rest], self.match,
+                                 self.mismatch, self.gap,
+                                 n_threads=self.num_threads)
+            except Exception as exc:
+                # the host batch itself died: leave the unbuilt windows
+                # as None for the caller's per-window quarantine path
+                # instead of losing the whole device pass's results
+                if strict:
+                    raise
+                print("[racon_tpu::FusedPOA] warning: host fallback "
+                      f"batch failed ({type(exc).__name__}: {exc}); "
+                      f"{len(rest)} windows left to the caller",
+                      file=sys.stderr)
+            else:
+                for i, r in zip(rest, host):
+                    results[i] = r
+                    statuses[i] = 1
         return results, statuses
 
     def _pack_chunk(self, windows, chunk):

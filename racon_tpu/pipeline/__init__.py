@@ -39,23 +39,45 @@ exc)`, the failed chunk is skipped and the run continues — callers route
 the chunk's items to their host fallback, the per-window GPU->CPU
 discipline of cudapolisher.cpp:354-383 at chunk granularity. `on_error`
 itself raising aborts the run with that exception.
+
+Resilience (racon_tpu/resilience/): the pipeline is the arming point for
+the deterministic fault-injection harness (RACON_TPU_FAULT_PLAN hooks at
+the pack/device/unpack stages and the fallback pool) and for the device
+watchdog — with a `Watchdog` configured, dispatch runs under its deadline
+with bounded retry + exponential backoff, the result wait under the
+deadline only (re-waiting on a hung handle would just burn a second
+deadline), and fallback jobs get the same bounded retry. Both default
+from the environment and stay None when unconfigured, so the clean path
+pays a single `is None` check per stage.
 """
 
 from __future__ import annotations
 
+import itertools
 import queue
 import threading
 import time
 from concurrent.futures import Future, ThreadPoolExecutor
 
+from ..resilience import Watchdog, get_fault_plan
+
 _STOP = object()
 
 
 class PipelineStats:
-    """Thread-safe per-stage counters, shareable across pipeline phases."""
+    """Thread-safe per-stage counters, shareable across pipeline phases.
 
-    _FLOAT_KEYS = ("pack_s", "device_s", "unpack_s", "fallback_s")
-    _INT_KEYS = ("launches", "chunks", "errors")
+    The first two key groups are the PR-1 overlap counters; the
+    resilience group (faults injected, watchdog retries/timeouts, backoff
+    seconds slept, circuit-breaker trips, quarantined windows, cancelled
+    fallback futures) is the degradation report — all zero on a clean
+    run, published together in bench.py's JSON artifact."""
+
+    _FLOAT_KEYS = ("pack_s", "device_s", "unpack_s", "fallback_s",
+                   "backoff_s")
+    _INT_KEYS = ("launches", "chunks", "errors",
+                 "faults", "retries", "timeouts", "breaker_trips",
+                 "quarantined", "cancelled")
     KEYS = _FLOAT_KEYS + _INT_KEYS
 
     def __init__(self):
@@ -88,20 +110,82 @@ class DispatchPipeline:
     """
 
     def __init__(self, depth: int = 2, fallback_workers: int = 2,
-                 stats: PipelineStats | None = None):
+                 stats: PipelineStats | None = None, watchdog=None,
+                 faults=None):
         self.depth = max(0, int(depth))
         self.fallback_workers = max(1, int(fallback_workers))
         self.stats = stats if stats is not None else PipelineStats()
+        # resilience hooks: explicit objects win (the polisher threads its
+        # CLI knobs through); otherwise the env posture applies so every
+        # pipeline in the process is injectable/guarded. Both are None —
+        # zero-overhead — when nothing is configured.
+        self.watchdog = watchdog if watchdog is not None \
+            else Watchdog.from_env()
+        self.faults = faults if faults is not None else get_fault_plan()
+        self._fb_counter = itertools.count()
         self._executor: ThreadPoolExecutor | None = None
         self._futures: list[Future] = []
 
     # ------------------------------------------------------------ stages
     def run(self, items, pack, dispatch, wait, unpack, on_error=None) -> None:
         items = list(items)
+        if self.faults is not None or self.watchdog is not None:
+            pack, dispatch, wait, unpack = self._instrument(
+                pack, dispatch, wait, unpack)
         if self.depth == 0:
             self._run_sync(items, pack, dispatch, wait, unpack, on_error)
             return
         self._run_async(items, pack, dispatch, wait, unpack, on_error)
+
+    def _instrument(self, pack, dispatch, wait, unpack):
+        """Wrap the stage callbacks with the resilience hooks: fault
+        injection fires as each stage starts its Nth item (each stage is
+        single-threaded, so a plain per-stage counter is the submission
+        order), and the watchdog applies its policy per stage — dispatch
+        under deadline + retry (faults are one-shot, so a retried
+        dispatch finds its injected fault consumed: the transient-fault
+        shape), the result wait under the deadline only, and the
+        idempotent host stages (pack/unpack: pure functions of their
+        inputs) under retry only."""
+        faults, wd, stats = self.faults, self.watchdog, self.stats
+        counters = {s: itertools.count() for s in ("pack", "device",
+                                                   "unpack")}
+
+        def fire(stage, idx):
+            if faults is not None:
+                faults.fire(stage, idx, stats=stats)
+
+        cancel = faults.cancel_hangs if faults is not None else None
+
+        def staged(stage, fn, retry=True, deadline=False):
+            idx = next(counters[stage])
+
+            def attempt():
+                fire(stage, idx)
+                return fn()
+
+            if wd is None:
+                return attempt()
+            return wd.call(attempt, stats=stats, retry=retry,
+                           deadline=deadline, on_timeout=cancel)
+
+        def pack_w(item):
+            return staged("pack", lambda: pack(item))
+
+        def dispatch_w(item, ops):
+            return staged("device", lambda: dispatch(item, ops),
+                          deadline=True)
+
+        def wait_w(handle):
+            if wd is None:
+                return wait(handle)
+            return wd.call(lambda: wait(handle), stats=stats, retry=False,
+                           on_timeout=cancel)
+
+        def unpack_w(item, res):
+            return staged("unpack", lambda: unpack(item, res))
+
+        return pack_w, dispatch_w, wait_w, unpack_w
 
     def _run_sync(self, items, pack, dispatch, wait, unpack, on_error):
         stats = self.stats
@@ -241,13 +325,26 @@ class DispatchPipeline:
     def submit_fallback(self, fn, *args, **kwargs) -> Future:
         """Schedule host-only work concurrently with the device stages
         (inline at depth 0). Returns a Future; collect with `.result()`
-        after `drain_fallback()`."""
+        after `drain_fallback()`. Fallback jobs are an injection point
+        (`fallback:chunk=<N>` counts submissions) and share the
+        watchdog's bounded retry — without its deadline: host work is
+        CPU-bound and finite, and abandoning it would leak the thread."""
         stats = self.stats
+        faults, wd = self.faults, self.watchdog
+        idx = next(self._fb_counter)
+
+        def job():
+            if faults is not None:
+                faults.fire("fallback", idx, stats=stats)
+            return fn(*args, **kwargs)
 
         def timed():
             t0 = time.perf_counter()
             try:
-                return fn(*args, **kwargs)
+                if wd is None:
+                    return job()
+                return Watchdog(timeout=0.0, retries=wd.retries,
+                                backoff=wd.backoff).call(job, stats=stats)
             finally:
                 stats.bump("fallback_s", time.perf_counter() - t0)
 
@@ -290,6 +387,31 @@ class DispatchPipeline:
                     first = exc
         if first is not None and not ignore_errors:
             raise first
+
+    def cancel_fallback(self) -> tuple[int, int]:
+        """Abandon the fallback queue: cancel every not-yet-started job
+        and block until the running ones finish (their results and
+        errors are discarded). Returns (cancelled, drained) counts.
+
+        This is the device-failure reset path: before the caller
+        restarts a whole phase on host, no orphaned fallback thread may
+        keep working (and bumping a just-restarted progress bar) and no
+        queued job may still start and burn host threads the restart
+        needs."""
+        futures, self._futures = self._futures, []
+        cancelled = sum(1 for fut in futures if fut.cancel())
+        drained = 0
+        for fut in futures:
+            if fut.cancelled():
+                continue
+            try:
+                fut.result()
+            except BaseException:
+                pass
+            drained += 1
+        if cancelled:
+            self.stats.bump("cancelled", cancelled)
+        return cancelled, drained
 
     def close(self) -> None:
         if self._executor is not None:

@@ -1,0 +1,70 @@
+"""Resilience layer: fault injection, device watchdog/retry, quarantine.
+
+The reference's only failure posture is a hard exit via `CU_CHECK_ERR`
+(cudautils.hpp:10-18). The TPU pipeline instead degrades in bounded,
+observable steps, and every failure mode is *injectable* so the whole
+ladder is exercisable in CI without real hardware faults:
+
+  1. `faults.FaultPlan` — a deterministic fault-injection harness armed
+     from `RACON_TPU_FAULT_PLAN` / `--tpu-fault-plan`
+     (`device:chunk=3:raise,device:chunk=7:hang=5,unpack:chunk=2:corrupt`);
+     hooks sit at the dispatch pipeline's pack/device/unpack stages and
+     its fallback pool (pipeline/__init__.py).
+  2. `watchdog.Watchdog` — a configurable deadline on device-stage calls
+     (`--tpu-device-timeout`; a timed-out call raises
+     errors.DeviceTimeout instead of hanging the run) plus bounded retry
+     with exponential backoff (`RACON_TPU_DEVICE_RETRIES`, default 1
+     once the watchdog is on) before a chunk routes to host fallback.
+  3. Per-window quarantine — a window whose consensus fails on both the
+     device and the host keeps its draft backbone as consensus and is
+     counted (ops/poa.py), mirroring the reference's `ratio > 0`
+     unpolished handling (polisher.cpp:515) at failure time instead of
+     output time.
+  4. Degradation report — retries / backoff seconds / timeouts / breaker
+     trips / quarantined windows / cancelled futures accumulate in the
+     shared PipelineStats, surface in `polisher.stage_stats`, and ride
+     bench.py's JSON artifact next to the PR-1 stage counters.
+
+Strictness: `RACON_TPU_STRICT` / `--tpu-strict` (`strict_mode()`) turns
+every degradation point back into a raise — the bench/CI discipline.
+Decisions key on the error taxonomy in errors.py (DeviceError /
+DeviceTimeout / ChunkCorrupt), never on exception message strings.
+
+With no fault plan and no timeout/retry configuration, every hook in the
+hot path collapses to a `None` check — the clean path stays byte- and
+cost-identical to the pre-resilience code.
+"""
+
+from __future__ import annotations
+
+import os
+
+from .faults import FaultPlan, get_fault_plan, reset_fault_plan
+from .watchdog import Watchdog
+
+__all__ = ["FaultPlan", "Watchdog", "get_fault_plan", "reset_fault_plan",
+           "strict_mode", "degradation_summary"]
+
+
+def strict_mode() -> bool:
+    """True when device failures must re-raise instead of degrading
+    (RACON_TPU_STRICT env, mirrored by the --tpu-strict CLI flag)."""
+    return bool(os.environ.get("RACON_TPU_STRICT"))
+
+
+#: stage_stats keys owned by the resilience layer (PipelineStats carries
+#: them next to the PR-1 stage counters; bench.py publishes the snapshot)
+REPORT_KEYS = ("faults", "retries", "timeouts", "backoff_s",
+               "breaker_trips", "quarantined", "cancelled")
+
+
+def degradation_summary(stats: dict) -> str | None:
+    """One-line human degradation report from a PipelineStats snapshot,
+    or None when the run degraded nowhere (the common case: silence)."""
+    parts = []
+    for key in REPORT_KEYS:
+        v = stats.get(key, 0)
+        if v:
+            parts.append(f"{key} {v:.2f}s" if key == "backoff_s"
+                         else f"{key} {v}")
+    return ", ".join(parts) if parts else None

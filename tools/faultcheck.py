@@ -1,0 +1,202 @@
+"""Fault-matrix checker: the resilience layer's pass/fail grid.
+
+Runs a small synthetic polishing job (mixed read lengths, so the device
+aligner has both device chunks and host-fallback work) through every
+fault-injection point — pack raise, device raise, device hang, unpack
+corrupt, fallback raise — in both the alignment phase (device aligner
+armed) and the consensus phase (host engine loop), at pipeline depths 0
+and 2, plus a persistent-failure case that must degrade to the
+per-window pass. Each cell passes when the injected run
+
+  - exits cleanly (no exception reaches the driver),
+  - fired its armed fault (`faults` counter >= 1),
+  - and either reproduces the clean run's bytes (the watchdog/retry/
+    fallback ladder absorbed the fault) or reports quarantined windows,
+  - within a wall-clock bound (hang cases: the watchdog deadline, not
+    the injected stall, must set the pace),
+  - leaving no orphaned racon-tpu worker thread behind.
+
+Usage: python tools/faultcheck.py [--quick]
+  --quick drops the hang cases (the slow rows; the pytest suite tags the
+  same cases with the `slow`/`faults` markers so tier-1 skips them too).
+
+Prints the grid and exits 0 only when every cell passed — the CI gate
+for the resilience acceptance criteria.
+"""
+
+from __future__ import annotations
+
+import argparse
+import gzip
+import os
+import random
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      "/tmp/racon_tpu_jax_cache")
+sys.path = [p for p in sys.path if "axon_site" not in p]
+
+ACGT = b"ACGT"
+
+#: (name, aligner_batches, fault spec, watchdog timeout, slow)
+MATRIX = [
+    ("align pack raise", 1, "pack:chunk=0:raise", 0.0, False),
+    ("align device raise", 1, "device:chunk=0:raise", 0.0, False),
+    ("align device hang", 1, "device:chunk=0:hang=5", 0.5, True),
+    ("align unpack corrupt", 1, "unpack:chunk=0:corrupt", 0.0, False),
+    ("align fallback raise", 1, "fallback:chunk=0:raise", 0.0, False),
+    ("consensus pack raise", 0, "pack:chunk=0:raise", 0.0, False),
+    ("consensus device raise", 0, "device:chunk=0:raise", 0.0, False),
+    ("consensus device hang", 0, "device:chunk=0:hang=5", 0.5, True),
+    ("consensus unpack corrupt", 0, "unpack:chunk=0:corrupt", 0.0, False),
+    ("consensus device persistent", 0,
+     "device:chunk=0:raise,device:chunk=0:raise", 0.0, False),
+]
+
+WALL_CAP = 120.0  # hard per-cell budget; a wedged run fails, not hangs CI
+
+
+def make_dataset(dirname: str, rng: random.Random):
+    truth = bytes(rng.choice(ACGT) for _ in range(2000))
+
+    def mutate(s, rate):
+        out = bytearray()
+        for c in s:
+            r = rng.random()
+            if r < rate / 3:
+                continue
+            if r < 2 * rate / 3:
+                out.append(rng.choice(ACGT))
+                out.append(c)
+                continue
+            if r < rate:
+                out.append(rng.choice(ACGT))
+                continue
+            out.append(c)
+        return bytes(out)
+
+    draft = mutate(truth, 0.04)
+    jobs = [(start, 400) for start in range(0, len(truth) - 400, 100)]
+    jobs += [(0, 1300), (600, 1300)]  # overlength: host-fallback pairs
+    reads, paf = [], []
+    for k, (start, read_len) in enumerate(jobs):
+        read = mutate(truth[start:start + read_len], 0.05)
+        reads.append((f"r{k}", read))
+        t_end = min(start + read_len, len(draft))
+        paf.append(f"r{k}\t{len(read)}\t0\t{len(read)}\t+\tdraft\t"
+                   f"{len(draft)}\t{start}\t{t_end}\t{read_len}\t"
+                   f"{read_len}\t60")
+    paths = (os.path.join(dirname, "reads.fasta.gz"),
+             os.path.join(dirname, "ovl.paf.gz"),
+             os.path.join(dirname, "draft.fasta.gz"))
+    with gzip.open(paths[0], "wb") as f:
+        for name, read in reads:
+            f.write(b">" + name.encode() + b"\n" + read + b"\n")
+    with gzip.open(paths[1], "wb") as f:
+        f.write(("\n".join(paf) + "\n").encode())
+    with gzip.open(paths[2], "wb") as f:
+        f.write(b">draft\n" + draft + b"\n")
+    return paths
+
+
+def polish(paths, depth: int, aligner: int, timeout: float):
+    from racon_tpu.core.polisher import PolisherType, create_polisher
+
+    p = create_polisher(*paths, PolisherType.kC, 500, -1.0, 0.3,
+                        num_threads=2, tpu_aligner_batches=aligner,
+                        tpu_pipeline_depth=depth,
+                        tpu_device_timeout=timeout)
+    p.initialize()
+    out = b"".join(b">" + s.name.encode() + b"\n" + s.data + b"\n"
+                   for s in p.polish())
+    return out, p.stage_stats
+
+
+def orphans(grace: float = 3.0) -> list[str]:
+    deadline = time.perf_counter() + grace
+    while time.perf_counter() < deadline:
+        alive = [t.name for t in threading.enumerate()
+                 if t.name.startswith("racon-tpu")]
+        if not alive:
+            return []
+        time.sleep(0.05)
+    return alive
+
+
+def run_cell(paths, clean, depth, aligner, spec, timeout):
+    from racon_tpu.resilience.faults import reset_fault_plan
+
+    os.environ["RACON_TPU_FAULT_PLAN"] = spec
+    os.environ["RACON_TPU_DEVICE_RETRIES"] = "1"
+    os.environ["RACON_TPU_RETRY_BACKOFF"] = "0.01"
+    reset_fault_plan()
+    t0 = time.perf_counter()
+    try:
+        out, stats = polish(paths, depth, aligner, timeout)
+    except Exception as exc:
+        return f"FAIL crashed ({type(exc).__name__}: {exc})"
+    finally:
+        wall = time.perf_counter() - t0
+        os.environ.pop("RACON_TPU_FAULT_PLAN", None)
+        reset_fault_plan()
+    if wall > WALL_CAP:
+        return f"FAIL over budget ({wall:.0f}s)"
+    if stats["faults"] < 1:
+        return "FAIL fault never fired"
+    left = orphans()
+    if left:
+        return f"FAIL orphaned threads {left}"
+    if out == clean[depth, aligner]:
+        how = "identical"
+    elif stats["quarantined"] > 0:
+        how = f"quarantined {stats['quarantined']}"
+    else:
+        return "FAIL output diverged without quarantine"
+    extras = [f"{k} {stats[k]}" for k in ("retries", "timeouts")
+              if stats[k]]
+    return f"pass  {how}" + (f" ({', '.join(extras)})" if extras else "")
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true",
+                    help="skip the slow hang-injection rows")
+    args = ap.parse_args()
+
+    os.environ["RACON_TPU_ALIGNER_MAXLEN"] = "1024"
+    os.environ.pop("RACON_TPU_STRICT", None)
+    rows = [m for m in MATRIX if not (args.quick and m[4])]
+
+    failures = 0
+    with tempfile.TemporaryDirectory(prefix="racon_faultcheck_") as tmp:
+        paths = make_dataset(tmp, random.Random(11))
+        clean = {}
+        for depth in (0, 2):
+            for aligner in (0, 1):
+                clean[depth, aligner] = polish(paths, depth, aligner,
+                                               0.0)[0]
+        width = max(len(m[0]) for m in rows)
+        print(f"{'injection point':<{width}}  depth0"
+              f"{'':<30}depth2", file=sys.stderr)
+        for name, aligner, spec, timeout, _slow in rows:
+            cells = []
+            for depth in (0, 2):
+                cell = run_cell(paths, clean, depth, aligner, spec,
+                                timeout)
+                failures += cell.startswith("FAIL")
+                cells.append(f"{cell:<36}")
+            print(f"{name:<{width}}  {''.join(cells)}", file=sys.stderr)
+    print(f"[faultcheck] {'FAIL' if failures else 'PASS'}: "
+          f"{2 * len(rows) - failures}/{2 * len(rows)} cells green",
+          file=sys.stderr)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
