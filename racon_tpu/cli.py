@@ -86,6 +86,18 @@ usage: racon_tpu [options ...] <sequences> <overlaps> <target sequences>
             call past the deadline raises a timeout and the chunk is
             retried with exponential backoff (RACON_TPU_DEVICE_RETRIES,
             default 1) before routing to the host fallback
+        --tpu-adaptive-buckets
+            derive each device engine's shape ladder from the run's own
+            job-shape histogram (occupancy-aware batch scheduler) and
+            pack shape-sorted batches, instead of the static worst-case
+            ladders; output is byte-identical either way (mirrors
+            RACON_TPU_ADAPTIVE_BUCKETS)
+        --tpu-compile-cache <dir>
+            default: none
+            persistent XLA compilation cache directory: repeated runs
+            skip recompiles, including adaptive-bucket runs whose shapes
+            are data-derived (mirrors RACON_TPU_COMPILE_CACHE /
+            JAX_COMPILATION_CACHE_DIR)
         --tpu-strict
             re-raise device failures instead of degrading to the host
             fallback / per-window quarantine (mirrors RACON_TPU_STRICT;
@@ -135,6 +147,8 @@ def parse_args(argv: list[str]) -> dict | None:
         "tpu_device_timeout": 0.0,
         "tpu_strict": False,
         "tpu_fault_plan": None,
+        "tpu_adaptive_buckets": None,
+        "tpu_compile_cache": None,
         "paths": [],
     }
 
@@ -164,7 +178,8 @@ def parse_args(argv: list[str]) -> dict | None:
                   "tpu-engine": ("tpu_engine", _engine_choice),
                   "tpu-pipeline-depth": ("tpu_pipeline_depth", int),
                   "tpu-device-timeout": ("tpu_device_timeout", float),
-                  "tpu-fault-plan": ("tpu_fault_plan", str)}
+                  "tpu-fault-plan": ("tpu_fault_plan", str),
+                  "tpu-compile-cache": ("tpu_compile_cache", str)}
 
     def flag(name: str) -> bool:
         if name in ("u", "include-unpolished"):
@@ -177,6 +192,8 @@ def parse_args(argv: list[str]) -> dict | None:
             opts["tpu_banded_alignment"] = True
         elif name == "tpu-strict":
             opts["tpu_strict"] = True
+        elif name == "tpu-adaptive-buckets":
+            opts["tpu_adaptive_buckets"] = True
         else:
             return False
         return True
@@ -303,7 +320,8 @@ def main(argv: list[str] | None = None) -> int:
             opts["tpu_poa_batches"], opts["tpu_banded_alignment"],
             opts["tpu_aligner_batches"], opts["tpu_aligner_band_width"],
             opts["tpu_engine"], opts["tpu_pipeline_depth"],
-            opts["tpu_device_timeout"])
+            opts["tpu_device_timeout"], opts["tpu_adaptive_buckets"],
+            opts["tpu_compile_cache"])
         polisher.initialize()
         polished = polisher.polish(opts["drop_unpolished_sequences"])
     except RaconError as exc:

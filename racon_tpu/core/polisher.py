@@ -48,7 +48,9 @@ def create_polisher(sequences_path: str, overlaps_path: str, target_path: str,
                     tpu_aligner_band_width: int = 0,
                     tpu_engine: str | None = None,
                     tpu_pipeline_depth: int = 2,
-                    tpu_device_timeout: float = 0.0) -> "Polisher":
+                    tpu_device_timeout: float = 0.0,
+                    tpu_adaptive_buckets: bool | None = None,
+                    tpu_compile_cache: str | None = None) -> "Polisher":
     """Factory mirroring reference createPolisher (polisher.cpp:55-160).
 
     The tpu_* knobs parallel the reference's CUDA flags (main.cpp:36-41); the
@@ -59,6 +61,14 @@ def create_polisher(sequences_path: str, overlaps_path: str, target_path: str,
     `tpu_device_timeout` (seconds, 0 = off) arms the resilience watchdog:
     device-stage calls run under that deadline with bounded retry +
     backoff before a chunk routes to host fallback.
+    `tpu_adaptive_buckets` arms the occupancy-aware batch scheduler
+    (racon_tpu/sched/): every device engine derives its shape ladder from
+    the run's job-shape histogram and packs shape-sorted chunks (output
+    stays byte-identical; None defers to RACON_TPU_ADAPTIVE_BUCKETS).
+    `tpu_compile_cache` points jax's persistent compilation cache at a
+    directory so repeated runs — including adaptive ones with
+    data-derived shapes — skip recompiles (None defers to
+    RACON_TPU_COMPILE_CACHE).
     """
     if not isinstance(type_, PolisherType):
         raise RaconError("createPolisher", "invalid polisher type!")
@@ -73,7 +83,8 @@ def create_polisher(sequences_path: str, overlaps_path: str, target_path: str,
                     quality_threshold, error_threshold, trim, match, mismatch,
                     gap, num_threads, tpu_poa_batches, tpu_banded_alignment,
                     tpu_aligner_batches, tpu_aligner_band_width, tpu_engine,
-                    tpu_pipeline_depth, tpu_device_timeout)
+                    tpu_pipeline_depth, tpu_device_timeout,
+                    tpu_adaptive_buckets, tpu_compile_cache)
 
 
 class Polisher:
@@ -85,7 +96,9 @@ class Polisher:
                  tpu_aligner_band_width: int = 0,
                  tpu_engine: str | None = None,
                  tpu_pipeline_depth: int = 2,
-                 tpu_device_timeout: float = 0.0):
+                 tpu_device_timeout: float = 0.0,
+                 tpu_adaptive_buckets: bool | None = None,
+                 tpu_compile_cache: str | None = None):
         self.sparser = sparser
         self.oparser = oparser
         self.tparser = tparser
@@ -112,6 +125,17 @@ class Polisher:
         from ..pipeline import PipelineStats
 
         self.pipeline_stats = PipelineStats()
+        # the occupancy-aware batch scheduler (racon_tpu/sched/), shared
+        # by the aligner and whichever consensus engine runs: adaptive
+        # ladders + sorted packing when armed (CLI flag winning over
+        # RACON_TPU_ADAPTIVE_BUCKETS), per-bucket occupancy telemetry
+        # always; the compile-cache knob composes so adaptive shapes
+        # survive process restarts
+        from ..sched import BatchScheduler
+
+        self.scheduler = BatchScheduler.from_env(
+            adaptive=tpu_adaptive_buckets,
+            compile_cache=tpu_compile_cache)
 
         self.sequences: list[Sequence] = []
         self.windows: list[Window] = []
@@ -146,6 +170,14 @@ class Polisher:
     def stage_stats(self) -> dict:
         """Snapshot of the per-stage pipeline counters (both phases)."""
         return self.pipeline_stats.snapshot()
+
+    @property
+    def occupancy_stats(self) -> dict:
+        """Snapshot of the scheduler's per-bucket occupancy counters
+        (jobs / batches / lanes / useful vs padded cells / occupancy %
+        per engine, plus compile count and seconds) — bench.py publishes
+        this next to `stages` in its JSON artifact."""
+        return self.scheduler.stats.snapshot()
 
     # ------------------------------------------------------------------ init
     def initialize(self) -> None:
@@ -385,7 +417,8 @@ class Polisher:
             handled: set[int] = set()
             if self.tpu_aligner_batches > 0:
                 from ..ops.align import BatchAligner
-                aligner = BatchAligner(band_width=self.tpu_aligner_band_width)
+                aligner = BatchAligner(band_width=self.tpu_aligner_band_width,
+                                       scheduler=self.scheduler)
                 pipeline = self._make_pipeline()
                 fb: list[tuple[list[int], object]] = []
                 # concurrent fallback jobs split the thread budget so the
@@ -515,7 +548,7 @@ class Polisher:
                           banded=self.tpu_banded_alignment,
                           band_width=self.tpu_aligner_band_width,
                           logger=self.logger, engine=self.tpu_engine,
-                          pipeline=pipeline)
+                          pipeline=pipeline, scheduler=self.scheduler)
         t_consensus = _time.perf_counter()
         with profile_ctx, pipeline:
             engine.generate_consensus(self.windows, self.trim)
@@ -538,6 +571,14 @@ class Polisher:
         if degraded:
             print(f"[racon_tpu::Polisher.polish] degradation report: "
                   f"{degraded}", file=sys.stderr)
+        # occupancy report: how much of the dispatched device shapes was
+        # real work (silent on host-only runs); adaptive ladders move
+        # this number, the bench JSON records it per bucket
+        occ = self.scheduler.stats.summary()
+        if occ:
+            print(f"[racon_tpu::Polisher.polish] batch occupancy "
+                  f"(adaptive={'on' if self.scheduler.adaptive else 'off'})"
+                  f": {occ}", file=sys.stderr)
 
         dst: list[Sequence] = []
         polished_data = bytearray()

@@ -255,8 +255,10 @@ class BatchAligner:
     MAX_BP_BYTES = 192 * 1024 * 1024
 
     def __init__(self, band_width: int = 0, max_length: int | None = None,
-                 runner=None):
+                 runner=None, scheduler=None):
         import os
+
+        from ..sched import BatchScheduler
 
         self.band_width = band_width
         # the cudaaligner max-length envelope (exceeded_max_length ->
@@ -267,6 +269,10 @@ class BatchAligner:
                                             65536))
         self.max_length = max_length
         self.runner = runner
+        # occupancy-aware scheduler (sched/): adaptive length ladder +
+        # sorted packing when armed, per-bucket occupancy telemetry always
+        self.sched = (scheduler if scheduler is not None
+                      else BatchScheduler.from_env())
         #: pairs whose banded distance hit the band-adequacy limit and were
         #: sent back for exact host alignment (observability, SURVEY.md §5)
         self.n_band_rejects = 0
@@ -327,20 +333,74 @@ class BatchAligner:
         runner = self.runner if self.runner is not None else BatchRunner()
         pl = pipeline if pipeline is not None else DispatchPipeline(depth=0)
         results: list[list[tuple[int, str]] | None] = [None] * len(pairs)
-        groups: dict[int, list[int]] = {}
+
+        def shape_of(idx: int) -> int:
+            return max(len(pairs[idx][0]), len(pairs[idx][1]))
+
+        # device eligibility and the AUTO band are ALWAYS decided by the
+        # static ladder, adaptive mode included. The band is algorithmic,
+        # not padding — it changes which equal-cost path the banded DP
+        # can see — so it must not move when the scheduler regroups jobs;
+        # pinning both to the static rule makes scheduler-on vs -off
+        # byte-identity structural, not a fixture property.
+        static_groups: dict[int, list[int]] = {}
         unbucketed: list[int] = []
         for idx, (qs, ts) in enumerate(pairs):
             edge = self._bucket_of(max(len(qs), len(ts)))
             if edge is None or not qs or not ts:
                 unbucketed.append(idx)  # host aligner handles these
                 continue
-            groups.setdefault(edge, []).append(idx)
+            static_groups.setdefault(edge, []).append(idx)
         if on_reject is not None and unbucketed:
             on_reject(unbucketed)
 
-        chunks: list[tuple[int, int, int, list[int]]] = []
-        for edge, idxs in sorted(groups.items()):
+        band_of: dict[int, int] = {}  # pair -> band, the static rule's
+        for edge, idxs in static_groups.items():
             band = self._band_for(pairs, idxs)
+            for i in idxs:
+                band_of[i] = band
+
+        # regroup by (compiled edge, band). Static mode: the original
+        # one-band-per-bucket grouping, unchanged. Adaptive mode: a
+        # sub-ladder INSIDE each occupied static bucket (the run's
+        # length histogram, compile budget K = len(BUCKETS) split across
+        # buckets by job count), so jobs move to a tighter edge but keep
+        # their static band — the per-lane DP (band + offsets) is
+        # bit-identical, only the compiled wavefront count shrinks, and
+        # the total (edge, band) combo count stays <= K because band is
+        # constant within a static bucket. Static edges are multiples of
+        # the ladder quantum, so a derived edge never exceeds its static
+        # bucket's. All derivation state is local: a reused aligner
+        # starts every align() from the static ladder again.
+        groups: dict[tuple[int, int], list[int]] = {}
+        if self.sched.adaptive and static_groups:
+            k_of = {edge: 1 for edge in static_groups}
+            spare = len(self.BUCKETS) - len(static_groups)
+            by_load = sorted(static_groups,
+                             key=lambda e: -len(static_groups[e]))
+            i = 0
+            while spare > 0:
+                k_of[by_load[i % len(by_load)]] += 1
+                spare -= 1
+                i += 1
+            for edge, idxs in static_groups.items():
+                sub = self.sched.aligner_ladder(
+                    [shape_of(i) for i in idxs], k=k_of[edge],
+                    max_length=self.max_length) or (edge,)
+                for i in idxs:
+                    e = next((x for x in sub if x >= shape_of(i)), edge)
+                    groups.setdefault((e, band_of[i]), []).append(i)
+        else:
+            for edge, idxs in static_groups.items():
+                for i in idxs:
+                    groups.setdefault((edge, band_of[i]), []).append(i)
+
+        chunks: list[tuple[int, int, int, list[int]]] = []
+        for (edge, band), idxs in sorted(groups.items()):
+            # sorted packing: shape-homogeneous chunks instead of arrival
+            # order (results land by original index, so output order is
+            # unaffected); identity when the scheduler is off
+            idxs = self.sched.order(idxs, key=shape_of)
             n_waves = 2 * edge + 1
             lane_bytes = n_waves * (band // 4)
             max_lanes = max(runner.n_devices,
@@ -362,13 +422,35 @@ class BatchAligner:
             return q_arr, t_arr, q_lens, t_lens, offs
 
         def dispatch(chunk, ops):
+            import time
+
             edge, band, n_waves, idx = chunk
             q_arr, t_arr, q_lens, t_lens, offs = ops
+            # compile telemetry: the first dispatch of a new shape blocks
+            # through trace + XLA build (near-zero when the persistent
+            # compile cache is warm) — charge that wall to the shape.
+            # The lane count is part of the program identity: a tail
+            # chunk narrower than its siblings compiles separately.
+            t0 = time.perf_counter()
             kernel = _kernel_for(band, n_waves)
             bp_packed, dist = runner.run(
                 kernel, q_arr, t_arr, q_lens.astype(np.int32),
                 t_lens.astype(np.int32), offs,
                 out_batch_axes=(1, 0))  # bp is [n_waves, B, band//4]
+            self.sched.stats.record_compile_once(
+                "aligner", (band, n_waves, q_arr.shape[0]),
+                time.perf_counter() - t0)
+            # occupancy telemetry, recorded at dispatch (a chunk killed
+            # by a fault or the circuit breaker must not be accounted as
+            # device work): useful DP cells = per-pair wave count x band
+            # vs the batch's full n_waves x band x lanes
+            self.sched.stats.record(
+                "aligner", (edge, band), jobs=len(idx),
+                lanes=q_arr.shape[0],
+                useful_cells=sum(
+                    (len(pairs[i][0]) + len(pairs[i][1]) + 1) * band
+                    for i in idx),
+                total_cells=q_arr.shape[0] * n_waves * band)
             pl.stats.bump("launches")
             return bp_packed, dist, q_lens, t_lens, offs
 

@@ -43,8 +43,13 @@ class BatchPOA:
                  window_length: int, num_threads: int = 1,
                  device_batches: int = 0, banded: bool = False,
                  band_width: int = 0, logger: Logger | None = None,
-                 engine: str | None = None, pipeline=None):
+                 engine: str | None = None, pipeline=None,
+                 scheduler=None):
         self.match = match
+        # the occupancy-aware batch scheduler (sched/), threaded into
+        # whichever device engine runs; None lets each engine default
+        # from the environment posture
+        self.scheduler = scheduler
         self.mismatch = mismatch
         self.gap = gap
         self.window_length = window_length
@@ -213,7 +218,8 @@ class BatchPOA:
             fused = FusedPOA(self.match, self.mismatch, self.gap,
                              num_threads=self.num_threads,
                              logger=self.logger,
-                             banded_only=self.banded_only)
+                             banded_only=self.banded_only,
+                             scheduler=self.scheduler)
             # RACON_TPU_FUSED_FALLBACK picks who polishes the windows the
             # fused engine cannot take (graph overflowed its envelope):
             # "session" (default) keeps the whole batch on device via the
@@ -235,11 +241,23 @@ class BatchPOA:
                   f"{'host' if to_host else 'session'} engine",
                   file=sys.stderr)
             if rest:
+                # leftover windows are a handful of envelope-tail cases:
+                # adapting a grid to THEM would compile throwaway
+                # programs mid-run (the stall precompile exists to
+                # prevent), so this engine pins the static grid —
+                # telemetry still flows into the shared counters
+                from ..sched import BatchScheduler
+
+                static_sched = BatchScheduler(
+                    adaptive=False,
+                    stats=(self.scheduler.stats
+                           if self.scheduler is not None else None))
                 engine = DeviceGraphPOA(self.match, self.mismatch,
                                         self.gap,
                                         num_threads=self.num_threads,
                                         logger=self.logger,
-                                        banded_only=self.banded_only)
+                                        banded_only=self.banded_only,
+                                        scheduler=static_sched)
                 sub_res, sub_st = engine.consensus(
                     [packed[i] for i in rest])
                 for i, r, st in zip(rest, sub_res, sub_st):
@@ -251,7 +269,8 @@ class BatchPOA:
             engine = DeviceGraphPOA(self.match, self.mismatch, self.gap,
                                     num_threads=self.num_threads,
                                     logger=self.logger,
-                                    banded_only=self.banded_only)
+                                    banded_only=self.banded_only,
+                                    scheduler=self.scheduler)
             results, statuses = engine.consensus(packed)
         leftover = []
         for w, r in zip(todo, results):

@@ -580,11 +580,16 @@ class FusedPOA:
                  max_nodes: int | None = None, max_len: int = MAX_LEN,
                  max_pred: int = MAX_PRED, batch_rows: int | None = None,
                  depth_buckets=DEPTH_BUCKETS, banded_only: bool = False,
-                 runner=None):
+                 runner=None, scheduler=None):
         from ..parallel.mesh import BatchRunner
+        from ..sched import BatchScheduler
 
         if max_nodes is None:
             max_nodes = env_max_nodes()
+        # occupancy-aware scheduler (sched/): adaptive depth ladder when
+        # armed, per-depth-bucket occupancy telemetry always
+        self.sched = (scheduler if scheduler is not None
+                      else BatchScheduler.from_env())
         self.match = match
         self.mismatch = mismatch
         self.gap = gap
@@ -603,6 +608,11 @@ class FusedPOA:
         else:
             self.B = self._pin_rows() * self.runner.n_devices
         self.depth_buckets = tuple(depth_buckets)
+        #: compile budget for the adaptive depth ladder — pinned to the
+        #: construction-time ladder size so adapt() is idempotent (a
+        #: precompile-then-consensus double derivation must yield the
+        #: SAME ladder, or the precompiled programs would be discarded)
+        self._depth_k = len(self.depth_buckets)
         self.last_stats = {"chunks": 0, "launches": 0, "pack_s": 0.0,
                            "device_s": 0.0, "unpack_s": 0.0}
         # -b / banded-only: trust banded DP results (skip the clipped ->
@@ -619,18 +629,31 @@ class FusedPOA:
               done: int):
         """One chained builder call for depth bucket `d`: shard_mapped
         over the mesh when one exists, plain donated jit on one device."""
+        import time
+
+        t0 = time.perf_counter()
         lbase = np.full(self.B, done, dtype=np.int32)
         if self.runner.sharding is not None:
             raw = fused_raw(self.N, self.L, d, self.P, self.match,
                             self.mismatch, self.gap,
                             banded_only=self.banded_only)
-            return self.runner.run(raw, *state, seqs, lens, wts, rlo,
-                                   rhi, band, lbase,
-                                   donate_argnums=tuple(range(11)))
-        fn = fused_builder(self.N, self.L, d, self.P, self.match,
-                           self.mismatch, self.gap,
-                           banded_only=self.banded_only)
-        return fn(*state, seqs, lens, wts, rlo, rhi, band, lbase)
+            out = self.runner.run(raw, *state, seqs, lens, wts, rlo,
+                                  rhi, band, lbase,
+                                  donate_argnums=tuple(range(11)))
+        else:
+            fn = fused_builder(self.N, self.L, d, self.P, self.match,
+                               self.mismatch, self.gap,
+                               banded_only=self.banded_only)
+            out = fn(*state, seqs, lens, wts, rlo, rhi, band, lbase)
+        # first-dispatch compile telemetry (shared record_compile_once
+        # idiom); the key is the full program identity
+        self.sched.stats.record_compile_once(
+            "fused",
+            (self.N, self.L, d, self.P, self.match, self.mismatch,
+             self.gap, self.banded_only, self.B,
+             self.runner.sharding is not None),
+            time.perf_counter() - t0)
+        return out
 
     def _eligible(self, win) -> bool:
         bb_len = len(win[0][0])
@@ -640,6 +663,36 @@ class FusedPOA:
             if not seq or len(seq) > self.L:
                 return False
         return True
+
+    def _adapt_depths(self, windows, fused_idx) -> None:
+        """Adaptive depth ladder from the ACTUAL chunk-max depths — known
+        exactly once windows are depth-sorted, since chunks are carved
+        from that list in B-strides; every padded layer costs B * L
+        device work, so tight edges are the whole occupancy story.
+        No-op when the scheduler is off."""
+        if not self.sched.adaptive or not fused_idx:
+            return
+        maxima = [len(windows[fused_idx[s]]) - 1
+                  for s in range(0, len(fused_idx), self.B)]
+        ladder = self.sched.depth_ladder(maxima, k=self._depth_k)
+        if ladder:
+            self.depth_buckets = ladder
+
+    def _fused_order(self, windows) -> list[int]:
+        """Eligible window indices, deepest first — the ONE definition of
+        which windows the device pass takes and in what order, shared by
+        consensus() and adapt() so a precompile-derived depth ladder is
+        always the ladder the run dispatches."""
+        idx = [i for i, w in enumerate(windows)
+               if len(w) >= 3 and self._eligible(w)]
+        idx.sort(key=lambda i: -len(windows[i]))
+        return idx
+
+    def adapt(self, windows) -> None:
+        """Derive the adaptive depth ladder ahead of consensus(), so
+        precompile(windows=...) warms exactly the programs the run will
+        dispatch (the ladder is a pure function of the window set)."""
+        self._adapt_depths(windows, self._fused_order(windows))
 
     def _chain_plan(self, depth: int) -> list[int]:
         """The greedy chained-call depth sequence for one chunk depth."""
@@ -653,12 +706,17 @@ class FusedPOA:
             done += d
         return plan
 
-    def precompile(self, max_depth: int | None = None) -> None:
+    def precompile(self, max_depth: int | None = None,
+                   windows=None) -> None:
         """Compile the depth-bucket programs up front. `max_depth` (the
         deepest window that will be polished) restricts compilation to the
         buckets the chaining algorithm can actually pick — the caller
         knows the windows, so the bench/polisher need not pay for unused
-        programs."""
+        programs. With the adaptive scheduler armed, pass `windows` (the
+        packed window set) so the DERIVED depth ladder is what gets
+        compiled instead of the static one the run would then discard."""
+        if windows is not None:
+            self.adapt(windows)
         if max_depth is None:
             needed = set(self.depth_buckets)
         else:
@@ -727,17 +785,16 @@ class FusedPOA:
         n = len(windows)
         results: list = [None] * n
         statuses = np.ones(n, dtype=np.int32)
-        fused_idx = []
         for i, w in enumerate(windows):
             if len(w) < 3:
                 statuses[i] = 2
                 results[i] = (w[0][0], np.zeros(len(w[0][0]), np.uint32))
-            elif self._eligible(w):
-                fused_idx.append(i)
         # windows are processed deepest-first so each batch chunk chains
-        # a similar number of calls (padding layers are not free)
-        fused_idx.sort(key=lambda i: -len(windows[i]))
+        # a similar number of calls (padding layers are not free);
+        # _fused_order is the one shared definition of the device set
+        fused_idx = self._fused_order(windows)
         fused_set = set(fused_idx)
+        self._adapt_depths(windows, fused_idx)
 
         bar = self.logger.bar if self.logger is not None else None
         if self.logger is not None and fused_idx:
@@ -769,11 +826,24 @@ class FusedPOA:
 
         def dispatch(chunk, packed):
             state, calls = packed
+            depths = [len(windows[i]) - 1 for i in chunk]
             # state stays on device across chained calls (a fetch here
             # would round-trip ~5 MB of graph arrays per call); only the
             # final state is materialized for the host finalizer
             for d, ops, done in calls:
                 state = self._call(d, state, *ops, done)
+                # occupancy in LAYER units, recorded AFTER the call
+                # returned (a faulted chunk must not be accounted as
+                # device work): every lane pays all d layer steps of
+                # every chained call, real or padded. Each window counts
+                # as a job ONCE (on its chunk's first call) so jobs
+                # totals stay comparable across engines.
+                self.sched.stats.record(
+                    "fused", d, jobs=len(chunk) if done == 0 else 0,
+                    lanes=self.B,
+                    useful_cells=sum(min(max(0, dep - done), d)
+                                     for dep in depths),
+                    total_cells=self.B * d)
             pl.stats.bump("launches", len(calls))
             return state
 

@@ -228,6 +228,18 @@ def max_pred_distance(preds: np.ndarray) -> int:
     return int(np.where(preds > 0, k1 - preds, 0).max(initial=0))
 
 
+def _mark_compiled(eng, nb: int, lb: int, ring_ok: bool,
+                   seconds: float) -> None:
+    """First-dispatch compile telemetry (the shared OccupancyStats
+    record_compile_once idiom): the key is the full program identity —
+    bucket shape, pinned batch width, ring variant, scoring, engine."""
+    eng.sched.stats.record_compile_once(
+        "session",
+        (nb, lb, eng.batch_rows.get((nb, lb)), bool(ring_ok),
+         eng.match, eng.mismatch, eng.gap, eng.max_pred, eng.use_pallas),
+        seconds)
+
+
 @functools.lru_cache(maxsize=None)
 def graph_aligner(n_nodes: int, seq_len: int, max_pred: int, match: int,
                   mismatch: int, gap: int, ring: int = 0):
@@ -438,11 +450,17 @@ class DeviceGraphPOA:
                  max_nodes: int | None = None, max_len: int = MAX_LEN,
                  max_pred: int = MAX_PRED, buckets=None,
                  batch_rows: int | None = None, cycle_jobs: int = _CYCLE_JOBS,
-                 banded_only: bool = False, use_pallas: bool | None = None):
+                 banded_only: bool = False, use_pallas: bool | None = None,
+                 scheduler=None):
         from ..parallel.mesh import BatchRunner
+        from ..sched import BatchScheduler
 
         if max_nodes is None:
             max_nodes = env_max_nodes()
+        # occupancy-aware scheduler (sched/): adaptive (nodes, len) grid
+        # + sorted packing when armed, occupancy telemetry always
+        self.sched = (scheduler if scheduler is not None
+                      else BatchScheduler.from_env())
         #: RACON_TPU_PALLAS=1 routes VMEM-sized buckets through the
         #: resident pallas window-sweep kernel (ops/poa_pallas.py) instead
         #: of the XLA scan program — experimental until profiled on chip
@@ -460,13 +478,9 @@ class DeviceGraphPOA:
         self.max_len = max_len
         self.max_pred = max_pred
         self.cycle_jobs = cycle_jobs
-        self.buckets = tuple(buckets) if buckets is not None else tuple(
-            b for b in BUCKETS if b[0] <= max_nodes and b[1] <= max_len)
-        if (not self.buckets or self.buckets[-1][0] < max_nodes
-                or self.buckets[-1][1] < max_len):
-            self.buckets = self.buckets + ((max_nodes, max_len),)
-        self.batch_rows = {
-            b: self._pin_batch(b, batch_rows) for b in self.buckets}
+        self._forced_batch_rows = batch_rows
+        self._set_buckets(tuple(buckets) if buckets is not None else tuple(
+            b for b in BUCKETS if b[0] <= max_nodes and b[1] <= max_len))
         #: RACON_TPU_ENVELOPE_STATS=1: collect observed envelope maxima
         #: (nodes, len, pred distance, in-degree, depth) across the run —
         #: the measurement that justifies RING/MAX_* on new datasets
@@ -474,6 +488,50 @@ class DeviceGraphPOA:
             {"max_nodes": 0, "max_len": 0, "max_pred_distance": 0,
              "max_in_degree": 0, "max_depth": 0}
             if os.environ.get("RACON_TPU_ENVELOPE_STATS") else None)
+
+    def _set_buckets(self, buckets) -> None:
+        """Install a bucket grid (envelope bucket appended as the safety
+        net — every in-envelope job always fits SOME bucket) and pin one
+        batch width per bucket."""
+        self.buckets = tuple(buckets)
+        if (not self.buckets or self.buckets[-1][0] < self.max_nodes
+                or self.buckets[-1][1] < self.max_len):
+            self.buckets = self.buckets + ((self.max_nodes, self.max_len),)
+        self.batch_rows = {
+            b: self._pin_batch(b, self._forced_batch_rows)
+            for b in self.buckets}
+
+    #: predicted graph growth per committed layer base: graphs start at
+    #: backbone size and gain ~GROWTH nodes per aligned layer bp from
+    #: insertions (lambda sample measurement: ~500 -> ~2000 nodes over
+    #: 37 layers of ~550 bp, PARITY.md). The prediction only shapes the
+    #: adaptive grid — a job outgrowing it first-fits a larger bucket or
+    #: the envelope, so a wrong GROWTH costs padding, never correctness.
+    GROWTH = 0.08
+
+    def adapt(self, windows) -> None:
+        """Derive the adaptive (nodes, len) grid from the window set (the
+        job-shape histogram at run start: one predicted job per layer).
+        No-op when the scheduler is off. Called by consensus() and by
+        precompile(windows=...) so the bench can warm the same shapes the
+        polish run will use."""
+        if not self.sched.adaptive:
+            return
+        shapes: list[tuple[int, int]] = []
+        for w in windows:
+            if len(w) < 3:
+                continue
+            nodes = len(w[0][0]) + 1
+            # host-engine visit order (begin-sorted, window.cpp:84-85):
+            # early layers align small graphs, late ones the grown graph
+            for seq, _, _, _ in sorted(w[1:], key=lambda s: s[2]):
+                shapes.append((min(self.max_nodes, int(nodes)), len(seq)))
+                nodes += self.GROWTH * len(seq)
+        grid = self.sched.poa_grid(shapes, k=len(BUCKETS),
+                                   max_nodes=self.max_nodes,
+                                   max_len=self.max_len)
+        if grid:
+            self._set_buckets(grid)
 
     def _pin_batch(self, bucket, forced) -> int:
         """ONE batch size per bucket: the largest power of two whose peak
@@ -488,11 +546,21 @@ class DeviceGraphPOA:
             b = pin_pow2_rows(budget, row)
         return max(n_dev, (b // n_dev) * n_dev)
 
-    def precompile(self) -> None:
+    def precompile(self, windows=None) -> None:
         """Compile every (bucket, pinned batch size) program up front so
         the scheduling loop never stalls on XLA (VERDICT r3: mid-run
-        compiles were the prime suspect in the on-chip failure)."""
+        compiles were the prime suspect in the on-chip failure).
+
+        With the adaptive scheduler armed, pass the window set so the
+        DERIVED grid is what gets compiled — the ladder is a pure
+        function of the windows, so a later engine instance adapting to
+        the same windows reuses these programs via the jit cache."""
+        import time
+
+        if windows is not None:
+            self.adapt(windows)
         for (nb, lb) in self.buckets:
+            t0 = time.perf_counter()
             B = self.batch_rows[(nb, lb)]
             fn = self._pallas_kernel(nb, lb)
             wants_nnodes = fn is not None
@@ -520,6 +588,8 @@ class DeviceGraphPOA:
                 out = self.runner.run(fn, codes, preds, centers, sinks,
                                       seq, lens, band)
             _materialize(out)  # block
+            _mark_compiled(self, nb, lb, ring_ok=True,
+                           seconds=time.perf_counter() - t0)
 
     def _bucket(self, n_nodes: int, length: int) -> tuple[int, int]:
         return next((nb, lb) for nb, lb in self.buckets
@@ -532,6 +602,9 @@ class DeviceGraphPOA:
         1 host fallback, 2 backbone-only)."""
         from ..native import PoaSession
 
+        # adaptive grid from the run's own job-shape histogram (no-op
+        # when the scheduler is off — the static grid stays)
+        self.adapt(windows)
         session = PoaSession(windows, self.match, self.mismatch, self.gap,
                              self.max_nodes, self.max_pred, self.max_len,
                              max_jobs=self.cycle_jobs,
@@ -645,6 +718,12 @@ class DeviceGraphPOA:
 
         batches = []
         for (nb, lb), idx in sorted(groups.items()):
+            # sorted packing: shape-homogeneous batches within the bucket
+            # (commits key on (win, layer), so cross-window dispatch
+            # order is free); identity when the scheduler is off
+            idx = self.sched.order(
+                idx, key=lambda i: (int(jobs["nnodes"][i]),
+                                    int(jobs["len"][i])))
             B = self.batch_rows[(nb, lb)]
             for s in range(0, len(idx), B):
                 part = idx[s:s + B]
@@ -652,6 +731,15 @@ class DeviceGraphPOA:
                 meta = (jobs["win"][sel].copy(), jobs["layer"][sel].copy(),
                         jobs["band"][sel].copy())
                 out = self._dispatch(jobs, sel, nb, lb, B)
+                # occupancy recorded AFTER the dispatch call returned
+                # (the aligner's discipline: a batch killed before the
+                # device saw it must not be accounted as device work)
+                self.sched.stats.record(
+                    "session", (nb, lb), jobs=len(part), lanes=B,
+                    useful_cells=int(
+                        (jobs["nnodes"][sel].astype(np.int64)
+                         * (jobs["len"][sel].astype(np.int64) + 1)).sum()),
+                    total_cells=B * nb * (lb + 1))
                 batches.append(meta + (len(part), lb, out))
         return batches
 
@@ -712,10 +800,16 @@ class DeviceGraphPOA:
         # ring validity: every predecessor within RING ranks of its node
         # (measured: 29 lambda / 72 synthbench, see RING; the full-carry
         # program covers the rare batch that exceeds it)
-        fn = self._scan_kernel(nb, lb,
-                               ring_ok=max_pred_distance(preds) <= RING)
-        return self.runner.run(fn, codes, preds, centers, sinks, seqs,
-                               lens, band)
+        import time
+
+        ring_ok = max_pred_distance(preds) <= RING
+        fn = self._scan_kernel(nb, lb, ring_ok=ring_ok)
+        t0 = time.perf_counter()
+        out = self.runner.run(fn, codes, preds, centers, sinks, seqs,
+                              lens, band)
+        _mark_compiled(self, nb, lb, ring_ok,
+                       seconds=time.perf_counter() - t0)
+        return out
 
     def _run_pallas(self, fn, *args):
         """Run the pallas sweep across every device: the grid is

@@ -1,0 +1,137 @@
+"""Occupancy telemetry: per-bucket padding-waste counters.
+
+The padding a shape ladder imposes was invisible until now — the bench
+measured windows/sec but not how much of each dispatched batch was real
+work. `OccupancyStats` makes padding waste a first-class, tracked metric:
+every dispatched batch records its bucket, lane count and useful-vs-total
+cells (cells = DP area for the aligner and session engine, layers for the
+fused engine — each engine's natural unit of padded compute), plus the
+per-engine compile count and the wall seconds the first dispatch of each
+new shape cost (trace + XLA compile; ~0 when the persistent compile
+cache is warm).
+
+The snapshot flows through `polisher.occupancy_stats` into bench.py's
+JSON artifact next to the pipeline stage counters, so a ladder change
+shows up as a measured occupancy delta, not an anecdote.
+
+Invariant the tests pin: per bucket, useful_cells + padded_cells ==
+lanes * capacity(bucket) — the counters sum to exactly the cells the
+device was asked to process.
+"""
+
+from __future__ import annotations
+
+import threading
+
+#: program shapes already charged to compile telemetry. Process-wide by
+#: design: jit caches are per-process, so a second engine instance (or a
+#: second polisher) dispatching an already-built shape really does pay
+#: no compile — charging it again would overreport.
+_seen_shapes: set = set()
+
+
+class OccupancyStats:
+    """Thread-safe per-(engine, bucket) occupancy counters.
+
+    Counter semantics per bucket:
+      jobs          real (non-pad) jobs dispatched
+      batches       device batches dispatched
+      lanes         total batch rows incl. round-up padding lanes
+      useful_cells  cells covered by real job shapes
+      padded_cells  cells burned on padding (bucket edge - job shape,
+                    plus whole padding lanes)
+    Per engine:
+      compiles      distinct program shapes built this process
+      compile_s     wall seconds spent in those shapes' first dispatch
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._buckets: dict[tuple[str, str], dict] = {}
+        self._compiles: dict[str, dict] = {}
+
+    def record(self, engine: str, bucket, jobs: int, lanes: int,
+               useful_cells: int, total_cells: int) -> None:
+        """Account one dispatched batch. `bucket` is any hashable shape
+        descriptor (stringified for the snapshot); `total_cells` is the
+        batch's full dispatched capacity (>= useful_cells)."""
+        key = (engine, str(bucket))
+        with self._lock:
+            b = self._buckets.get(key)
+            if b is None:
+                b = self._buckets[key] = {
+                    "jobs": 0, "batches": 0, "lanes": 0,
+                    "useful_cells": 0, "padded_cells": 0}
+            b["jobs"] += int(jobs)
+            b["batches"] += 1
+            b["lanes"] += int(lanes)
+            b["useful_cells"] += int(useful_cells)
+            b["padded_cells"] += int(total_cells) - int(useful_cells)
+
+    def record_compile(self, engine: str, seconds: float,
+                       count: int = 1) -> None:
+        with self._lock:
+            c = self._compiles.setdefault(
+                engine, {"compiles": 0, "compile_s": 0.0})
+            c["compiles"] += count
+            c["compile_s"] += float(seconds)
+
+    def record_compile_once(self, engine: str, key,
+                            seconds: float) -> bool:
+        """Charge `seconds` as compile wall iff `key` (the FULL program
+        identity, including the batch dimension — jit programs are
+        shape-keyed on it, so a tail chunk with a different lane count
+        is a separate compile) is new to this process. The shared
+        first-dispatch idiom of all three engines: time the dispatch,
+        call this, and the first occurrence of each shape is charged."""
+        k = (engine, key)
+        with self._lock:
+            if k in _seen_shapes:
+                return False
+            _seen_shapes.add(k)
+        self.record_compile(engine, seconds)
+        return True
+
+    def snapshot(self) -> dict:
+        """{engine: {"buckets": {bucket: {..., "occupancy_pct"}},
+                     "occupancy_pct", "compiles", "compile_s"}} —
+        JSON-ready; empty dict when nothing was dispatched."""
+        with self._lock:
+            buckets = {k: dict(v) for k, v in self._buckets.items()}
+            compiles = {k: dict(v) for k, v in self._compiles.items()}
+        out: dict = {}
+        for (engine, bucket), b in sorted(buckets.items()):
+            e = out.setdefault(engine, {"buckets": {}})
+            total = b["useful_cells"] + b["padded_cells"]
+            e["buckets"][bucket] = dict(
+                b, occupancy_pct=round(100.0 * b["useful_cells"] / total, 2)
+                if total else 0.0)
+        for engine, e in out.items():
+            useful = sum(b["useful_cells"] for b in e["buckets"].values())
+            total = useful + sum(b["padded_cells"]
+                                 for b in e["buckets"].values())
+            e["occupancy_pct"] = (round(100.0 * useful / total, 2)
+                                  if total else 0.0)
+        for engine, c in compiles.items():
+            e = out.setdefault(engine, {"buckets": {}})
+            e["compiles"] = c["compiles"]
+            e["compile_s"] = round(c["compile_s"], 3)
+        return out
+
+    def summary(self) -> str | None:
+        """One-line per-engine occupancy report for stderr, or None when
+        nothing was dispatched (the common host-only case: silence)."""
+        snap = self.snapshot()
+        parts = []
+        for engine, e in snap.items():
+            if not e.get("buckets"):
+                continue
+            jobs = sum(b["jobs"] for b in e["buckets"].values())
+            batches = sum(b["batches"] for b in e["buckets"].values())
+            s = (f"{engine} {e['occupancy_pct']:.1f}% "
+                 f"({jobs} jobs / {batches} batches"
+                 f" / {len(e['buckets'])} shapes")
+            if "compiles" in e:
+                s += f", {e['compiles']} compiles {e['compile_s']:.1f}s"
+            parts.append(s + ")")
+        return "; ".join(parts) if parts else None

@@ -5,8 +5,10 @@ aligner has both device chunks and host-fallback work) through every
 fault-injection point — pack raise, device raise, device hang, unpack
 corrupt, fallback raise — in both the alignment phase (device aligner
 armed) and the consensus phase (host engine loop), at pipeline depths 0
-and 2, plus a persistent-failure case that must degrade to the
-per-window pass. Each cell passes when the injected run
+and 2 AND with the occupancy-aware batch scheduler armed (depth 2 +
+adaptive buckets + sorted packing: a repacked chunk must route through
+the same fault hooks), plus a persistent-failure case that must degrade
+to the per-window pass. Each cell passes when the injected run
 
   - exits cleanly (no exception reaches the driver),
   - fired its armed fault (`faults` counter >= 1),
@@ -105,13 +107,15 @@ def make_dataset(dirname: str, rng: random.Random):
     return paths
 
 
-def polish(paths, depth: int, aligner: int, timeout: float):
+def polish(paths, depth: int, aligner: int, timeout: float,
+           adaptive: bool = False):
     from racon_tpu.core.polisher import PolisherType, create_polisher
 
     p = create_polisher(*paths, PolisherType.kC, 500, -1.0, 0.3,
                         num_threads=2, tpu_aligner_batches=aligner,
                         tpu_pipeline_depth=depth,
-                        tpu_device_timeout=timeout)
+                        tpu_device_timeout=timeout,
+                        tpu_adaptive_buckets=adaptive)
     p.initialize()
     out = b"".join(b">" + s.name.encode() + b"\n" + s.data + b"\n"
                    for s in p.polish())
@@ -129,7 +133,8 @@ def orphans(grace: float = 3.0) -> list[str]:
     return alive
 
 
-def run_cell(paths, clean, depth, aligner, spec, timeout):
+def run_cell(paths, clean, depth, aligner, spec, timeout,
+             adaptive=False):
     from racon_tpu.resilience.faults import reset_fault_plan
 
     os.environ["RACON_TPU_FAULT_PLAN"] = spec
@@ -138,7 +143,7 @@ def run_cell(paths, clean, depth, aligner, spec, timeout):
     reset_fault_plan()
     t0 = time.perf_counter()
     try:
-        out, stats = polish(paths, depth, aligner, timeout)
+        out, stats = polish(paths, depth, aligner, timeout, adaptive)
     except Exception as exc:
         return f"FAIL crashed ({type(exc).__name__}: {exc})"
     finally:
@@ -181,19 +186,29 @@ def main() -> int:
             for aligner in (0, 1):
                 clean[depth, aligner] = polish(paths, depth, aligner,
                                                0.0)[0]
+        # scheduler-on column: the clean adaptive run must be
+        # byte-identical to the static one (the scheduler contract) —
+        # checked once here, so every adaptive cell compares against the
+        # same bytes the static cells do
+        for aligner in (0, 1):
+            sched_clean = polish(paths, 2, aligner, 0.0, adaptive=True)[0]
+            if sched_clean != clean[2, aligner]:
+                print("[faultcheck] FAIL: adaptive-bucket clean run "
+                      "diverged from static", file=sys.stderr)
+                return 1
         width = max(len(m[0]) for m in rows)
         print(f"{'injection point':<{width}}  depth0"
-              f"{'':<30}depth2", file=sys.stderr)
+              f"{'':<30}depth2{'':<30}depth2+sched", file=sys.stderr)
         for name, aligner, spec, timeout, _slow in rows:
             cells = []
-            for depth in (0, 2):
+            for depth, adaptive in ((0, False), (2, False), (2, True)):
                 cell = run_cell(paths, clean, depth, aligner, spec,
-                                timeout)
+                                timeout, adaptive)
                 failures += cell.startswith("FAIL")
                 cells.append(f"{cell:<36}")
             print(f"{name:<{width}}  {''.join(cells)}", file=sys.stderr)
     print(f"[faultcheck] {'FAIL' if failures else 'PASS'}: "
-          f"{2 * len(rows) - failures}/{2 * len(rows)} cells green",
+          f"{3 * len(rows) - failures}/{3 * len(rows)} cells green",
           file=sys.stderr)
     return 1 if failures else 0
 

@@ -158,6 +158,10 @@ def main(argv=None):
     ap.add_argument("-t", "--threads", type=int, default=os.cpu_count() or 1)
     ap.add_argument("-c", "--tpupoa-batches", type=int, default=0)
     ap.add_argument("--tpualigner-batches", type=int, default=0)
+    ap.add_argument("--adaptive-buckets", action="store_true",
+                    help="arm the occupancy-aware batch scheduler "
+                         "(adaptive shape ladders + sorted packing); "
+                         "the occupancy report below A/Bs the win")
     ap.add_argument("--seed", type=int, default=42)
     ap.add_argument("--fast-sim", action="store_true",
                     help="vectorized simulator for multi-Mb genomes "
@@ -203,12 +207,28 @@ def main(argv=None):
             args.window_length, 10.0, 0.3, True, 5, -4, -8,
             num_threads=args.threads,
             tpu_poa_batches=args.tpupoa_batches,
-            tpu_aligner_batches=args.tpualigner_batches)
+            tpu_aligner_batches=args.tpualigner_batches,
+            tpu_adaptive_buckets=args.adaptive_buckets or None)
         polisher.initialize()
         t1 = time.perf_counter()
         n_windows = len(polisher.windows)
         polished = polisher.polish()
         t2 = time.perf_counter()
+        # occupancy report: the per-bucket padding-waste metric the
+        # adaptive scheduler moves (see README "Batch scheduling &
+        # occupancy"); printed per bucket so a ladder change is
+        # attributable, not just a single blended number
+        for engine, e in polisher.occupancy_stats.items():
+            if not e.get("buckets"):
+                continue
+            print(f"[synthbench] {engine} occupancy "
+                  f"{e['occupancy_pct']:.1f}% (adaptive="
+                  f"{'on' if polisher.scheduler.adaptive else 'off'})",
+                  file=sys.stderr)
+            for bucket, b in e["buckets"].items():
+                print(f"[synthbench]   bucket {bucket}: {b['jobs']} jobs "
+                      f"/ {b['batches']} batches, occupancy "
+                      f"{b['occupancy_pct']:.1f}%", file=sys.stderr)
 
     if args.golden_out:
         with open(args.golden_out, "wb") as fh:
