@@ -212,7 +212,11 @@ def phase_consensus(mode: str) -> int:
            "cache_warm": cache_warm,
            "adaptive_buckets": polisher.scheduler.adaptive,
            "stages": _stage_fields(polisher),
-           "occupancy": polisher.occupancy_stats}
+           "occupancy": polisher.occupancy_stats,
+           # the unified observability snapshot (racon_tpu/obs): the
+           # stage/occupancy fields above, re-published under one
+           # namespaced schema (pipeline.* / sched.* / resilience.*)
+           "metrics": polisher.metrics.snapshot()}
     if device:
         rec["platform"] = _jax_platform()
     print(json.dumps(rec))
@@ -253,6 +257,9 @@ def phase_aligner() -> int:
           f"({polisher.n_aligner_device}/{polisher.n_aligner_pairs} pairs "
           f"on device, {polisher.n_aligner_host_fallback} host fallbacks)",
           file=sys.stderr)
+    # initialize-only flow: polish() never runs, so emit any armed
+    # trace/metrics artifacts explicitly
+    polisher.emit_observability()
     print(json.dumps({"mode": "aligner", "seconds": round(t1 - t0, 2),
                       "platform": _jax_platform(),
                       "pairs": polisher.n_aligner_pairs,
@@ -260,7 +267,8 @@ def phase_aligner() -> int:
                       "host_fallbacks": polisher.n_aligner_host_fallback,
                       "adaptive_buckets": polisher.scheduler.adaptive,
                       "stages": _stage_fields(polisher),
-                      "occupancy": polisher.occupancy_stats}))
+                      "occupancy": polisher.occupancy_stats,
+                      "metrics": polisher.metrics.snapshot()}))
     return 0
 
 
@@ -471,7 +479,7 @@ def main() -> int:
     # much of each dispatched device shape was real work, plus warm-vs-
     # cold compile-cache evidence for the initialize-time comparison
     for key in ("occupancy", "init_s", "precompile_s", "cache_warm",
-                "adaptive_buckets"):
+                "adaptive_buckets", "metrics"):
         if key in res:
             stage_fields[key] = res[key]
     label = {"fused": "device_fused", "device": "device",

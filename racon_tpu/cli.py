@@ -109,6 +109,27 @@ usage: racon_tpu [options ...] <sequences> <overlaps> <target sequences>
             <stage>:chunk=<N>:<action> entries with stage one of
             pack|device|unpack|fallback and action raise | corrupt |
             hang=<seconds>, e.g. 'device:chunk=3:raise,unpack:chunk=2:corrupt'
+        --tpu-trace <file>
+            default: none
+            record a span trace of the run (pipeline stages per chunk,
+            engine dispatch loops, XLA compiles, fault/quarantine
+            events) as Chrome trace-event JSON loadable in Perfetto /
+            chrome://tracing (mirrors RACON_TPU_TRACE)
+        --tpu-metrics <file>
+            default: none
+            dump the end-of-run metrics snapshot (pipeline.* / sched.* /
+            resilience.* namespaces) as JSON, and render it as a stderr
+            summary table (mirrors RACON_TPU_METRICS)
+        --tpu-log-level <quiet|info|debug>
+            default: info
+            stderr verbosity: quiet silences progress/timing lines, info
+            is the classic output, debug additionally shows every
+            deduplicated per-chunk warning (mirrors RACON_TPU_LOG_LEVEL)
+        --tpu-jax-profile <dir>
+            default: none
+            bracket the device phases with a jax.profiler capture into
+            <dir> (deep-dive XLA/TPU view; no-op when the backend cannot
+            profile; mirrors RACON_TPU_PROFILE)
         --tpualigner-batches <int>
             default: 0
             number of device batches for TPU accelerated alignment
@@ -149,12 +170,26 @@ def parse_args(argv: list[str]) -> dict | None:
         "tpu_fault_plan": None,
         "tpu_adaptive_buckets": None,
         "tpu_compile_cache": None,
+        "tpu_trace": None,
+        "tpu_metrics": None,
+        "tpu_log_level": None,
+        "tpu_jax_profile": None,
         "paths": [],
     }
 
     def _engine_choice(v: str) -> str:
         if v not in ("session", "fused"):
             print("racon_tpu: --tpu-engine must be 'session' or 'fused'",
+                  file=sys.stderr)
+            sys.exit(1)
+        return v
+
+    def _level_choice(v: str) -> str:
+        from .utils.logger import LEVEL_NAMES
+
+        if v not in LEVEL_NAMES:
+            names = ", ".join(f"'{n}'" for n in LEVEL_NAMES)
+            print(f"racon_tpu: --tpu-log-level must be one of {names}",
                   file=sys.stderr)
             sys.exit(1)
         return v
@@ -179,7 +214,11 @@ def parse_args(argv: list[str]) -> dict | None:
                   "tpu-pipeline-depth": ("tpu_pipeline_depth", int),
                   "tpu-device-timeout": ("tpu_device_timeout", float),
                   "tpu-fault-plan": ("tpu_fault_plan", str),
-                  "tpu-compile-cache": ("tpu_compile_cache", str)}
+                  "tpu-compile-cache": ("tpu_compile_cache", str),
+                  "tpu-trace": ("tpu_trace", str),
+                  "tpu-metrics": ("tpu_metrics", str),
+                  "tpu-log-level": ("tpu_log_level", _level_choice),
+                  "tpu-jax-profile": ("tpu_jax_profile", str)}
 
     def flag(name: str) -> bool:
         if name in ("u", "include-unpolished"):
@@ -295,25 +334,44 @@ def main(argv: list[str] | None = None) -> int:
         return 1
 
     from .core.polisher import create_polisher, PolisherType
+    from .obs import trace
+    from .utils.logger import set_log_level
 
+    import os
+
+    saved_env: dict[str, str | None] = {}
     try:
         # posture flags mirror their env knobs (env-only knobs are
         # invisible in --help): set the env so every layer — pipelines
         # constructed anywhere, strict checks in the ops — sees them
         if opts["tpu_strict"]:
-            import os
-
             os.environ["RACON_TPU_STRICT"] = "1"
         if opts["tpu_fault_plan"]:
-            import os
-
             from .resilience import FaultPlan
 
             FaultPlan.parse(opts["tpu_fault_plan"])  # fail fast on typos
             os.environ["RACON_TPU_FAULT_PLAN"] = opts["tpu_fault_plan"]
+        # observability knobs follow the same pattern, but restore on
+        # exit (saved_env) — unlike the posture flags, a stale armed
+        # tracer would make a later flagless in-process main() call
+        # record (and overwrite) the earlier run's trace
+        for key, env in (("tpu_trace", "RACON_TPU_TRACE"),
+                         ("tpu_metrics", "RACON_TPU_METRICS"),
+                         ("tpu_log_level", "RACON_TPU_LOG_LEVEL"),
+                         ("tpu_jax_profile", "RACON_TPU_PROFILE")):
+            if opts[key]:
+                saved_env[env] = os.environ.get(env)
+                os.environ[env] = opts[key]
+        # the level and tracer resolve once per process: force a fresh
+        # resolution from the environment just set, so this invocation's
+        # flags win over any earlier resolution and every main() call
+        # records into its own recorder
+        set_log_level(opts["tpu_log_level"] or None)
+        trace.reset()
         polisher = create_polisher(
             opts["paths"][0], opts["paths"][1], opts["paths"][2],
-            PolisherType.kF if opts["fragment_correction"] else PolisherType.kC,
+            PolisherType.kF if opts["fragment_correction"]
+            else PolisherType.kC,
             opts["window_length"], opts["quality_threshold"],
             opts["error_threshold"], opts["trim"], opts["match"],
             opts["mismatch"], opts["gap"], opts["num_threads"],
@@ -324,15 +382,24 @@ def main(argv: list[str] | None = None) -> int:
             opts["tpu_compile_cache"])
         polisher.initialize()
         polished = polisher.polish(opts["drop_unpolished_sequences"])
+
+        out = sys.stdout.buffer
+        for seq in polished:
+            out.write(b">" + seq.name.encode() + b"\n" + seq.data + b"\n")
+        out.flush()
+        return 0
     except RaconError as exc:
         print(str(exc), file=sys.stderr)
         return 1
-
-    out = sys.stdout.buffer
-    for seq in polished:
-        out.write(b">" + seq.name.encode() + b"\n" + seq.data + b"\n")
-    out.flush()
-    return 0
+    finally:
+        if saved_env:
+            for env, old in saved_env.items():
+                if old is None:
+                    os.environ.pop(env, None)
+                else:
+                    os.environ[env] = old
+            set_log_level(None)
+            trace.reset()
 
 
 if __name__ == "__main__":

@@ -18,14 +18,17 @@ from __future__ import annotations
 
 import enum
 import os
-import sys
+import time
 
 import numpy as np
 
 from ..errors import DeviceError, RaconError, as_device_error
-from ..resilience import degradation_summary, strict_mode
+from ..obs import jax_profile, trace
+from ..obs.metrics import MetricsRegistry
+from ..resilience import REPORT_KEYS, degradation_summary, strict_mode
 from ..io.parsers import create_sequence_parser, create_overlap_parser
-from ..utils.logger import Logger
+from ..utils.logger import (Logger, flush_dedup, log_info, log_level,
+                            reset_dedup, DEBUG)
 from ..utils.cigar import cigar_from_ops
 from .sequence import Sequence, create_sequence
 from .window import Window, WindowType, create_window
@@ -147,6 +150,30 @@ class Polisher:
         self.n_aligner_pairs = 0
         self.n_aligner_device = 0
         self.n_aligner_host_fallback = 0
+        # the unified metrics registry (obs/metrics.py): the pipeline
+        # stage counters, the resilience degradation counters, the
+        # scheduler's occupancy telemetry and the aligner accounting, one
+        # namespaced snapshot — bench JSON "metrics" field, the
+        # --tpu-metrics dump, and the end-of-run stderr table
+        # resolve the env-armed tracer NOW so its time base predates
+        # every phase span (a lazy first-hook resolution mid-initialize
+        # would start the clock after t_init and clamp the ts to 0)
+        trace.get_tracer()
+        self.metrics = MetricsRegistry()
+        self.metrics.register(
+            "pipeline", lambda: {k: v
+                                 for k, v in self.stage_stats.items()
+                                 if k not in REPORT_KEYS})
+        self.metrics.register(
+            "resilience", lambda: {k: self.stage_stats.get(k, 0)
+                                   for k in REPORT_KEYS})
+        self.metrics.register("sched", self.scheduler.stats.snapshot)
+        self.metrics.register(
+            "aligner", lambda: {
+                "pairs": self.n_aligner_pairs,
+                "device_pairs": self.n_aligner_device,
+                "host_fallbacks": self.n_aligner_host_fallback,
+                "band_width": self.tpu_aligner_band_width})
 
     def _make_pipeline(self):
         """One DispatchPipeline per hot phase, all feeding the shared
@@ -182,10 +209,15 @@ class Polisher:
     # ------------------------------------------------------------------ init
     def initialize(self) -> None:
         if self.windows:
-            print("[racon_tpu::Polisher.initialize] warning: "
-                  "object already initialized!", file=sys.stderr)
+            log_info("[racon_tpu::Polisher.initialize] warning: "
+                     "object already initialized!")
             return
 
+        # a new run starts with clean dedup state: a previous in-process
+        # run that crashed before its flush must not leave keys behind
+        # that would silently swallow this run's first warnings
+        reset_dedup()
+        t_init = time.perf_counter()
         log = self.logger
         log.log()
 
@@ -269,7 +301,8 @@ class Polisher:
         for i, seq in enumerate(self.sequences):
             seq.transmute(has_name[i], has_data[i], has_reverse_data[i])
 
-        self.find_overlap_breaking_points(overlaps)
+        with trace.span("polisher.align_overlaps"):
+            self.find_overlap_breaking_points(overlaps)
 
         log.log()
 
@@ -325,6 +358,15 @@ class Polisher:
             o.breaking_points = None
 
         log.log("[racon_tpu::Polisher.initialize] transformed data into windows")
+        tr = trace.get_tracer()
+        if tr is not None:
+            tr.complete("polisher.initialize", t_init, time.perf_counter(),
+                        {"windows": len(self.windows),
+                         "targets": self._num_targets})
+        # per-phase flush: initialize-only flows (bench's aligner phase)
+        # must still report suppressed duplicate-warning counts; a repeat
+        # spanning both phases then reports once per phase
+        flush_dedup()
 
     def _load_overlaps(self, name_to_id, id_to_id, has_data, has_reverse_data):
         overlaps: list = []
@@ -452,18 +494,21 @@ class Polisher:
                     # threads would keep aligning (and bumping the
                     # just-restarted progress bar) underneath it
                     cancelled, drained = pipeline.cancel_fallback()
-                    print("[racon_tpu::Polisher.initialize] warning: device "
-                          f"alignment failed ({exc}); falling back to host "
-                          f"aligner ({cancelled} fallback jobs cancelled, "
-                          f"{drained} drained)", file=sys.stderr)
+                    log_info("[racon_tpu::Polisher.initialize] warning: "
+                             f"device alignment failed ({exc}); falling "
+                             f"back to host aligner ({cancelled} fallback "
+                             f"jobs cancelled, {drained} drained)")
                     self.logger.bar_total(len(pairs))  # restart progress
                     return [None] * len(pairs), set()
 
                 try:
-                    runs = aligner.align(pairs, progress=bar_n,
-                                         pipeline=pipeline,
-                                         on_reject=on_reject)
-                    pipeline.drain_fallback()
+                    # optional deep-dive: --tpu-jax-profile brackets the
+                    # device alignment pass with a jax.profiler capture
+                    with jax_profile("align"):
+                        runs = aligner.align(pairs, progress=bar_n,
+                                             pipeline=pipeline,
+                                             on_reject=on_reject)
+                        pipeline.drain_fallback()
                     for sub, fut in fb:
                         for i, c in zip(sub, fut.result()):
                             need[i].cigar = c
@@ -501,10 +546,9 @@ class Polisher:
             self.n_aligner_host_fallback = len(rest) + len(handled)
             self.n_aligner_device = len(pairs) - self.n_aligner_host_fallback
             if self.tpu_aligner_batches > 0 and self.n_aligner_host_fallback:
-                print(f"[racon_tpu::Polisher.initialize] "
-                      f"{self.n_aligner_host_fallback} overlaps "
-                      "aligned on host (device capacity fallback)",
-                      file=sys.stderr)
+                log_info(f"[racon_tpu::Polisher.initialize] "
+                         f"{self.n_aligner_host_fallback} overlaps "
+                         "aligned on host (device capacity fallback)")
 
         for o in overlaps:
             if o.is_valid and o.cigar:
@@ -516,26 +560,21 @@ class Polisher:
     def polish(self, drop_unpolished_sequences: bool = True) -> list[Sequence]:
         """Per-window consensus + stitch (reference polisher.cpp:486-548).
 
-        Set RACON_TPU_PROFILE=<dir> to capture a jax.profiler trace of the
-        consensus phase (the TPU analogue of the reference's nvprof
-        `-lineinfo` support, CMakeLists.txt:26); per-phase windows/sec is
+        Set RACON_TPU_PROFILE=<dir> (CLI: --tpu-jax-profile) to capture a
+        jax.profiler trace of the device phases (the TPU analogue of the
+        reference's nvprof `-lineinfo` support, CMakeLists.txt:26) — a
+        no-op when the backend cannot profile; per-phase windows/sec is
         reported on stderr either way.
         """
         import contextlib
-        import os
         import time as _time
 
         from ..ops.poa import BatchPOA
 
         self.logger.log()
 
-        profile_dir = os.environ.get("RACON_TPU_PROFILE")
-        if profile_dir and self.tpu_poa_batches > 0:
-            import jax
-
-            profile_ctx = jax.profiler.trace(profile_dir)
-        else:
-            profile_ctx = contextlib.nullcontext()
+        profile_ctx = (jax_profile("consensus") if self.tpu_poa_batches > 0
+                       else contextlib.nullcontext())
 
         pipeline = self._make_pipeline()
         # stage counters accumulate across phases (bench artifact wants
@@ -553,36 +592,44 @@ class Polisher:
         with profile_ctx, pipeline:
             engine.generate_consensus(self.windows, self.trim)
         dt = _time.perf_counter() - t_consensus
+        tr = trace.get_tracer()
+        if tr is not None:
+            tr.complete("polisher.consensus", t_consensus,
+                        _time.perf_counter(),
+                        {"windows": len(self.windows),
+                         "engine": engine.engine
+                         if self.tpu_poa_batches > 0 else "host"})
         if dt > 0 and self.windows:
-            print(f"[racon_tpu::Polisher.polish] consensus throughput: "
-                  f"{len(self.windows) / dt:.1f} windows/s", file=sys.stderr)
+            log_info(f"[racon_tpu::Polisher.polish] consensus throughput: "
+                     f"{len(self.windows) / dt:.1f} windows/s")
         ss = {k: v - stats_base[k] for k, v in self.stage_stats.items()}
         # overlap evidence: with the pipeline live, pack+device+unpack
         # stage seconds exceed the phase wall time; additive means dead
-        print(f"[racon_tpu::Polisher.polish] pipeline stages (depth "
-              f"{self.tpu_pipeline_depth}): pack {ss['pack_s']:.2f}s "
-              f"device {ss['device_s']:.2f}s unpack {ss['unpack_s']:.2f}s "
-              f"fallback {ss['fallback_s']:.2f}s, {ss['chunks']} chunks / "
-              f"{ss['launches']} launches", file=sys.stderr)
+        log_info(f"[racon_tpu::Polisher.polish] pipeline stages (depth "
+                 f"{self.tpu_pipeline_depth}): pack {ss['pack_s']:.2f}s "
+                 f"device {ss['device_s']:.2f}s unpack {ss['unpack_s']:.2f}s "
+                 f"fallback {ss['fallback_s']:.2f}s, {ss['chunks']} chunks / "
+                 f"{ss['launches']} launches")
         # degradation report: what the resilience layer absorbed across
         # the whole run (silent on a clean run); the same counters ride
         # stage_stats into bench.py's JSON artifact
         degraded = degradation_summary(self.stage_stats)
         if degraded:
-            print(f"[racon_tpu::Polisher.polish] degradation report: "
-                  f"{degraded}", file=sys.stderr)
+            log_info(f"[racon_tpu::Polisher.polish] degradation report: "
+                     f"{degraded}")
         # occupancy report: how much of the dispatched device shapes was
         # real work (silent on host-only runs); adaptive ladders move
         # this number, the bench JSON records it per bucket
         occ = self.scheduler.stats.summary()
         if occ:
-            print(f"[racon_tpu::Polisher.polish] batch occupancy "
-                  f"(adaptive={'on' if self.scheduler.adaptive else 'off'})"
-                  f": {occ}", file=sys.stderr)
+            log_info(f"[racon_tpu::Polisher.polish] batch occupancy "
+                     f"(adaptive={'on' if self.scheduler.adaptive else 'off'})"
+                     f": {occ}")
 
         dst: list[Sequence] = []
         polished_data = bytearray()
         num_polished_windows = 0
+        t_stitch = _time.perf_counter()
 
         for i, window in enumerate(self.windows):
             num_polished_windows += 1 if window.polished else 0
@@ -603,9 +650,48 @@ class Polisher:
                 num_polished_windows = 0
                 polished_data = bytearray()
 
+        if tr is not None:
+            tr.complete("polisher.stitch", t_stitch, _time.perf_counter(),
+                        {"sequences": len(dst)})
         self.logger.log("[racon_tpu::Polisher.polish] generated consensus")
         # cumulative wall-clock, mirroring ~Polisher (polisher.cpp:189)
         self.logger.total("[racon_tpu::Polisher.] total =")
         self.windows = []
         self.sequences = []
+        self.emit_observability()
         return dst
+
+    def emit_observability(self) -> None:
+        """End-of-run observability emission — every part a no-op when
+        its knob is off, so the default run's stderr stays byte-identical:
+        report suppressed duplicate warnings, dump the metrics snapshot
+        (RACON_TPU_METRICS / --tpu-metrics), render the stderr metrics
+        table (when metrics are dumped or at debug level), and write the
+        Chrome trace (RACON_TPU_TRACE / --tpu-trace). polish() calls
+        this; initialize-only flows (bench's aligner phase) call it
+        themselves so an armed trace/metrics artifact is never silently
+        dropped."""
+        flush_dedup()
+        metrics_path = os.environ.get("RACON_TPU_METRICS")
+        if metrics_path:
+            # observability must never take a finished run down: an
+            # unwritable path loses the artifact, not the polished FASTA
+            try:
+                self.metrics.dump(metrics_path)
+                log_info(f"[racon_tpu::obs] metrics written to "
+                         f"{metrics_path}")
+            except OSError as exc:
+                log_info(f"[racon_tpu::obs] warning: could not write "
+                         f"metrics to {metrics_path} ({exc})")
+        if metrics_path or log_level() >= DEBUG:
+            log_info("[racon_tpu::obs] end-of-run metrics:\n"
+                     + self.metrics.table())
+        try:
+            saved = trace.save()
+        except OSError as exc:
+            saved = None
+            log_info(f"[racon_tpu::obs] warning: could not write trace "
+                     f"({exc})")
+        if saved:
+            log_info(f"[racon_tpu::obs] trace written to {saved} "
+                     "(open in https://ui.perfetto.dev)")

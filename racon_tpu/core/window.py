@@ -21,9 +21,9 @@ TPU-native analogue of GenomeWorks cudapoa batches (src/cuda/cudabatch.cpp).
 from __future__ import annotations
 
 import enum
-import sys
 
 from ..errors import RaconError
+from ..utils.logger import warn_dedup
 
 
 class WindowType(enum.Enum):
@@ -89,9 +89,13 @@ class Window:
         while end >= 0 and coverages[end] < average_coverage:
             end -= 1
         if begin >= end:
-            print(f"[racon_tpu::Window.generate_consensus] warning: "
-                  f"contig {self.id} might be chimeric in window {self.rank}!",
-                  file=sys.stderr)
+            # one line per run, not one per suspect window (a noisy draft
+            # can trip this on hundreds of windows); debug shows each
+            warn_dedup(
+                "Window.chimeric",
+                f"[racon_tpu::Window.generate_consensus] warning: "
+                f"contig {self.id} might be chimeric in window "
+                f"{self.rank}!")
         else:
             self.consensus = consensus[begin:end + 1]
 

@@ -1,0 +1,81 @@
+"""Unified observability layer: tracing, metrics, leveled logging.
+
+Three pillars, all off (or invisible) by default so the clean run's
+output and stderr stay byte-identical:
+
+  1. SPAN TRACING (`obs.trace`): a thread-safe `TraceRecorder` armed by
+     RACON_TPU_TRACE=<out.json> / `--tpu-trace`, emitting Chrome
+     trace-event JSON for Perfetto — per-chunk pipeline stage spans,
+     engine dispatch loops, XLA compiles, watchdog backoff, and instant
+     events mirroring every resilience counter bump.
+  2. METRICS REGISTRY (`obs.metrics.MetricsRegistry`): the pipeline /
+     sched / resilience telemetry islands consolidated into one
+     namespaced snapshot — bench JSON `"metrics"` field, `--tpu-metrics
+     out.json` dump, end-of-run stderr table.
+  3. LEVELED LOGGING (`utils/logger.py`, re-exported here):
+     RACON_TPU_LOG_LEVEL=quiet|info|debug structured stderr logging
+     with once-per-run deduplication of repeated per-chunk warnings.
+
+`jax_profile(phase)` is the optional deep-dive hook: a context manager
+bracketing a device phase with `jax.profiler` when RACON_TPU_PROFILE /
+`--tpu-jax-profile <dir>` names a directory, and a silent no-op when the
+profiler is unavailable on the backend."""
+
+from __future__ import annotations
+
+import os
+
+from . import trace
+from .metrics import MetricsRegistry
+from ..utils.logger import (log_debug, log_info, log_level, warn_dedup,
+                            flush_dedup)
+
+__all__ = ["trace", "MetricsRegistry", "jax_profile",
+           "log_debug", "log_info", "log_level", "warn_dedup",
+           "flush_dedup"]
+
+
+class _SafeJaxProfile:
+    """`jax.profiler.trace` bracket that degrades to a no-op — entering
+    must never take a run down just because the backend (CPU tests, a
+    shimmed tunnel) cannot profile."""
+
+    def __init__(self, directory: str):
+        self._dir = directory
+        self._cm = None
+
+    def __enter__(self) -> "_SafeJaxProfile":
+        try:
+            import jax
+
+            cm = jax.profiler.trace(self._dir)
+            cm.__enter__()
+            self._cm = cm
+        except Exception as exc:
+            log_debug(f"[racon_tpu::obs] jax profiler unavailable "
+                      f"({type(exc).__name__}: {exc}); phase runs "
+                      "unprofiled")
+            self._cm = None
+        return self
+
+    def __exit__(self, *exc_info) -> bool:
+        if self._cm is not None:
+            try:
+                self._cm.__exit__(*exc_info)
+            except Exception as exc:
+                log_debug(f"[racon_tpu::obs] jax profiler stop failed "
+                          f"({type(exc).__name__}: {exc})")
+        return False
+
+
+def jax_profile(phase: str = ""):
+    """Context manager bracketing one device phase with a jax.profiler
+    trace under RACON_TPU_PROFILE/<phase> (each phase gets its own
+    capture directory so align and consensus don't clobber each other).
+    A no-op context when the knob is unset."""
+    import contextlib
+
+    base = os.environ.get("RACON_TPU_PROFILE")
+    if not base:
+        return contextlib.nullcontext()
+    return _SafeJaxProfile(os.path.join(base, phase) if phase else base)

@@ -1,0 +1,218 @@
+"""Span tracing: Chrome trace-event recording for Perfetto.
+
+The reference exposes only coarse phase timings (src/logger.cpp); a slow
+or degraded run gives no way to see WHERE the time went. `TraceRecorder`
+records per-event spans — pipeline pack/device/unpack/fallback stages
+per chunk, engine dispatch loops, XLA compiles, watchdog backoff — plus
+instant events for every resilience counter bump (faults, retries,
+timeouts, breaker trips, quarantined windows, cancelled futures), and
+writes them as Chrome trace-event JSON loadable in Perfetto
+(https://ui.perfetto.dev) or chrome://tracing.
+
+Design constraints, in order:
+
+  1. OFF BY DEFAULT, zero overhead when off. The process-wide tracer is
+     armed only by RACON_TPU_TRACE=<out.json> (mirrored by the CLI's
+     `--tpu-trace`) or an explicit `configure()`; every hot-path hook is
+     an `is None` check against the resolved-once singleton.
+  2. Low overhead when ON: events append to per-thread buffers (no lock
+     on the hot path — each pipeline worker owns its list; the shared
+     lock is taken once per thread, at buffer registration), timestamps
+     come from the monotonic `time.perf_counter` clock the pipeline's
+     stage counters already use, and serialization happens once, at
+     `save()`. Instrumentation sites reuse the exact perf_counter
+     endpoints they feed into PipelineStats, so per-stage span-duration
+     sums equal the stage wall-clock counters by construction
+     (pinned by tests/test_obs.py).
+  3. Thread-safe: concurrent pipeline threads (pack worker, dispatcher,
+     unpack worker, fallback pool, watchdog workers) record freely;
+     `events()` snapshots every buffer and sorts by timestamp.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+
+class _Span:
+    """Context manager recording one complete ("X") event on exit."""
+
+    __slots__ = ("_rec", "_name", "_args", "_t0")
+
+    def __init__(self, rec: "TraceRecorder", name: str, args: dict | None):
+        self._rec = rec
+        self._name = name
+        self._args = args
+
+    def __enter__(self) -> "_Span":
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc_info) -> None:
+        self._rec.complete(self._name, self._t0, time.perf_counter(),
+                           self._args)
+
+
+class _NullSpan:
+    """Shared no-op context for the disabled-tracer path."""
+
+    __slots__ = ()
+
+    def __enter__(self) -> "_NullSpan":
+        return self
+
+    def __exit__(self, *exc_info) -> None:
+        pass
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class TraceRecorder:
+    """Append-only per-thread event buffers with one shared time base."""
+
+    def __init__(self, path: str | None = None):
+        self.path = path
+        self._pid = os.getpid()
+        self._base = time.perf_counter()
+        self._lock = threading.Lock()
+        self._buffers: list[list] = []
+        self._threads: dict[int, str] = {}
+        self._next_tid = 1
+        self._local = threading.local()
+
+    # ------------------------------------------------------------ recording
+    def _buf(self) -> list:
+        buf = getattr(self._local, "buf", None)
+        if buf is None:
+            # synthetic per-registration tid, NOT threading.get_ident():
+            # the OS reuses idents, so the consensus phase's workers
+            # would land on (and relabel) the dead align-phase workers'
+            # tracks — every registered thread gets its own track
+            t = threading.current_thread()
+            buf = self._local.buf = []
+            with self._lock:
+                tid = self._next_tid
+                self._next_tid += 1
+                self._buffers.append(buf)
+                self._threads[tid] = t.name
+            self._local.tid = tid
+        return buf
+
+    def _us(self, t: float) -> float:
+        # clamp: a caller-supplied endpoint can predate this recorder
+        # (env-armed tracer created lazily mid-phase); negative ts would
+        # fail the faultcheck gate and misrender in Perfetto
+        return round(max(0.0, t - self._base) * 1e6, 3)
+
+    def complete(self, name: str, t0: float, t1: float,
+                 args: dict | None = None) -> None:
+        """Record a finished span from its `time.perf_counter` endpoints
+        — the idiom every stats-timed site uses, so span durations equal
+        the wall seconds charged to the counters."""
+        buf = self._buf()
+        ev = {"name": name, "cat": "racon_tpu", "ph": "X",
+              "ts": self._us(t0), "dur": round(max(0.0, t1 - t0) * 1e6, 3),
+              "pid": self._pid, "tid": self._local.tid}
+        if args:
+            ev["args"] = args
+        buf.append(ev)
+
+    def instant(self, name: str, args: dict | None = None) -> None:
+        buf = self._buf()
+        ev = {"name": name, "cat": "racon_tpu", "ph": "i", "s": "t",
+              "ts": self._us(time.perf_counter()),
+              "pid": self._pid, "tid": self._local.tid}
+        if args:
+            ev["args"] = args
+        buf.append(ev)
+
+    def span(self, name: str, **args) -> _Span:
+        return _Span(self, name, args or None)
+
+    # ------------------------------------------------------------ emission
+    def events(self) -> list[dict]:
+        """Timestamp-sorted snapshot of every buffer, prefixed with the
+        thread-name metadata events Perfetto uses to label tracks."""
+        with self._lock:
+            buffers = list(self._buffers)
+            threads = dict(self._threads)
+        meta = [{"name": "thread_name", "ph": "M", "pid": self._pid,
+                 "tid": tid, "args": {"name": tname}}
+                for tid, tname in sorted(threads.items())]
+        evs: list[dict] = []
+        for buf in buffers:
+            evs.extend(list(buf))  # list() snapshots concurrent appends
+        evs.sort(key=lambda e: e["ts"])
+        return meta + evs
+
+    def save(self, path: str | None = None) -> str:
+        """Write the Chrome trace-event JSON object form (the format
+        Perfetto and chrome://tracing both load)."""
+        path = path or self.path
+        if not path:
+            raise ValueError("TraceRecorder.save: no output path")
+        with open(path, "w") as fh:
+            json.dump({"traceEvents": self.events(),
+                       "displayTimeUnit": "ms"}, fh)
+        return path
+
+
+# ----------------------------------------------------------- module state
+#: resolved-once process tracer: None (the common case — every hook is a
+#: single `is None` check) or the armed recorder
+_tracer: TraceRecorder | None = None
+_resolved = False
+
+
+def get_tracer() -> TraceRecorder | None:
+    """The process tracer, armed lazily from RACON_TPU_TRACE on first
+    call (None when unset — the zero-overhead clean path)."""
+    global _tracer, _resolved
+    if not _resolved:
+        path = os.environ.get("RACON_TPU_TRACE")
+        _tracer = TraceRecorder(path) if path else None
+        _resolved = True
+    return _tracer
+
+
+def configure(path: str | None = None) -> TraceRecorder:
+    """Explicitly arm (or re-arm) recording — tests and tools; the CLI
+    path goes through the RACON_TPU_TRACE env so subprocesses inherit."""
+    global _tracer, _resolved
+    _tracer = TraceRecorder(path)
+    _resolved = True
+    return _tracer
+
+
+def reset() -> None:
+    """Drop the tracer and the env resolution (tests re-arm per case)."""
+    global _tracer, _resolved
+    _tracer = None
+    _resolved = False
+
+
+def save(path: str | None = None) -> str | None:
+    """Write the armed tracer's events to its configured path (or
+    `path`); None when tracing is off or has nowhere to write — callers
+    use this as the unconditional end-of-run hook."""
+    tr = get_tracer()
+    if tr is None or not (path or tr.path):
+        return None
+    return tr.save(path)
+
+
+def span(name: str, **args):
+    """Convenience span: a real recording context when tracing is armed,
+    a shared no-op otherwise."""
+    tr = get_tracer()
+    return tr.span(name, **args) if tr is not None else _NULL_SPAN
+
+
+def instant(name: str, **args) -> None:
+    tr = get_tracer()
+    if tr is not None:
+        tr.instant(name, args or None)

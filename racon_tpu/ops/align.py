@@ -321,14 +321,13 @@ class BatchAligner:
         continues (chunk-granularity GPU->CPU discipline,
         cudapolisher.cpp:354-383) instead of aborting the whole phase.
         """
-        import sys
-
         import jax
 
         from .encode import encode_padded
         from ..parallel.mesh import BatchRunner
         from ..pipeline import DispatchPipeline
         from ..resilience import strict_mode
+        from ..utils.logger import warn_dedup
 
         runner = self.runner if self.runner is not None else BatchRunner()
         pl = pipeline if pipeline is not None else DispatchPipeline(depth=0)
@@ -496,12 +495,17 @@ class BatchAligner:
 
         def chunk_error(chunk, exc):
             # a chunk dead after watchdog/retry: its pairs host-align via
-            # the reject protocol; results stay complete, never crash
+            # the reject protocol; results stay complete, never crash.
+            # Deduplicated: on a wedged device this fires once per chunk
+            # with near-identical text — the first prints, repeats are
+            # counted (RACON_TPU_LOG_LEVEL=debug shows each)
             edge, band, n_waves, idx = chunk
             streak["n"] += 1
-            print(f"[racon_tpu::BatchAligner] warning: device chunk "
-                  f"failed ({type(exc).__name__}: {exc}); {len(idx)} "
-                  "pairs to host fallback", file=sys.stderr)
+            warn_dedup(
+                "BatchAligner.device_chunk_failed",
+                f"[racon_tpu::BatchAligner] warning: device chunk "
+                f"failed ({type(exc).__name__}: {exc}); {len(idx)} "
+                "pairs to host fallback")
             if streak["n"] >= MAX_STREAK:
                 from ..errors import DeviceError
 
@@ -516,7 +520,11 @@ class BatchAligner:
 
         pl.run(chunks, pack, dispatch, wait, unpack,
                on_error=(chunk_error if on_reject is not None
-                         and not strict_mode() else None))
+                         and not strict_mode() else None),
+               label="aligner",
+               describe=lambda c: {"engine": "aligner",
+                                   "bucket": f"{c[0]}x{c[1]}",
+                                   "jobs": len(c[3])})
         return results
 
 

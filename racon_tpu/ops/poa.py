@@ -35,7 +35,7 @@ import os
 
 from ..native import poa_batch
 from ..resilience import strict_mode
-from ..utils.logger import Logger
+from ..utils.logger import Logger, log_info, warn_dedup
 
 
 class BatchPOA:
@@ -99,8 +99,6 @@ class BatchPOA:
 
         host = todo
         if self.device_batches > 0:
-            import sys
-
             from ..errors import DeviceError, RaconError
 
             def degrade(msg):
@@ -110,9 +108,8 @@ class BatchPOA:
                 # keep polishing those same windows underneath it
                 if self.pipeline is not None:
                     self.pipeline.cancel_fallback()
-                print(f"[racon_tpu::BatchPOA] warning: device consensus "
-                      f"failed ({msg}); falling back to host engine",
-                      file=sys.stderr)
+                log_info(f"[racon_tpu::BatchPOA] warning: device consensus "
+                         f"failed ({msg}); falling back to host engine")
                 return [w for w in todo if not w.polished]
 
             try:
@@ -169,11 +166,11 @@ class BatchPOA:
             # host-chunk failure: retry each window on its own; a window
             # that fails alone is poisoned — quarantine it (draft
             # backbone as consensus, counted) and keep the run alive
-            import sys
-
-            print(f"[racon_tpu::BatchPOA] warning: host consensus chunk "
-                  f"failed ({type(exc).__name__}: {exc}); retrying "
-                  f"{len(chunk)} windows individually", file=sys.stderr)
+            warn_dedup(
+                "BatchPOA.host_chunk_failed",
+                f"[racon_tpu::BatchPOA] warning: host consensus chunk "
+                f"failed ({type(exc).__name__}: {exc}); retrying "
+                f"{len(chunk)} windows individually")
             for w in chunk:
                 try:
                     (cons, cov), = poa_batch([_pack(w)], self.match,
@@ -183,15 +180,18 @@ class BatchPOA:
                 except Exception as wexc:
                     w.backbone_fallback()
                     pl.stats.bump("quarantined")
-                    print("[racon_tpu::BatchPOA] warning: window "
-                          f"quarantined (kept draft backbone; "
-                          f"{type(wexc).__name__}: {wexc})",
-                          file=sys.stderr)
+                    warn_dedup(
+                        "BatchPOA.window_quarantined",
+                        "[racon_tpu::BatchPOA] warning: window "
+                        f"quarantined (kept draft backbone; "
+                        f"{type(wexc).__name__}: {wexc})")
                 if bar is not None:
                     bar("[racon_tpu::Polisher.polish] generating consensus")
 
         pl.run(chunks, pack, dispatch, wait, unpack,
-               on_error=None if strict_mode() else chunk_error)
+               on_error=None if strict_mode() else chunk_error,
+               label="host_poa",
+               describe=lambda c: {"engine": "host", "jobs": len(c)})
 
     def _device_consensus(self, todo, trim) -> list:
         """Device consensus over `todo`; unfit/failed windows are
@@ -207,8 +207,6 @@ class BatchPOA:
         single-launch engine, ops/poa_fused.py — the cudapoa-shaped
         design; equal aggregate quality, rare topo-order tie divergence
         possible on deep windows — see its module docstring)."""
-        import sys
-
         from .poa_graph import DeviceGraphPOA
 
         packed = [_pack(w) for w in todo]
@@ -232,14 +230,13 @@ class BatchPOA:
                                                 pipeline=self.pipeline)
             rest = [i for i, r in enumerate(results) if r is None]
             fs = fused.last_stats
-            print(f"[racon_tpu::BatchPOA] fused engine built "
-                  f"{int((statuses == 0).sum())} windows "
-                  f"({fs['chunks']} chunks, {fs['launches']} device "
-                  f"launches, pack {fs['pack_s']:.2f}s, device "
-                  f"{fs['device_s']:.2f}s, finalize {fs['unpack_s']:.2f}s); "
-                  f"{fused.n_fallback} to "
-                  f"{'host' if to_host else 'session'} engine",
-                  file=sys.stderr)
+            log_info(f"[racon_tpu::BatchPOA] fused engine built "
+                     f"{int((statuses == 0).sum())} windows "
+                     f"({fs['chunks']} chunks, {fs['launches']} device "
+                     f"launches, pack {fs['pack_s']:.2f}s, device "
+                     f"{fs['device_s']:.2f}s, finalize "
+                     f"{fs['unpack_s']:.2f}s); {fused.n_fallback} to "
+                     f"{'host' if to_host else 'session'} engine")
             if rest:
                 # leftover windows are a handful of envelope-tail cases:
                 # adapting a grid to THEM would compile throwaway
@@ -280,15 +277,15 @@ class BatchPOA:
                 w.apply_trim(r[0], r[1], trim)
         stats = getattr(engine, "last_stats", None) or {}
         if "committed" in stats:
-            print(f"[racon_tpu::BatchPOA] device layer alignments: "
-                  f"{stats['committed']} committed, {stats['redos']} "
-                  "banded-clip full-DP retries", file=sys.stderr)
+            log_info(f"[racon_tpu::BatchPOA] device layer alignments: "
+                     f"{stats['committed']} committed, {stats['redos']} "
+                     "banded-clip full-DP retries")
         n_fallback = int((statuses == 1).sum())
         if n_fallback:
             # the reference logs GPU-skipped work the same way
             # (cudapolisher.cpp:204-206)
-            print(f"[racon_tpu::BatchPOA] {n_fallback} windows polished on "
-                  "host (outside device kernel envelope)", file=sys.stderr)
+            log_info(f"[racon_tpu::BatchPOA] {n_fallback} windows polished "
+                     "on host (outside device kernel envelope)")
         return leftover
 
 

@@ -69,13 +69,12 @@ from __future__ import annotations
 
 import functools
 import os
-import sys
 
 import numpy as np
 
 from ..errors import DeviceError
 from ..resilience import strict_mode
-from ..utils.logger import Logger
+from ..utils.logger import Logger, log_info, warn_dedup
 #: envelope shared with the session engine (ONE source of truth, incl.
 #: the construction-time RACON_TPU_MAX_NODES override; measured: ~2000
 #: nodes at depth 38 on the lambda sample, and the default envelope
@@ -873,9 +872,11 @@ class FusedPOA:
             # the chunk's windows stay unbuilt; the fallback tail below
             # polishes every one of them on host
             streak["n"] += 1
-            print(f"[racon_tpu::FusedPOA] warning: device chunk failed "
-                  f"({type(exc).__name__}: {exc}); {len(chunk)} windows "
-                  "to fallback", file=sys.stderr)
+            warn_dedup(
+                "FusedPOA.device_chunk_failed",
+                f"[racon_tpu::FusedPOA] warning: device chunk failed "
+                f"({type(exc).__name__}: {exc}); {len(chunk)} windows "
+                "to fallback")
             if streak["n"] >= MAX_STREAK:
                 pl.stats.bump("breaker_trips")
                 err = DeviceError(
@@ -896,7 +897,10 @@ class FusedPOA:
             # meanwhile
             base = pl.stats.snapshot()
             pl.run(chunk_items, pack, dispatch, wait, unpack,
-                   on_error=None if strict else on_error)
+                   on_error=None if strict else on_error,
+                   label="fused",
+                   describe=lambda c: {"engine": "fused",
+                                       "jobs": len(c)})
             after = pl.stats.snapshot()
             for key in ("pack_s", "device_s", "unpack_s", "chunks",
                         "launches"):
@@ -910,10 +914,11 @@ class FusedPOA:
                     # this fallback job died even after its bounded
                     # retry: its windows stay None for the caller's
                     # per-window quarantine path
-                    print("[racon_tpu::FusedPOA] warning: fallback job "
-                          f"failed ({type(exc).__name__}: {exc}); "
-                          f"{len(sub)} windows left to the caller",
-                          file=sys.stderr)
+                    warn_dedup(
+                        "FusedPOA.fallback_job_failed",
+                        "[racon_tpu::FusedPOA] warning: fallback job "
+                        f"failed ({type(exc).__name__}: {exc}); "
+                        f"{len(sub)} windows left to the caller")
                     continue
                 for i, r in zip(sub, sub_res):
                     results[i] = r
@@ -936,10 +941,9 @@ class FusedPOA:
                 # instead of losing the whole device pass's results
                 if strict:
                     raise
-                print("[racon_tpu::FusedPOA] warning: host fallback "
-                      f"batch failed ({type(exc).__name__}: {exc}); "
-                      f"{len(rest)} windows left to the caller",
-                      file=sys.stderr)
+                log_info("[racon_tpu::FusedPOA] warning: host fallback "
+                         f"batch failed ({type(exc).__name__}: {exc}); "
+                         f"{len(rest)} windows left to the caller")
             else:
                 for i, r in zip(rest, host):
                     results[i] = r

@@ -59,7 +59,8 @@ from collections import deque
 
 import numpy as np
 
-from ..utils.logger import Logger
+from ..obs import trace
+from ..utils.logger import Logger, log_info
 
 #: kernel shape envelope: max graph nodes, max layer len, max node
 #: in-degree. Sized from measurement so w=500 ONT polishing fits entirely
@@ -85,8 +86,6 @@ def env_max_nodes(default: int = MAX_NODES) -> int:
     one: RACON_TPU_MAX_NODES when set to a sane positive integer, else
     `default`. Invalid values warn and fall back instead of crashing
     the import or silently emptying the bucket ladder."""
-    import sys
-
     raw = os.environ.get("RACON_TPU_MAX_NODES")
     if not raw:
         return default
@@ -97,9 +96,9 @@ def env_max_nodes(default: int = MAX_NODES) -> int:
     # upper bound: beyond 32k nodes a single DP row costs ~100 MB and a
     # typo'd extra digit should warn, not OOM the device
     if v < 512 or v > 32768:
-        print(f"[racon_tpu::env_max_nodes] warning: ignoring invalid "
-              f"RACON_TPU_MAX_NODES={raw!r} (want an integer in "
-              "[512, 32768])", file=sys.stderr)
+        log_info(f"[racon_tpu::env_max_nodes] warning: ignoring invalid "
+                 f"RACON_TPU_MAX_NODES={raw!r} (want an integer in "
+                 "[512, 32768])")
         return default
     return v
 
@@ -156,8 +155,6 @@ def _device_budget(devices) -> int:
     recorded which path sized the batches). The chosen branch is logged
     on stderr once per process so every run's artifact shows whether a
     real free-memory reading drove the batch widths."""
-    import sys
-
     dev = devices[0]
     budget = 0
     override = os.environ.get("RACON_TPU_DEVICE_MEM")
@@ -170,9 +167,9 @@ def _device_budget(devices) -> int:
             kind = "override"
             branch = f"RACON_TPU_DEVICE_MEM override ({budget} bytes)"
         else:
-            print(f"[racon_tpu::device_budget] warning: ignoring invalid "
-                  f"RACON_TPU_DEVICE_MEM={override!r} (want a positive "
-                  "byte count)", file=sys.stderr)
+            log_info(f"[racon_tpu::device_budget] warning: ignoring invalid "
+                     f"RACON_TPU_DEVICE_MEM={override!r} (want a positive "
+                     "byte count)")
     if budget <= 0:
         branch = ""
         kind = ""
@@ -198,8 +195,8 @@ def _device_budget(devices) -> int:
     # logs each sizing path once rather than once per query
     if kind not in _budget_logged:
         _budget_logged.add(kind)
-        print(f"[racon_tpu::device_budget] {branch} -> {budget} bytes "
-              f"(platform {dev.platform})", file=sys.stderr)
+        log_info(f"[racon_tpu::device_budget] {branch} -> {budget} bytes "
+                 f"(platform {dev.platform})")
     return budget
 
 
@@ -655,8 +652,10 @@ class DeviceGraphPOA:
             # commit the oldest batch (blocks only on ITS device result;
             # younger batches keep computing via async dispatch)
             win, layer, band, npart, lb, out = inflight.popleft()
-            ranks = _materialize(out)[:npart, :lb]
-            session.commit(win, layer, band, ranks)
+            with trace.span("session.commit", engine="session",
+                            jobs=npart):
+                ranks = _materialize(out)[:npart, :lb]
+                session.commit(win, layer, band, ranks)
             freed += npart
             if bar is not None:
                 for _ in range(npart):
@@ -664,14 +663,12 @@ class DeviceGraphPOA:
                         "aligning layers to graphs on device")
         self.last_stats = session.stats()
         if self._env_stats is not None:
-            import sys
-
             self._env_stats["max_depth"] = max(
                 (len(w) - 1 for w in windows), default=0)
-            print(f"[racon_tpu::DeviceGraphPOA] envelope stats: "
-                  f"{self._env_stats} (envelope: nodes {self.max_nodes}, "
-                  f"len {self.max_len}, pred {self.max_pred}, RING {RING})",
-                  file=sys.stderr)
+            log_info(f"[racon_tpu::DeviceGraphPOA] envelope stats: "
+                     f"{self._env_stats} (envelope: nodes {self.max_nodes}, "
+                     f"len {self.max_len}, pred {self.max_pred}, "
+                     f"RING {RING})")
         return session.finish(self.num_threads)
 
     #: bucket groups smaller than this merge upward into the next larger
@@ -730,7 +727,9 @@ class DeviceGraphPOA:
                 sel = np.asarray(part, dtype=np.int64)
                 meta = (jobs["win"][sel].copy(), jobs["layer"][sel].copy(),
                         jobs["band"][sel].copy())
-                out = self._dispatch(jobs, sel, nb, lb, B)
+                with trace.span("session.dispatch", engine="session",
+                                bucket=f"{nb}x{lb}", jobs=len(part)):
+                    out = self._dispatch(jobs, sel, nb, lb, B)
                 # occupancy recorded AFTER the dispatch call returned
                 # (the aligner's discipline: a batch killed before the
                 # device saw it must not be accounted as device work)
@@ -765,11 +764,9 @@ class DeviceGraphPOA:
         RING)."""
         ring = RING if (ring_ok and nb > RING) else 0
         if not ring_ok and not getattr(self, "_warned_full", False):
-            import sys
-
             self._warned_full = True
-            print("[racon_tpu::DeviceGraphPOA] long back-edge batch: "
-                  "using the full-carry DP program", file=sys.stderr)
+            log_info("[racon_tpu::DeviceGraphPOA] long back-edge batch: "
+                     "using the full-carry DP program")
         return graph_aligner(nb, lb, self.max_pred, self.match,
                              self.mismatch, self.gap, ring=ring)
 

@@ -59,9 +59,16 @@ import threading
 import time
 from concurrent.futures import Future, ThreadPoolExecutor
 
+from ..obs import trace
 from ..resilience import Watchdog, get_fault_plan
 
 _STOP = object()
+
+#: PipelineStats keys whose bumps are semantic events, mirrored as trace
+#: instant events when the tracer is armed — the counter and the trace
+#: can never disagree because both come from the same bump
+_INSTANT_KEYS = frozenset(("faults", "retries", "timeouts",
+                           "breaker_trips", "quarantined", "cancelled"))
 
 
 class PipelineStats:
@@ -88,6 +95,10 @@ class PipelineStats:
     def bump(self, key: str, amount=1) -> None:
         with self._lock:
             self._v[key] += amount
+        if key in _INSTANT_KEYS:
+            tr = trace.get_tracer()
+            if tr is not None:
+                tr.instant(f"resilience.{key}", {"n": amount})
 
     def snapshot(self) -> dict:
         with self._lock:
@@ -127,15 +138,32 @@ class DispatchPipeline:
         self._futures: list[Future] = []
 
     # ------------------------------------------------------------ stages
-    def run(self, items, pack, dispatch, wait, unpack, on_error=None) -> None:
+    def run(self, items, pack, dispatch, wait, unpack, on_error=None,
+            label: str | None = None, describe=None) -> None:
+        """`label` names this loop in the trace (aligner / fused /
+        host_poa); `describe(item) -> dict` supplies per-chunk span args
+        (engine, bucket, job count). Both are ignored — zero cost — when
+        tracing is off."""
         items = list(items)
         if self.faults is not None or self.watchdog is not None:
             pack, dispatch, wait, unpack = self._instrument(
                 pack, dispatch, wait, unpack)
+        tr = trace.get_tracer()
+        args_of = None
+        if tr is not None:
+            def args_of(idx, item):
+                a = {"chunk": idx}
+                if label:
+                    a["loop"] = label
+                if describe is not None:
+                    a.update(describe(item))
+                return a
         if self.depth == 0:
-            self._run_sync(items, pack, dispatch, wait, unpack, on_error)
+            self._run_sync(items, pack, dispatch, wait, unpack, on_error,
+                           tr, args_of)
             return
-        self._run_async(items, pack, dispatch, wait, unpack, on_error)
+        self._run_async(items, pack, dispatch, wait, unpack, on_error,
+                        tr, args_of)
 
     def _instrument(self, pack, dispatch, wait, unpack):
         """Wrap the stage callbacks with the resilience hooks: fault
@@ -187,30 +215,50 @@ class DispatchPipeline:
 
         return pack_w, dispatch_w, wait_w, unpack_w
 
-    def _run_sync(self, items, pack, dispatch, wait, unpack, on_error):
+    def _run_sync(self, items, pack, dispatch, wait, unpack, on_error,
+                  tr=None, args_of=None):
+        # spans reuse the exact perf_counter endpoints the stats bumps
+        # charge, so per-stage span-duration sums equal the stage
+        # wall-clock counters by construction (tests/test_obs.py)
         stats = self.stats
-        for item in items:
+        for idx, item in enumerate(items):
             try:
                 t0 = time.perf_counter()
                 ops = pack(item)
-                stats.bump("pack_s", time.perf_counter() - t0)
+                t1 = time.perf_counter()
+                stats.bump("pack_s", t1 - t0)
+                if tr is not None:
+                    tr.complete("pipeline.pack", t0, t1, args_of(idx, item))
                 t0 = time.perf_counter()
                 handle = dispatch(item, ops)
-                stats.bump("device_s", time.perf_counter() - t0)
+                t1 = time.perf_counter()
+                stats.bump("device_s", t1 - t0)
                 stats.bump("chunks")
+                if tr is not None:
+                    tr.complete("pipeline.device", t0, t1,
+                                dict(args_of(idx, item), seg="dispatch"))
                 t0 = time.perf_counter()
                 res = wait(handle)
-                stats.bump("device_s", time.perf_counter() - t0)
+                t1 = time.perf_counter()
+                stats.bump("device_s", t1 - t0)
+                if tr is not None:
+                    tr.complete("pipeline.device", t0, t1,
+                                dict(args_of(idx, item), seg="wait"))
                 t0 = time.perf_counter()
                 unpack(item, res)
-                stats.bump("unpack_s", time.perf_counter() - t0)
+                t1 = time.perf_counter()
+                stats.bump("unpack_s", t1 - t0)
+                if tr is not None:
+                    tr.complete("pipeline.unpack", t0, t1,
+                                args_of(idx, item))
             except Exception as exc:
                 stats.bump("errors")
                 if on_error is None:
                     raise
                 on_error(item, exc)
 
-    def _run_async(self, items, pack, dispatch, wait, unpack, on_error):
+    def _run_async(self, items, pack, dispatch, wait, unpack, on_error,
+                   tr=None, args_of=None):
         stats = self.stats
         fatal: list[BaseException] = []
         abort = threading.Event()
@@ -232,17 +280,21 @@ class DispatchPipeline:
 
         def packer():
             try:
-                for item in items:
+                for idx, item in enumerate(items):
                     if abort.is_set():
                         break
                     try:
                         t0 = time.perf_counter()
                         ops = pack(item)
-                        stats.bump("pack_s", time.perf_counter() - t0)
+                        t1 = time.perf_counter()
+                        stats.bump("pack_s", t1 - t0)
+                        if tr is not None:
+                            tr.complete("pipeline.pack", t0, t1,
+                                        args_of(idx, item))
                     except Exception as exc:
                         guard(item, exc)
                         continue
-                    packed_q.put((item, ops))
+                    packed_q.put((idx, item, ops))
             finally:
                 packed_q.put(_STOP)
 
@@ -253,14 +305,22 @@ class DispatchPipeline:
                     return
                 if abort.is_set():
                     continue
-                item, handle = entry
+                idx, item, handle = entry
                 try:
                     t0 = time.perf_counter()
                     res = wait(handle)
-                    stats.bump("device_s", time.perf_counter() - t0)
+                    t1 = time.perf_counter()
+                    stats.bump("device_s", t1 - t0)
+                    if tr is not None:
+                        tr.complete("pipeline.device", t0, t1,
+                                    dict(args_of(idx, item), seg="wait"))
                     t0 = time.perf_counter()
                     unpack(item, res)
-                    stats.bump("unpack_s", time.perf_counter() - t0)
+                    t1 = time.perf_counter()
+                    stats.bump("unpack_s", t1 - t0)
+                    if tr is not None:
+                        tr.complete("pipeline.unpack", t0, t1,
+                                    args_of(idx, item))
                 except Exception as exc:
                     guard(item, exc)
 
@@ -287,16 +347,21 @@ class DispatchPipeline:
                     break
                 if abort.is_set():
                     continue
-                item, ops = entry
+                idx, item, ops = entry
                 try:
                     t0 = time.perf_counter()
                     handle = dispatch(item, ops)
-                    stats.bump("device_s", time.perf_counter() - t0)
+                    t1 = time.perf_counter()
+                    stats.bump("device_s", t1 - t0)
                     stats.bump("chunks")
+                    if tr is not None:
+                        tr.complete("pipeline.device", t0, t1,
+                                    dict(args_of(idx, item),
+                                         seg="dispatch"))
                 except Exception as exc:
                     guard(item, exc)
                     continue
-                waiting_q.put((item, handle))
+                waiting_q.put((idx, item, handle))
         except BaseException:
             # exceptional exit (KeyboardInterrupt is the real case): the
             # workers may be blocked on the bounded queues, so a plain
@@ -346,7 +411,11 @@ class DispatchPipeline:
                 return Watchdog(timeout=0.0, retries=wd.retries,
                                 backoff=wd.backoff).call(job, stats=stats)
             finally:
-                stats.bump("fallback_s", time.perf_counter() - t0)
+                t1 = time.perf_counter()
+                stats.bump("fallback_s", t1 - t0)
+                tr = trace.get_tracer()
+                if tr is not None:
+                    tr.complete("pipeline.fallback", t0, t1, {"job": idx})
 
         if self.depth == 0:
             fut: Future = Future()

@@ -90,6 +90,19 @@ class OccupancyStats:
                 return False
             _seen_shapes.add(k)
         self.record_compile(engine, seconds)
+        # trace the compile as a span ending now (the charge is made
+        # right after the first dispatch returned, so now - seconds is
+        # the dispatch's start) — the Perfetto view of "where did the
+        # first chunk's stall go"
+        from ..obs import trace
+
+        tr = trace.get_tracer()
+        if tr is not None:
+            import time
+
+            now = time.perf_counter()
+            tr.complete("xla.compile", now - float(seconds), now,
+                        {"engine": engine, "shape": str(key)})
         return True
 
     def snapshot(self) -> dict:

@@ -1,15 +1,120 @@
-"""Phase timing + progress logging to stderr.
+"""Phase timing + progress logging to stderr, plus the leveled logger.
 
-Mirrors the reference Logger (src/logger.cpp:20-54): `log()` opens a timing
-section, `log(msg)` closes it printing elapsed seconds, `bar(msg)` renders a
-fixed 20-bin progress bar, `total(msg)` prints cumulative elapsed time.
-"""
+`Logger` mirrors the reference Logger (src/logger.cpp:20-54): `log()`
+opens a timing section, `log(msg)` closes it printing elapsed seconds,
+`bar(msg)` renders a fixed 20-bin progress bar, `total(msg)` prints
+cumulative elapsed time.
+
+The module-level functions are the observability layer's structured,
+leveled logging (`RACON_TPU_LOG_LEVEL=quiet|info|debug`, default info):
+
+  - `log_info(msg)` / `log_debug(msg)` — plain leveled stderr lines. At
+    the default level every `log_info` prints exactly the text it is
+    given, so migrating a raw `print(..., file=sys.stderr)` site onto it
+    is byte-identical.
+  - `warn_dedup(key, msg)` — once-per-run deduplication for warnings
+    that repeat per chunk/window (host-fallback warnings flood stderr on
+    large runs): the first occurrence of `key` prints at info, repeats
+    are counted silently (every occurrence prints at debug), and
+    `flush_dedup()` — called at end of run — reports the suppressed
+    totals in one line per key.
+
+Timing/progress prints from `Logger` honor the same level (quiet
+silences them; timing ACCUMULATION is level-independent, so a quiet run
+still carries correct totals into the metrics snapshot)."""
 
 from __future__ import annotations
 
+import os
 import sys
 import threading
 import time
+
+# ---------------------------------------------------------- leveled logging
+QUIET, INFO, DEBUG = 0, 1, 2
+_LEVELS = {"quiet": QUIET, "info": INFO, "debug": DEBUG}
+#: the valid level names, in severity order — the one source of truth
+#: the CLI's --tpu-log-level validation shares
+LEVEL_NAMES = tuple(_LEVELS)
+
+#: resolved-once level (RACON_TPU_LOG_LEVEL); None = not yet resolved
+_level: int | None = None
+
+
+def log_level() -> int:
+    """The active level, resolved once from RACON_TPU_LOG_LEVEL (default
+    info; unknown values fall back to info rather than crashing — a
+    typo'd level must not take a run down)."""
+    global _level
+    if _level is None:
+        name = (os.environ.get("RACON_TPU_LOG_LEVEL") or "info").strip()
+        _level = _LEVELS.get(name.lower(), INFO)
+    return _level
+
+
+def set_log_level(name: str | None) -> None:
+    """Pin the level (`quiet`/`info`/`debug`), or None to re-resolve
+    from the environment on next use — tests and tools."""
+    global _level
+    if name is None:
+        _level = None
+        return
+    if name not in _LEVELS:
+        raise ValueError(f"set_log_level: unknown level {name!r} "
+                         f"(expected one of {', '.join(_LEVELS)})")
+    _level = _LEVELS[name]
+
+
+def log_info(msg: str) -> None:
+    if log_level() >= INFO:
+        print(msg, file=sys.stderr)
+
+
+def log_debug(msg: str) -> None:
+    if log_level() >= DEBUG:
+        print(msg, file=sys.stderr)
+
+
+# ------------------------------------------------------- warning dedup
+_dedup_lock = threading.Lock()
+#: key -> count of suppressed repeats since the first occurrence
+_dedup: dict[str, int] = {}
+
+
+def warn_dedup(key: str, msg: str) -> None:
+    """Leveled warning with once-per-run deduplication on `key` (the
+    call-site identity, not the formatted text — per-chunk messages
+    differ in counts/exception text but are the same warning). First
+    occurrence prints at info; repeats are counted for `flush_dedup()`.
+    At debug every occurrence prints in full."""
+    with _dedup_lock:
+        first = key not in _dedup
+        _dedup[key] = 0 if first else _dedup[key] + 1
+    lvl = log_level()
+    if lvl >= DEBUG or (first and lvl >= INFO):
+        print(msg, file=sys.stderr)
+
+
+def flush_dedup() -> None:
+    """End-of-run hook: report (and clear) the suppressed-repeat counts.
+    Silent when nothing repeated, at debug (everything already printed),
+    and at quiet."""
+    with _dedup_lock:
+        repeated = [(k, c) for k, c in _dedup.items() if c]
+        _dedup.clear()
+    if log_level() != INFO:
+        return
+    for key, count in repeated:
+        print(f"[racon_tpu::obs] warning '{key}' repeated {count} more "
+              f"time{'s' if count != 1 else ''} (suppressed; "
+              "RACON_TPU_LOG_LEVEL=debug shows every occurrence)",
+              file=sys.stderr)
+
+
+def reset_dedup() -> None:
+    """Drop dedup state without reporting (tests)."""
+    with _dedup_lock:
+        _dedup.clear()
 
 
 class Logger:
@@ -19,6 +124,7 @@ class Logger:
         self._bar_count = 0
         self._bar_total = 0
         self._total = 0.0
+        self._open = False
         # bar() is ticked concurrently by the dispatch pipeline's unpack
         # worker and fallback pool (pipeline/__init__.py); the tick
         # read-modify-write needs the lock or progress is lost
@@ -28,11 +134,14 @@ class Logger:
         now = time.perf_counter()
         if msg is None:
             self._time = now
+            self._open = True
             return
         elapsed = now - self._time
         self._total += elapsed
-        print(f"{msg} {elapsed:.5f} s", file=sys.stderr)
+        if log_level() >= INFO:
+            print(f"{msg} {elapsed:.5f} s", file=sys.stderr)
         self._time = now
+        self._open = False
 
     def bar_total(self, total: int) -> None:
         """Arm the 20-bin progress bar for `total` upcoming bar() calls."""
@@ -48,19 +157,30 @@ class Logger:
             if bins == self._bar and bins < 20:
                 return
             self._bar = bins
-            filled = "=" * bins + (">" if bins < 20 else "")
-            sys.stderr.write(f"{msg} [{filled:<20}] {bins * 5}%")
+            quiet = log_level() < INFO
+            if not quiet:
+                filled = "=" * bins + (">" if bins < 20 else "")
+                sys.stderr.write(f"{msg} [{filled:<20}] {bins * 5}%")
             if bins == 20 and self._bar_count >= self._bar_total:
                 elapsed = time.perf_counter() - self._time
                 self._total += elapsed
-                sys.stderr.write(f" {elapsed:.5f} s\n")
+                if not quiet:
+                    sys.stderr.write(f" {elapsed:.5f} s\n")
                 self._bar = 0
                 self._bar_count = 0
                 self._time = time.perf_counter()
-            else:
+            elif not quiet:
                 sys.stderr.write("\r")
-            sys.stderr.flush()
+            if not quiet:
+                sys.stderr.flush()
 
     def total(self, msg: str) -> None:
-        elapsed = self._total + (time.perf_counter() - self._time if self._bar else 0)
-        print(f"{msg} {elapsed:.5f} s", file=sys.stderr)
+        # an open log() section counts its elapsed time even with no bar
+        # mid-progress (it used to contribute 0 unless a bar was active);
+        # after a bar completion or log(msg) close, _time was just reset,
+        # so the addition is the genuine still-open remainder
+        elapsed = self._total
+        if self._open or self._bar:
+            elapsed += time.perf_counter() - self._time
+        if log_level() >= INFO:
+            print(f"{msg} {elapsed:.5f} s", file=sys.stderr)

@@ -48,6 +48,14 @@ def test_bench_host_only_emits_json_line():
     assert stages["device_s"] > 0
     assert stages["launches"] >= 1
     assert stages["errors"] == 0
+    # the unified observability snapshot rides the same line: one
+    # namespaced schema consolidating the stage/occupancy/degradation
+    # telemetry (racon_tpu/obs), consistent with the legacy fields
+    metrics = rec["metrics"]
+    for ns in ("pipeline", "resilience", "sched"):
+        assert ns in metrics
+    assert metrics["pipeline"]["chunks"] == stages["chunks"]
+    assert all(not v for v in metrics["resilience"].values())
 
 
 def test_bench_emits_json_even_when_phases_cannot_run():

@@ -1,0 +1,410 @@
+"""Observability layer: trace/metrics integrity, leveled logging.
+
+Pins the PR-4 contracts:
+  - every emitted span is well-formed (ph/pid/tid/name present, dur >= 0)
+    and the trace file is valid Chrome trace-event JSON;
+  - per-stage span-duration sums agree with the PipelineStats wall-clock
+    counters (they share perf_counter endpoints, so within tolerance);
+  - fault-plan runs produce resilience instant events matching the
+    degradation counters exactly (both come from the same bump);
+  - concurrent pipeline threads produce a parseable trace;
+  - tracing off by default, and a traced run's FASTA is byte-identical;
+  - the metrics registry namespaces (pipeline/sched/resilience/aligner),
+    the --tpu-metrics dump, and the bench-facing snapshot;
+  - leveled logging (quiet/info/debug), warn_dedup suppression, and the
+    Logger.total() open-section fix.
+"""
+
+import gzip
+import json
+import os
+import random
+import time
+
+import pytest
+
+from racon_tpu.obs import trace
+from racon_tpu.obs.metrics import MetricsRegistry
+from racon_tpu.utils import logger as ulog
+
+ACGT = b"ACGT"
+
+
+@pytest.fixture(autouse=True)
+def _reset_obs(monkeypatch):
+    """Every test starts with tracing unarmed, dedup empty and the log
+    level re-resolving from a clean environment."""
+    monkeypatch.delenv("RACON_TPU_TRACE", raising=False)
+    monkeypatch.delenv("RACON_TPU_METRICS", raising=False)
+    monkeypatch.delenv("RACON_TPU_LOG_LEVEL", raising=False)
+    monkeypatch.delenv("RACON_TPU_FAULT_PLAN", raising=False)
+    trace.reset()
+    ulog.reset_dedup()
+    ulog.set_log_level(None)
+    yield
+    trace.reset()
+    ulog.reset_dedup()
+    ulog.set_log_level(None)
+
+
+# ------------------------------------------------------------------ fixture
+def _mutate(rng, s, rate):
+    out = bytearray()
+    for c in s:
+        r = rng.random()
+        if r < rate / 3:
+            continue
+        if r < 2 * rate / 3:
+            out.append(rng.choice(ACGT))
+            out.append(c)
+            continue
+        if r < rate:
+            out.append(rng.choice(ACGT))
+            continue
+        out.append(c)
+    return bytes(out)
+
+
+@pytest.fixture(scope="module")
+def dataset(tmp_path_factory):
+    """Small synthetic polishing job (the faultcheck shape): a 2 kb
+    draft, windowed reads, PAF overlaps — enough windows and layers to
+    drive both pipeline phases on the host backend in well under a
+    second."""
+    rng = random.Random(11)
+    truth = bytes(rng.choice(ACGT) for _ in range(2000))
+    draft = _mutate(rng, truth, 0.04)
+    jobs = [(start, 400) for start in range(0, len(truth) - 400, 100)]
+    reads, paf = [], []
+    for k, (start, read_len) in enumerate(jobs):
+        read = _mutate(rng, truth[start:start + read_len], 0.05)
+        reads.append((f"r{k}", read))
+        t_end = min(start + read_len, len(draft))
+        paf.append(f"r{k}\t{len(read)}\t0\t{len(read)}\t+\tdraft\t"
+                   f"{len(draft)}\t{start}\t{t_end}\t{read_len}\t"
+                   f"{read_len}\t60")
+    d = tmp_path_factory.mktemp("obsdata")
+    paths = (str(d / "reads.fasta.gz"), str(d / "ovl.paf.gz"),
+             str(d / "draft.fasta.gz"))
+    with gzip.open(paths[0], "wb") as f:
+        for name, read in reads:
+            f.write(b">" + name.encode() + b"\n" + read + b"\n")
+    with gzip.open(paths[1], "wb") as f:
+        f.write(("\n".join(paf) + "\n").encode())
+    with gzip.open(paths[2], "wb") as f:
+        f.write(b">draft\n" + draft + b"\n")
+    return paths
+
+
+def _polish(paths, depth=2):
+    from racon_tpu.core.polisher import PolisherType, create_polisher
+
+    p = create_polisher(*paths, PolisherType.kC, 500, -1.0, 0.3,
+                        num_threads=2, tpu_pipeline_depth=depth)
+    p.initialize()
+    out = b"".join(b">" + s.name.encode() + b"\n" + s.data + b"\n"
+                   for s in p.polish())
+    return out, p
+
+
+def _load_trace(path):
+    with open(path) as fh:
+        doc = json.load(fh)
+    return doc["traceEvents"]
+
+
+# ------------------------------------------------------------- span tracing
+def test_tracing_off_by_default():
+    assert trace.get_tracer() is None
+    # the disabled convenience span is a working no-op context
+    with trace.span("noop", x=1):
+        pass
+
+
+def test_trace_events_well_formed(dataset, tmp_path):
+    path = str(tmp_path / "trace.json")
+    trace.configure(path)
+    _polish(dataset, depth=2)
+    events = _load_trace(path)  # polish() end saves automatically
+    assert events, "traced polish emitted no events"
+    names = {e["name"] for e in events}
+    for expected in ("polisher.initialize", "polisher.consensus",
+                     "pipeline.pack", "pipeline.device",
+                     "pipeline.unpack"):
+        assert expected in names, f"missing {expected} spans"
+    for ev in events:
+        for field in ("name", "ph", "pid", "tid"):
+            assert field in ev, f"event missing {field}: {ev}"
+        if ev["ph"] == "X":
+            assert ev["dur"] >= 0  # end >= start
+            assert ev["ts"] >= 0
+
+
+def test_span_sums_match_stage_stats(dataset, tmp_path):
+    path = str(tmp_path / "trace.json")
+    trace.configure(path)
+    _, polisher = _polish(dataset, depth=2)
+    events = _load_trace(path)
+    stats = polisher.stage_stats
+    sums = {}
+    for ev in events:
+        if ev["ph"] == "X" and ev["name"].startswith("pipeline."):
+            stage = ev["name"].split(".", 1)[1]
+            sums[stage] = sums.get(stage, 0.0) + ev["dur"] / 1e6
+    for stage, key in (("pack", "pack_s"), ("device", "device_s"),
+                       ("unpack", "unpack_s"), ("fallback", "fallback_s")):
+        want = stats[key]
+        got = sums.get(stage, 0.0)
+        # spans reuse the counters' perf_counter endpoints, so only
+        # float/serialization rounding separates them; 5% is the
+        # acceptance bound, 1 ms the small-value floor
+        assert got == pytest.approx(want, rel=0.05, abs=1e-3), \
+            f"{stage}: span sum {got} vs stage counter {want}"
+
+
+def test_fault_instants_match_counters(dataset, tmp_path, monkeypatch):
+    from racon_tpu.resilience.faults import reset_fault_plan
+
+    path = str(tmp_path / "trace.json")
+    monkeypatch.setenv("RACON_TPU_FAULT_PLAN", "device:chunk=0:raise")
+    reset_fault_plan()
+    trace.configure(path)
+    try:
+        _, polisher = _polish(dataset, depth=2)
+    finally:
+        monkeypatch.delenv("RACON_TPU_FAULT_PLAN")
+        reset_fault_plan()
+    stats = polisher.stage_stats
+    assert stats["faults"] >= 1
+    events = _load_trace(path)
+    fired = sum(e["args"]["n"] for e in events
+                if e["name"] == "resilience.faults")
+    assert fired == stats["faults"]
+    for e in events:
+        if e["name"].startswith("resilience."):
+            assert e["ph"] == "i"
+
+
+def test_quarantine_instants_match_counters(dataset, tmp_path,
+                                            monkeypatch):
+    # poison the host POA engine entirely: the chunk fails, the
+    # per-window retries fail, every eligible window quarantines — the
+    # trace's quarantine instants must equal the counter exactly
+    import racon_tpu.ops.poa as poa_mod
+
+    def boom(*a, **kw):
+        raise RuntimeError("poisoned poa")
+
+    monkeypatch.setattr(poa_mod, "poa_batch", boom)
+    path = str(tmp_path / "trace.json")
+    trace.configure(path)
+    out, polisher = _polish(dataset, depth=2)
+    stats = polisher.stage_stats
+    assert stats["quarantined"] > 0
+    # the run survived (every window on its draft backbone; with ratio 0
+    # the target is dropped from the output, the reference's `ratio > 0`
+    # rule — the point is no exception reached us)
+    events = _load_trace(path)
+    quarantined = sum(e["args"]["n"] for e in events
+                      if e["name"] == "resilience.quarantined")
+    assert quarantined == stats["quarantined"]
+
+
+def test_concurrent_pipeline_trace_parseable(tmp_path):
+    from racon_tpu.pipeline import DispatchPipeline
+
+    path = str(tmp_path / "trace.json")
+    rec = trace.configure(path)
+    results = []
+    with DispatchPipeline(depth=2, fallback_workers=3) as pl:
+        for _ in range(40):
+            pl.submit_fallback(lambda: time.sleep(0.0005))
+        pl.run(range(60),
+               pack=lambda i: i * 2,
+               dispatch=lambda i, ops: ops + 1,
+               wait=lambda h: h,
+               unpack=lambda i, res: results.append(res),
+               label="t", describe=lambda i: {"i": i})
+        pl.drain_fallback()
+    rec.save()
+    events = _load_trace(path)  # parseable despite 5+ writer threads
+    counts = {}
+    for e in events:
+        counts[e["name"]] = counts.get(e["name"], 0) + 1
+    assert counts["pipeline.pack"] == 60
+    assert counts["pipeline.device"] == 120  # dispatch + wait segments
+    assert counts["pipeline.unpack"] == 60
+    assert counts["pipeline.fallback"] == 40
+    assert len(results) == 60
+
+
+def test_env_armed_trace_nonnegative_ts(dataset, tmp_path, monkeypatch):
+    # arm via the env (the documented primary knob): the recorder is
+    # created lazily at polisher construction, yet phase spans whose
+    # start predates it must still clamp to ts >= 0
+    path = str(tmp_path / "trace.json")
+    monkeypatch.setenv("RACON_TPU_TRACE", path)
+    _polish(dataset, depth=2)
+    for ev in _load_trace(path):
+        if ev["ph"] != "M":
+            assert ev["ts"] >= 0, ev
+
+
+def test_traced_output_byte_identical(dataset, tmp_path):
+    out_plain, _ = _polish(dataset, depth=2)
+    trace.configure(str(tmp_path / "trace.json"))
+    out_traced, _ = _polish(dataset, depth=2)
+    assert out_plain == out_traced
+
+
+# ---------------------------------------------------------------- metrics
+def test_metrics_registry_basics(tmp_path):
+    reg = MetricsRegistry()
+    reg.register("pipeline", lambda: {"pack_s": 1.5, "chunks": 3})
+    reg.register("sched", lambda: {"aligner": {"occupancy_pct": 42.0}})
+    snap = reg.snapshot()
+    assert snap["pipeline"]["chunks"] == 3
+    flat = reg.flat()
+    assert flat["pipeline.pack_s"] == 1.5
+    assert flat["sched.aligner.occupancy_pct"] == 42.0
+    assert "pipeline.pack_s" in reg.table()
+    p = str(tmp_path / "m.json")
+    reg.dump(p)
+    assert json.load(open(p))["pipeline"]["chunks"] == 3
+    with pytest.raises(ValueError):
+        reg.register("a.b", dict)
+
+
+def test_polisher_metrics_namespaces(dataset):
+    _, polisher = _polish(dataset, depth=2)
+    snap = polisher.metrics.snapshot()
+    for ns in ("pipeline", "resilience", "sched", "aligner"):
+        assert ns in snap
+    stats = polisher.stage_stats
+    assert snap["pipeline"]["chunks"] == stats["chunks"]
+    assert snap["resilience"]["quarantined"] == stats["quarantined"]
+    # clean run: the whole resilience namespace is zero
+    assert all(not v for v in snap["resilience"].values())
+    flat = polisher.metrics.flat()
+    assert flat["pipeline.pack_s"] == stats["pack_s"]
+
+
+def test_metrics_env_dump(dataset, tmp_path, monkeypatch, capsys):
+    path = str(tmp_path / "metrics.json")
+    monkeypatch.setenv("RACON_TPU_METRICS", path)
+    _polish(dataset, depth=2)
+    snap = json.load(open(path))
+    assert "pipeline" in snap and "resilience" in snap
+    err = capsys.readouterr().err
+    assert "end-of-run metrics" in err
+    assert "pipeline.chunks" in err  # the stderr summary table
+
+
+# ---------------------------------------------------------------- logging
+def test_log_levels(capsys):
+    ulog.set_log_level("quiet")
+    ulog.log_info("INFO-LINE")
+    ulog.log_debug("DEBUG-LINE")
+    assert capsys.readouterr().err == ""
+    ulog.set_log_level("info")
+    ulog.log_info("INFO-LINE")
+    ulog.log_debug("DEBUG-LINE")
+    assert capsys.readouterr().err == "INFO-LINE\n"
+    ulog.set_log_level("debug")
+    ulog.log_info("INFO-LINE")
+    ulog.log_debug("DEBUG-LINE")
+    assert capsys.readouterr().err == "INFO-LINE\nDEBUG-LINE\n"
+
+
+def test_log_level_env_resolution(monkeypatch):
+    monkeypatch.setenv("RACON_TPU_LOG_LEVEL", "quiet")
+    ulog.set_log_level(None)
+    assert ulog.log_level() == ulog.QUIET
+    monkeypatch.setenv("RACON_TPU_LOG_LEVEL", "bogus")
+    ulog.set_log_level(None)
+    assert ulog.log_level() == ulog.INFO  # typo falls back, never crashes
+
+
+def test_warn_dedup_suppresses_repeats(capsys):
+    ulog.set_log_level("info")
+    for i in range(5):
+        ulog.warn_dedup("site.key", f"warning text {i}")
+    err = capsys.readouterr().err
+    assert err == "warning text 0\n"  # first occurrence only
+    ulog.flush_dedup()
+    err = capsys.readouterr().err
+    assert "repeated 4 more times" in err
+    # flushed: state cleared, the next run warns afresh
+    ulog.warn_dedup("site.key", "again")
+    assert capsys.readouterr().err == "again\n"
+
+
+def test_warn_dedup_debug_shows_all(capsys):
+    ulog.set_log_level("debug")
+    ulog.warn_dedup("k", "w1")
+    ulog.warn_dedup("k", "w2")
+    assert capsys.readouterr().err == "w1\nw2\n"
+    ulog.flush_dedup()  # nothing suppressed at debug: no summary
+    assert capsys.readouterr().err == ""
+
+
+def test_logger_total_counts_open_section(capsys):
+    ulog.set_log_level("info")
+    lg = ulog.Logger()
+    lg.log()  # open a section, no bar armed
+    time.sleep(0.02)
+    lg.total("total =")
+    line = capsys.readouterr().err.strip()
+    seconds = float(line.split()[-2])
+    assert seconds >= 0.015  # used to report 0 with no active bar
+
+
+def test_quiet_run_keeps_timing_totals(dataset, capsys):
+    ulog.set_log_level("quiet")
+    out, polisher = _polish(dataset, depth=2)
+    assert capsys.readouterr().err == ""  # quiet really is silent
+    assert out  # and the output is unaffected
+    assert polisher.stage_stats["chunks"] >= 1
+
+
+# -------------------------------------------------------------- CLI / misc
+def test_cli_obs_flags_parse():
+    from racon_tpu.cli import parse_args
+
+    opts = parse_args(["--tpu-trace", "t.json", "--tpu-metrics=m.json",
+                       "--tpu-log-level", "debug",
+                       "--tpu-jax-profile", "prof", "a", "b", "c"])
+    assert opts["tpu_trace"] == "t.json"
+    assert opts["tpu_metrics"] == "m.json"
+    assert opts["tpu_log_level"] == "debug"
+    assert opts["tpu_jax_profile"] == "prof"
+    assert opts["paths"] == ["a", "b", "c"]
+
+
+def test_cli_obs_flags_in_help(capsys):
+    from racon_tpu import cli
+
+    assert cli.main(["--help"]) == 0
+    out = capsys.readouterr().out
+    for flag in ("--tpu-trace", "--tpu-metrics", "--tpu-log-level",
+                 "--tpu-jax-profile"):
+        assert flag in out
+
+
+def test_jax_profile_noop_and_safe(monkeypatch, tmp_path):
+    from racon_tpu.obs import jax_profile
+
+    # unset: a null context
+    monkeypatch.delenv("RACON_TPU_PROFILE", raising=False)
+    with jax_profile("x"):
+        pass
+    # set but profiler broken: still a silent no-op, never a crash
+    monkeypatch.setenv("RACON_TPU_PROFILE", str(tmp_path / "prof"))
+    import jax
+
+    def broken(*a, **kw):
+        raise RuntimeError("no profiler on this backend")
+
+    monkeypatch.setattr(jax.profiler, "trace", broken)
+    with jax_profile("consensus"):
+        pass

@@ -133,14 +133,64 @@ def orphans(grace: float = 3.0) -> list[str]:
     return alive
 
 
+def validate_trace(trace_path, stats):
+    """The trace-validation gate: the injected run's trace must be valid
+    Chrome trace-event JSON whose resilience instant events match the
+    run's degradation counters. Returns None when OK, else a failure
+    string."""
+    import json
+
+    try:
+        with open(trace_path) as fh:
+            doc = json.load(fh)
+        events = doc["traceEvents"]
+    except Exception as exc:
+        return f"FAIL trace unparseable ({type(exc).__name__}: {exc})"
+    for ev in events:
+        for field in ("name", "ph", "pid", "tid"):
+            if field not in ev:
+                return f"FAIL trace event missing {field!r}"
+        if ev["ph"] == "X" and (ev["dur"] < 0 or ev["ts"] < 0):
+            return "FAIL trace span with negative ts/dur"
+    for key in ("faults", "quarantined"):
+        seen = sum(ev.get("args", {}).get("n", 1) for ev in events
+                   if ev["name"] == f"resilience.{key}")
+        if seen != stats[key]:
+            return (f"FAIL trace {key} events {seen} != "
+                    f"counter {stats[key]}")
+    return None
+
+
 def run_cell(paths, clean, depth, aligner, spec, timeout,
-             adaptive=False):
+             adaptive=False, trace=False):
+    trace_path = None
+    if trace:
+        fd, trace_path = tempfile.mkstemp(suffix=".json",
+                                          prefix="racon_trace_")
+        os.close(fd)
+    try:
+        return _run_cell(paths, clean, depth, aligner, spec, timeout,
+                         adaptive, trace_path)
+    finally:
+        if trace_path is not None:
+            try:
+                os.unlink(trace_path)
+            except OSError:
+                pass
+
+
+def _run_cell(paths, clean, depth, aligner, spec, timeout,
+              adaptive, trace_path):
+    from racon_tpu.obs import trace as obs_trace
     from racon_tpu.resilience.faults import reset_fault_plan
 
+    trace = trace_path is not None
     os.environ["RACON_TPU_FAULT_PLAN"] = spec
     os.environ["RACON_TPU_DEVICE_RETRIES"] = "1"
     os.environ["RACON_TPU_RETRY_BACKOFF"] = "0.01"
     reset_fault_plan()
+    if trace:
+        obs_trace.configure(trace_path)
     t0 = time.perf_counter()
     try:
         out, stats = polish(paths, depth, aligner, timeout, adaptive)
@@ -150,6 +200,11 @@ def run_cell(paths, clean, depth, aligner, spec, timeout,
         wall = time.perf_counter() - t0
         os.environ.pop("RACON_TPU_FAULT_PLAN", None)
         reset_fault_plan()
+        if trace:
+            try:
+                obs_trace.save(trace_path)
+            finally:
+                obs_trace.reset()
     if wall > WALL_CAP:
         return f"FAIL over budget ({wall:.0f}s)"
     if stats["faults"] < 1:
@@ -157,6 +212,12 @@ def run_cell(paths, clean, depth, aligner, spec, timeout,
     left = orphans()
     if left:
         return f"FAIL orphaned threads {left}"
+    traced = ""
+    if trace:
+        bad = validate_trace(trace_path, stats)
+        if bad is not None:
+            return bad
+        traced = " traced"
     if out == clean[depth, aligner]:
         how = "identical"
     elif stats["quarantined"] > 0:
@@ -165,7 +226,8 @@ def run_cell(paths, clean, depth, aligner, spec, timeout,
         return "FAIL output diverged without quarantine"
     extras = [f"{k} {stats[k]}" for k in ("retries", "timeouts")
               if stats[k]]
-    return f"pass  {how}" + (f" ({', '.join(extras)})" if extras else "")
+    return (f"pass  {how}{traced}"
+            + (f" ({', '.join(extras)})" if extras else ""))
 
 
 def main() -> int:
@@ -198,17 +260,24 @@ def main() -> int:
                 return 1
         width = max(len(m[0]) for m in rows)
         print(f"{'injection point':<{width}}  depth0"
-              f"{'':<30}depth2{'':<30}depth2+sched", file=sys.stderr)
+              f"{'':<30}depth2{'':<30}depth2+sched"
+              f"{'':<24}depth2+trace", file=sys.stderr)
+        # the 4th column runs with span tracing armed: the injected run
+        # must additionally produce a valid Chrome trace whose
+        # fault/quarantine instant events match the degradation counters
+        columns = ((0, False, False), (2, False, False),
+                   (2, True, False), (2, False, True))
         for name, aligner, spec, timeout, _slow in rows:
             cells = []
-            for depth, adaptive in ((0, False), (2, False), (2, True)):
+            for depth, adaptive, traced in columns:
                 cell = run_cell(paths, clean, depth, aligner, spec,
-                                timeout, adaptive)
+                                timeout, adaptive, trace=traced)
                 failures += cell.startswith("FAIL")
                 cells.append(f"{cell:<36}")
             print(f"{name:<{width}}  {''.join(cells)}", file=sys.stderr)
+    n_cells = len(columns) * len(rows)
     print(f"[faultcheck] {'FAIL' if failures else 'PASS'}: "
-          f"{3 * len(rows) - failures}/{3 * len(rows)} cells green",
+          f"{n_cells - failures}/{n_cells} cells green",
           file=sys.stderr)
     return 1 if failures else 0
 

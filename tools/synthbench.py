@@ -171,6 +171,11 @@ def main(argv=None):
     ap.add_argument("--golden-out", default=None,
                     help="write the polished FASTA here (golden artifact; "
                          "deterministic for a given seed/params)")
+    ap.add_argument("--trace", metavar="PATH", default=None,
+                    help="record a Chrome trace (Perfetto) of the polish "
+                         "to PATH and report trace-recording overhead vs "
+                         "an untraced baseline run of the same workload "
+                         "(target: < 2%%)")
     args = ap.parse_args(argv)
 
     from racon_tpu.core.polisher import create_polisher, PolisherType
@@ -201,19 +206,53 @@ def main(argv=None):
         with gzip.open(draft_path, "wb", compresslevel=1) as f:
             f.write(b">draft\n" + draft + b"\n")
 
-        t0 = time.perf_counter()
-        polisher = create_polisher(
-            reads_path, paf_path, draft_path, PolisherType.kC,
-            args.window_length, 10.0, 0.3, True, 5, -4, -8,
-            num_threads=args.threads,
-            tpu_poa_batches=args.tpupoa_batches,
-            tpu_aligner_batches=args.tpualigner_batches,
-            tpu_adaptive_buckets=args.adaptive_buckets or None)
-        polisher.initialize()
-        t1 = time.perf_counter()
-        n_windows = len(polisher.windows)
-        polished = polisher.polish()
-        t2 = time.perf_counter()
+        def run_polish():
+            t0 = time.perf_counter()
+            polisher = create_polisher(
+                reads_path, paf_path, draft_path, PolisherType.kC,
+                args.window_length, 10.0, 0.3, True, 5, -4, -8,
+                num_threads=args.threads,
+                tpu_poa_batches=args.tpupoa_batches,
+                tpu_aligner_batches=args.tpualigner_batches,
+                tpu_adaptive_buckets=args.adaptive_buckets or None)
+            polisher.initialize()
+            t1 = time.perf_counter()
+            n_windows = len(polisher.windows)
+            polished = polisher.polish()
+            t2 = time.perf_counter()
+            return polisher, polished, n_windows, t1 - t0, t2 - t1
+
+        if args.trace:
+            # overhead A/B on the SAME workload: a discarded warmup run
+            # first, so one-time process-wide costs (XLA jit compiles,
+            # compile telemetry, lazy imports) are paid before EITHER
+            # measured run — a cold baseline vs warm traced comparison
+            # would systematically understate the overhead — then the
+            # untraced baseline, then the traced run (whose outputs the
+            # identity metrics below use; all runs are deterministic)
+            from racon_tpu.obs import trace as obs_trace
+
+            run_polish()  # warmup, discarded
+            _, _, _, _, base_polish_s = run_polish()
+            # configure with NO path: polish()'s own end-of-run save is
+            # then a no-op, so the timed region measures pure recording
+            # overhead — serialization happens once, below, off-clock
+            rec = obs_trace.configure(None)
+            polisher, polished, n_windows, init_s, polish_s = run_polish()
+            n_events = len(rec.events())
+            rec.save(os.path.abspath(args.trace))
+            obs_trace.reset()
+            print(f"[synthbench] trace written to {args.trace}",
+                  file=sys.stderr)
+            overhead = ((polish_s - base_polish_s) / base_polish_s * 100
+                        if base_polish_s > 0 else 0.0)
+            print(f"[synthbench] trace overhead: {overhead:+.2f}% "
+                  f"(baseline {base_polish_s:.2f}s, traced "
+                  f"{polish_s:.2f}s, {n_events} events) "
+                  f"[{'OK' if overhead < 2.0 else 'OVER'} 2% target]",
+                  file=sys.stderr)
+        else:
+            polisher, polished, n_windows, init_s, polish_s = run_polish()
         # occupancy report: the per-bucket padding-waste metric the
         # adaptive scheduler moves (see README "Batch scheduling &
         # occupancy"); printed per bucket so a ladder change is
@@ -239,8 +278,8 @@ def main(argv=None):
     # throughput first: the identity metric below costs O(genome^2/64)
     # Myers time at multi-Mb scale, and the perf number must survive a
     # wall-cap hitting mid-metric
-    print(f"[synthbench] init {t1 - t0:.1f}s  polish {t2 - t1:.1f}s  "
-          f"({n_windows} windows, {n_windows / (t2 - t1):.1f} windows/s)",
+    print(f"[synthbench] init {init_s:.1f}s  polish {polish_s:.1f}s  "
+          f"({n_windows} windows, {n_windows / polish_s:.1f} windows/s)",
           file=sys.stderr)
     d_draft = edit_distance(draft, truth)
     d_pol = edit_distance(polished[0].data, truth)
