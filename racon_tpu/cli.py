@@ -16,6 +16,16 @@ from .errors import RaconError
 
 HELP = """\
 usage: racon_tpu [options ...] <sequences> <overlaps> <target sequences>
+       racon_tpu serve [serve options ...]
+       racon_tpu submit [submit options ...] <sequences> <overlaps> <target>
+
+    subcommands (see `racon_tpu serve --help` / `racon_tpu submit --help`
+    and the README "Serving" section):
+        serve   run the warm polishing job server (one process keeps the
+                engines compiled; jobs from many clients share device
+                batches)
+        submit  send one polishing job to a running server; polished
+                FASTA on stdout, byte-identical to the one-shot run
 
     #default output is stdout
     <sequences>
@@ -324,6 +334,17 @@ def parse_args(argv: list[str]) -> dict | None:
 
 def main(argv: list[str] | None = None) -> int:
     argv = sys.argv[1:] if argv is None else argv
+    # serve-mode subcommands (README "Serving"): `serve` runs the warm
+    # polishing job server, `submit` sends one job to it. Everything
+    # else is the classic one-shot surface below, untouched.
+    if argv and argv[0] == "serve":
+        from .serve.server import serve_main
+
+        return serve_main(argv[1:])
+    if argv and argv[0] == "submit":
+        from .serve.client import submit_main
+
+        return submit_main(argv[1:])
     opts = parse_args(argv)
     if opts is None:
         return 0

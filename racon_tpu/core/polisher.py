@@ -53,7 +53,8 @@ def create_polisher(sequences_path: str, overlaps_path: str, target_path: str,
                     tpu_pipeline_depth: int = 2,
                     tpu_device_timeout: float = 0.0,
                     tpu_adaptive_buckets: bool | None = None,
-                    tpu_compile_cache: str | None = None) -> "Polisher":
+                    tpu_compile_cache: str | None = None,
+                    tpu_fault_plan: str | None = None) -> "Polisher":
     """Factory mirroring reference createPolisher (polisher.cpp:55-160).
 
     The tpu_* knobs parallel the reference's CUDA flags (main.cpp:36-41); the
@@ -72,6 +73,9 @@ def create_polisher(sequences_path: str, overlaps_path: str, target_path: str,
     directory so repeated runs — including adaptive ones with
     data-derived shapes — skip recompiles (None defers to
     RACON_TPU_COMPILE_CACHE).
+    `tpu_fault_plan` arms a fault-injection plan for THIS polisher only
+    (the serve layer's per-job isolation; None defers to the process-wide
+    RACON_TPU_FAULT_PLAN posture).
     """
     if not isinstance(type_, PolisherType):
         raise RaconError("createPolisher", "invalid polisher type!")
@@ -87,7 +91,7 @@ def create_polisher(sequences_path: str, overlaps_path: str, target_path: str,
                     gap, num_threads, tpu_poa_batches, tpu_banded_alignment,
                     tpu_aligner_batches, tpu_aligner_band_width, tpu_engine,
                     tpu_pipeline_depth, tpu_device_timeout,
-                    tpu_adaptive_buckets, tpu_compile_cache)
+                    tpu_adaptive_buckets, tpu_compile_cache, tpu_fault_plan)
 
 
 class Polisher:
@@ -101,7 +105,8 @@ class Polisher:
                  tpu_pipeline_depth: int = 2,
                  tpu_device_timeout: float = 0.0,
                  tpu_adaptive_buckets: bool | None = None,
-                 tpu_compile_cache: str | None = None):
+                 tpu_compile_cache: str | None = None,
+                 tpu_fault_plan: str | None = None):
         self.sparser = sparser
         self.oparser = oparser
         self.tparser = tparser
@@ -121,6 +126,13 @@ class Polisher:
         self.tpu_engine = tpu_engine
         self.tpu_pipeline_depth = max(0, tpu_pipeline_depth)
         self.tpu_device_timeout = max(0.0, tpu_device_timeout)
+        # per-polisher fault plan (serve mode: each job's injected faults
+        # stay its own); None defers every pipeline to the process-wide
+        # RACON_TPU_FAULT_PLAN posture, the one-shot CLI behavior
+        from ..resilience import FaultPlan
+
+        self.faults = (FaultPlan.parse(tpu_fault_plan)
+                       if tpu_fault_plan else None)
         # per-stage wall-clock counters shared by both hot phases' dispatch
         # pipelines (pack / device / unpack / fallback seconds, launch and
         # chunk counts) — the observability half of the overlap design;
@@ -146,6 +158,10 @@ class Polisher:
         self.dummy_quality = b"!" * window_length
         self.logger = Logger()
         self._num_targets = 0
+        #: completed initialize()+polish() cycles — a reused (warm)
+        #: polisher resets its per-run counters at the next initialize()
+        #: so every run's stats describe that run alone
+        self._runs_completed = 0
         # alignment-phase accounting (reference cudapolisher.cpp:204-206)
         self.n_aligner_pairs = 0
         self.n_aligner_device = 0
@@ -167,7 +183,11 @@ class Polisher:
         self.metrics.register(
             "resilience", lambda: {k: self.stage_stats.get(k, 0)
                                    for k in REPORT_KEYS})
-        self.metrics.register("sched", self.scheduler.stats.snapshot)
+        # late-bound lambda, not the bound method: a warm-reused polisher
+        # swaps in a fresh OccupancyStats per run and the registry must
+        # follow it
+        self.metrics.register("sched",
+                              lambda: self.scheduler.stats.snapshot())
         self.metrics.register(
             "aligner", lambda: {
                 "pairs": self.n_aligner_pairs,
@@ -191,7 +211,9 @@ class Polisher:
                                 watchdog=Watchdog.from_env(
                                     timeout=self.tpu_device_timeout
                                     or None),
-                                faults=get_fault_plan())
+                                faults=(self.faults
+                                        if self.faults is not None
+                                        else get_fault_plan()))
 
     @property
     def stage_stats(self) -> dict:
@@ -206,12 +228,56 @@ class Polisher:
         this next to `stages` in its JSON artifact."""
         return self.scheduler.stats.snapshot()
 
+    # ------------------------------------------------------- warm reuse
+    def _reset_run_state(self) -> None:
+        """Fresh per-run counters for a warm-reused polisher: a second
+        initialize()+polish() cycle must report ITS OWN stage seconds,
+        occupancy, degradation and aligner counts — not a running total
+        across jobs — and its FASTA must be byte-identical to a fresh-
+        process run (tests/test_serve.py pins both). Engines, jit caches
+        and the compile-cache posture are process-level and deliberately
+        stay warm."""
+        from ..pipeline import PipelineStats
+        from ..sched import OccupancyStats
+
+        self.pipeline_stats = PipelineStats()
+        self.scheduler.stats = OccupancyStats()
+        self.n_aligner_pairs = 0
+        self.n_aligner_device = 0
+        self.n_aligner_host_fallback = 0
+        self.logger = Logger()
+        self.targets_coverages = []
+        self._num_targets = 0
+
+    def rebind(self, sequences_path: str, overlaps_path: str,
+               target_path: str) -> "Polisher":
+        """Warm-reuse entry point: point this polisher at a new input
+        triple (parsers rebuilt, per-run state reset) while keeping the
+        warm process-level state — jit caches, adaptive posture, compile
+        cache, metrics registry. The serve layer uses this shape of
+        reuse; the next initialize() parses the new inputs."""
+        if self.windows:
+            raise RaconError("Polisher.rebind",
+                             "cannot rebind mid-run (windows pending)!")
+        self.sparser = create_sequence_parser(sequences_path,
+                                              "Polisher.rebind")
+        self.oparser = create_overlap_parser(overlaps_path,
+                                             "Polisher.rebind")
+        self.tparser = create_sequence_parser(target_path,
+                                              "Polisher.rebind")
+        self._reset_run_state()
+        return self
+
     # ------------------------------------------------------------------ init
     def initialize(self) -> None:
         if self.windows:
             log_info("[racon_tpu::Polisher.initialize] warning: "
                      "object already initialized!")
             return
+        if self._runs_completed:
+            # warm reuse: this is run N+1 in the same process — counters
+            # describe one run each (see _reset_run_state)
+            self._reset_run_state()
 
         # a new run starts with clean dedup state: a previous in-process
         # run that crashed before its flush must not leave keys behind
@@ -557,7 +623,8 @@ class Polisher:
         self.logger.log("[racon_tpu::Polisher.initialize] aligned overlaps")
 
     # ---------------------------------------------------------------- polish
-    def polish(self, drop_unpolished_sequences: bool = True) -> list[Sequence]:
+    def polish(self, drop_unpolished_sequences: bool = True,
+               batcher=None) -> list[Sequence]:
         """Per-window consensus + stitch (reference polisher.cpp:486-548).
 
         Set RACON_TPU_PROFILE=<dir> (CLI: --tpu-jax-profile) to capture a
@@ -565,7 +632,42 @@ class Polisher:
         reference's nvprof `-lineinfo` support, CMakeLists.txt:26) — a
         no-op when the backend cannot profile; per-phase windows/sec is
         reported on stderr either way.
+
+        `batcher` (serve mode) replaces the in-process consensus pass:
+        this job's windows are handed to the shared cross-job window
+        batcher (serve/batcher.py), which funnels them into device
+        batches alongside concurrent jobs' windows and returns once this
+        job's windows all carry their consensus. Per-window results are
+        independent of batch composition, so the stitched FASTA stays
+        byte-identical to a solo run (test-pinned).
         """
+        import time as _time
+
+        if batcher is not None:
+            batcher.consensus(self)
+        else:
+            self._consensus_pass()
+
+        t_stitch = _time.perf_counter()
+        dst = self._stitch(drop_unpolished_sequences)
+        tr = trace.get_tracer()
+        if tr is not None:
+            tr.complete("polisher.stitch", t_stitch, _time.perf_counter(),
+                        {"sequences": len(dst)})
+        self.logger.log("[racon_tpu::Polisher.polish] generated consensus")
+        # cumulative wall-clock, mirroring ~Polisher (polisher.cpp:189)
+        self.logger.total("[racon_tpu::Polisher.] total =")
+        self.windows = []
+        self.sequences = []
+        self._runs_completed += 1
+        self.emit_observability()
+        return dst
+
+    def _consensus_pass(self) -> None:
+        """Run the consensus engine over this run's windows (every
+        window ends up carrying `consensus`/`polished`) and emit the
+        per-phase reports. polish() calls this for the one-shot path;
+        serve mode substitutes the cross-job batcher."""
         import contextlib
         import time as _time
 
@@ -626,10 +728,12 @@ class Polisher:
                      f"(adaptive={'on' if self.scheduler.adaptive else 'off'})"
                      f": {occ}")
 
+    def _stitch(self, drop_unpolished_sequences: bool) -> list[Sequence]:
+        """Stitch per-window consensus back into whole sequences with
+        the reference's LN/RC/XC tagging (polisher.cpp:506-545)."""
         dst: list[Sequence] = []
         polished_data = bytearray()
         num_polished_windows = 0
-        t_stitch = _time.perf_counter()
 
         for i, window in enumerate(self.windows):
             num_polished_windows += 1 if window.polished else 0
@@ -650,15 +754,6 @@ class Polisher:
                 num_polished_windows = 0
                 polished_data = bytearray()
 
-        if tr is not None:
-            tr.complete("polisher.stitch", t_stitch, _time.perf_counter(),
-                        {"sequences": len(dst)})
-        self.logger.log("[racon_tpu::Polisher.polish] generated consensus")
-        # cumulative wall-clock, mirroring ~Polisher (polisher.cpp:189)
-        self.logger.total("[racon_tpu::Polisher.] total =")
-        self.windows = []
-        self.sequences = []
-        self.emit_observability()
         return dst
 
     def emit_observability(self) -> None:

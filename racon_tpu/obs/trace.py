@@ -195,6 +195,34 @@ def reset() -> None:
     _resolved = False
 
 
+class scoped:
+    """Context manager arming a fresh in-memory recorder and restoring
+    the previous tracer state (armed or unresolved) on exit — the serve
+    layer's per-job trace scoping. The recorder is process-global for
+    the duration, so spans from concurrent jobs sharing the process land
+    in it too (one process, shared device: documented, not hidden).
+
+    Scopes SERIALIZE on a module lock: the save/restore of the global
+    tracer is not reentrant (overlapping scopes restoring out of order
+    would leave the process tracer pointing at a dead per-job recorder),
+    so a second traced job waits for the first to finish."""
+
+    _lock = threading.Lock()
+
+    def __enter__(self) -> TraceRecorder:
+        global _tracer, _resolved
+        self._lock.acquire()
+        self._prev = (_tracer, _resolved)
+        rec = TraceRecorder(None)
+        _tracer, _resolved = rec, True
+        return rec
+
+    def __exit__(self, *exc_info) -> None:
+        global _tracer, _resolved
+        _tracer, _resolved = self._prev
+        self._lock.release()
+
+
 def save(path: str | None = None) -> str | None:
     """Write the armed tracer's events to its configured path (or
     `path`); None when tracing is off or has nowhere to write — callers

@@ -37,19 +37,51 @@ cost-identical to the pre-resilience code.
 
 from __future__ import annotations
 
+import contextlib
 import os
+import threading
 
 from .faults import FaultPlan, get_fault_plan, reset_fault_plan
 from .watchdog import Watchdog
 
 __all__ = ["FaultPlan", "Watchdog", "get_fault_plan", "reset_fault_plan",
-           "strict_mode", "degradation_summary"]
+           "strict_mode", "strict_scope", "degradation_summary"]
+
+#: per-thread strictness override (serve mode: one job's strict posture
+#: must not leak into concurrent jobs sharing the process, so the env
+#: knob alone cannot carry it)
+_strict_local = threading.local()
 
 
 def strict_mode() -> bool:
     """True when device failures must re-raise instead of degrading
-    (RACON_TPU_STRICT env, mirrored by the --tpu-strict CLI flag)."""
+    (RACON_TPU_STRICT env, mirrored by the --tpu-strict CLI flag). A
+    `strict_scope` override on the calling thread wins over the env —
+    the serve layer's per-job posture. Every strict decision is made on
+    the thread driving the failing phase (the polisher's catch sites and
+    the engines' on_error selection), so a thread-local is sufficient."""
+    override = getattr(_strict_local, "value", None)
+    if override is not None:
+        return override
     return bool(os.environ.get("RACON_TPU_STRICT"))
+
+
+@contextlib.contextmanager
+def strict_scope(value: bool | None):
+    """Pin `strict_mode()` to `value` for the calling thread (None =
+    no-op, defer to the environment). The serve worker wraps each job in
+    this so a `strict: true` request degrades nothing — its failures
+    surface as one typed error response — while concurrent jobs keep
+    the default posture."""
+    if value is None:
+        yield
+        return
+    prev = getattr(_strict_local, "value", None)
+    _strict_local.value = bool(value)
+    try:
+        yield
+    finally:
+        _strict_local.value = prev
 
 
 #: stage_stats keys owned by the resilience layer (PipelineStats carries

@@ -1,0 +1,31 @@
+"""Warm polishing service: a persistent job server over the polisher.
+
+The one-shot CLI pays engine construction, XLA compilation and ladder
+warmup per run; this subsystem amortizes those across many requests —
+the request-queue + continuous-batching shape of modern inference
+servers, built from the repo's existing layers:
+
+    protocol.py   length-prefixed JSON frames (unix socket / localhost
+                  TCP), typed error responses
+    queue.py      bounded JobQueue: admission control with retry-after,
+                  FIFO-within-priority, per-job deadlines
+    batcher.py    cross-job window batching through the sched ladders
+                  (per-job output byte-identical to a solo run)
+    server.py     PolishServer: warm engine set, worker pool, graceful
+                  SIGTERM drain, per-job failure isolation + obs scoping
+    client.py     PolishClient / `racon_tpu submit`
+
+CLI: `python -m racon_tpu.cli serve ...` / `... submit ...`;
+benchmarks: tools/servebench.py; failure matrix: tools/faultcheck.py
+(serve column). See README "Serving".
+"""
+
+from .batcher import WindowBatcher
+from .client import (JobFailed, PolishClient, PolishResult, QueueFull,
+                     ServeError, ServerDraining)
+from .queue import Job, JobQueue
+from .server import PolishServer, ServeConfig, make_synth_dataset
+
+__all__ = ["WindowBatcher", "PolishClient", "PolishResult", "PolishServer",
+           "ServeConfig", "Job", "JobQueue", "ServeError", "QueueFull",
+           "ServerDraining", "JobFailed", "make_synth_dataset"]

@@ -1,0 +1,226 @@
+"""Cross-job window batching: one warm engine pass over many jobs.
+
+A window's consensus depends only on the window itself (backbone +
+layers) and the engine parameters — never on which other windows share
+its device batch. The scheduler's sorted packing already exploits this
+within one run (results restore by index, byte-identical, PR-3 pinned);
+`WindowBatcher` extends the same invariant ACROSS jobs: windows from
+concurrent polish requests are concatenated into one engine pass, so one
+job's stragglers fill the padding lanes another job's batch would have
+burned, and each job's windows come back carrying their consensus exactly
+as a solo run would have produced (test-pinned in tests/test_serve.py).
+
+Mechanics — the leader/joiner gather pattern:
+
+  - a job thread calling `consensus(polisher)` files a ticket under the
+    job's engine-parameter key (jobs with different scores / window
+    length / engine must not share a pass);
+  - the first ticket for a key becomes the LEADER: it waits up to
+    `gather_window_s` (or until `min_gather` tickets joined), takes the
+    whole group, and runs ONE `BatchPOA.generate_consensus` over the
+    concatenated windows;
+  - joiners block on their ticket; results demultiplex for free because
+    every window object belongs to exactly one job's polisher.
+
+Engine passes are serialized on one executor lock — the device is a
+single shared resource, and serialization makes the per-round compile
+telemetry (the "warm submit = 0 compiles" acceptance signal) exact.
+
+Isolation: a job carrying its own fault plan or a strict posture never
+shares a batch — it runs its polisher's own `_consensus_pass()` (own
+pipeline, own injected faults), so an injected `DeviceError` storm fails
+exactly one job while the batcher, the warm engines and every concurrent
+job continue untouched.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+
+from ..obs import trace
+
+
+class _Ticket:
+    __slots__ = ("polisher", "event", "error", "round_info")
+
+    def __init__(self, polisher):
+        self.polisher = polisher
+        self.event = threading.Event()
+        self.error: BaseException | None = None
+        self.round_info: dict | None = None
+
+
+def _engine_key(p) -> tuple:
+    """Engine-parameter identity: jobs share a pass only when every
+    knob that can influence a window's consensus bytes matches."""
+    return (p.match, p.mismatch, p.gap, p.window_length, p.trim,
+            p.num_threads, p.tpu_poa_batches, p.tpu_banded_alignment,
+            p.tpu_aligner_band_width, p.tpu_engine,
+            p.tpu_pipeline_depth, p.tpu_device_timeout)
+
+
+class WindowBatcher:
+    def __init__(self, gather_window_s: float = 0.05, min_gather: int = 2,
+                 scheduler=None):
+        from ..pipeline import PipelineStats
+        from ..sched import BatchScheduler
+
+        self.gather_window_s = max(0.0, float(gather_window_s))
+        self.min_gather = max(1, int(min_gather))
+        #: one scheduler + stage-stat sink for every shared round: the
+        #: server-lifetime occupancy/compile telemetry servebench reads
+        self.scheduler = (scheduler if scheduler is not None
+                          else BatchScheduler.from_env())
+        self.pipeline_stats = PipelineStats()
+        self._cond = threading.Condition()
+        self._pending: dict[tuple, list[_Ticket]] = {}
+        self._leading: set[tuple] = set()
+        #: optional callable -> number of jobs currently executing
+        #: (the server wires its in-flight count): a leader whose ticket
+        #: group already holds every executing job skips the gather wait
+        #: — a lone job must not idle out the window for company that
+        #: cannot arrive
+        self.active_hint = None
+        self._exec_lock = threading.Lock()
+        self._round_seq = itertools.count()
+        self.counters = {"rounds": 0, "solo_rounds": 0,
+                         "multi_job_rounds": 0, "jobs": 0, "windows": 0,
+                         "max_jobs_in_round": 0}
+
+    # ------------------------------------------------------------ entry
+    def consensus(self, polisher) -> None:
+        """Run the consensus pass for `polisher.windows`, possibly merged
+        with concurrent jobs' windows (see module docstring). On return
+        every window carries consensus/polished; round telemetry is left
+        on `polisher.serve_round` for the server's response."""
+        from ..resilience import strict_mode
+
+        if polisher.faults is not None or strict_mode():
+            # isolation round: injected faults / strict posture stay on
+            # this job's own pipeline and never touch a shared batch
+            rnd = next(self._round_seq)
+            with self._exec_lock:
+                polisher._consensus_pass()
+            self._account(1, len(polisher.windows), solo=True)
+            polisher.serve_round = {"round": rnd, "jobs": 1,
+                                    "windows": len(polisher.windows),
+                                    "solo": True}
+            return
+
+        key = _engine_key(polisher)
+        ticket = _Ticket(polisher)
+        with self._cond:
+            self._pending.setdefault(key, []).append(ticket)
+            leader = key not in self._leading
+            if leader:
+                self._leading.add(key)
+            self._cond.notify_all()
+        if not leader:
+            ticket.event.wait()
+        else:
+            deadline = time.monotonic() + self.gather_window_s
+            hint = self.active_hint
+            with self._cond:
+                while len(self._pending[key]) < self.min_gather:
+                    if (hint is not None
+                            and hint() <= len(self._pending[key])):
+                        break
+                    left = deadline - time.monotonic()
+                    if left <= 0:
+                        break
+                    self._cond.wait(left)
+                batch = self._pending.pop(key)
+                # release the key BEFORE executing: tickets arriving
+                # mid-round start gathering the next round immediately
+                self._leading.discard(key)
+            self._execute(batch)
+        if ticket.error is not None:
+            raise ticket.error
+        polisher.serve_round = ticket.round_info
+
+    # -------------------------------------------------------- execution
+    def _compile_totals(self) -> tuple[int, float]:
+        snap = self.scheduler.stats.snapshot()
+        return (sum(e.get("compiles", 0) for e in snap.values()),
+                sum(e.get("compile_s", 0.0) for e in snap.values()))
+
+    def _execute(self, tickets: list[_Ticket]) -> None:
+        from ..ops.poa import BatchPOA
+        from ..pipeline import DispatchPipeline
+        from ..resilience import Watchdog
+
+        p0 = tickets[0].polisher
+        windows = []
+        for t in tickets:
+            windows.extend(t.polisher.windows)
+        rnd = next(self._round_seq)
+        try:
+            with self._exec_lock:
+                pre_c, pre_s = self._compile_totals()
+                pipeline = DispatchPipeline(
+                    depth=p0.tpu_pipeline_depth,
+                    stats=self.pipeline_stats,
+                    fallback_workers=max(1, min(4, p0.num_threads)),
+                    watchdog=Watchdog.from_env(
+                        timeout=p0.tpu_device_timeout or None))
+                engine = BatchPOA(p0.match, p0.mismatch, p0.gap,
+                                  p0.window_length,
+                                  num_threads=p0.num_threads,
+                                  device_batches=p0.tpu_poa_batches,
+                                  banded=p0.tpu_banded_alignment,
+                                  band_width=p0.tpu_aligner_band_width,
+                                  logger=None, engine=p0.tpu_engine,
+                                  pipeline=pipeline,
+                                  scheduler=self.scheduler)
+                t0 = time.perf_counter()
+                with pipeline:
+                    engine.generate_consensus(windows, p0.trim)
+                t1 = time.perf_counter()
+                post_c, post_s = self._compile_totals()
+            tr = trace.get_tracer()
+            if tr is not None:
+                tr.complete("serve.batch_round", t0, t1,
+                            {"round": rnd, "jobs": len(tickets),
+                             "windows": len(windows)})
+        except BaseException as exc:
+            # a shared-round failure fails every participant the same
+            # way a solo run would have (strict-off degradation happens
+            # INSIDE generate_consensus; reaching here means even the
+            # degraded path gave up) — the batcher itself stays alive
+            for t in tickets:
+                t.error = exc
+                t.event.set()
+            return
+        info = {"round": rnd, "jobs": len(tickets),
+                "windows": len(windows), "solo": False,
+                "compiles": post_c - pre_c,
+                "compile_s": round(post_s - pre_s, 3),
+                "round_s": round(t1 - t0, 4)}
+        self._account(len(tickets), len(windows), solo=False)
+        for t in tickets:
+            t.round_info = dict(info, job_windows=len(t.polisher.windows))
+            t.event.set()
+
+    def _account(self, jobs: int, windows: int, solo: bool) -> None:
+        with self._cond:
+            self.counters["rounds"] += 1
+            self.counters["jobs"] += jobs
+            self.counters["windows"] += windows
+            if solo:
+                self.counters["solo_rounds"] += 1
+            if jobs > 1:
+                self.counters["multi_job_rounds"] += 1
+            self.counters["max_jobs_in_round"] = max(
+                self.counters["max_jobs_in_round"], jobs)
+
+    def snapshot(self) -> dict:
+        with self._cond:
+            out = dict(self.counters)
+        compiles, compile_s = self._compile_totals()
+        out["compiles"] = compiles
+        out["compile_s"] = round(compile_s, 3)
+        out["occupancy"] = self.scheduler.stats.snapshot()
+        out["pipeline"] = self.pipeline_stats.snapshot()
+        return out

@@ -1,0 +1,230 @@
+"""PolishClient: Python + CLI client for the warm polishing service.
+
+One request = one connection (the server multiplexes concurrency across
+connections, so a client that wants N jobs in flight opens N sockets —
+exactly what `tools/servebench.py` does from a thread pool). Errors come
+back as the protocol's typed error responses and are re-raised as the
+exception taxonomy below, so callers branch on types, not message
+strings:
+
+    QueueFull       admission control rejected; `retry_after` seconds
+    ServerDraining  server is shutting down, resubmit elsewhere
+    JobFailed       the job ran and failed; `error_type` names the
+                    errors.py class (DeviceError, DeviceTimeout, ...)
+    ServeError      anything else typed (bad-request, bad-frame, ...)
+
+`racon_tpu submit ...` (cli.py) is the CLI face: same three positional
+inputs as the one-shot CLI, polished FASTA on stdout — byte-identical
+to the one-shot run, just served warm.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import sys
+import time
+
+from .protocol import WIRE_LIMIT, recv_frame, send_frame
+from .server import DEFAULT_SOCKET
+
+
+class ServeError(Exception):
+    """Typed error response from the server."""
+
+    def __init__(self, code: str, message: str, response: dict):
+        super().__init__(f"[{code}] {message}")
+        self.code = code
+        self.response = response
+
+
+class QueueFull(ServeError):
+    def __init__(self, code, message, response):
+        super().__init__(code, message, response)
+        self.retry_after = float(response.get("retry_after", 1.0))
+
+
+class ServerDraining(ServeError):
+    pass
+
+
+class JobFailed(ServeError):
+    def __init__(self, code, message, response):
+        super().__init__(code, message, response)
+        self.error_type = response.get("error_type", "RaconError")
+
+
+_ERROR_TYPES = {"queue-full": QueueFull, "draining": ServerDraining,
+                "job-failed": JobFailed}
+
+
+class PolishResult:
+    __slots__ = ("job_id", "fasta", "metrics", "serve", "trace")
+
+    def __init__(self, resp: dict):
+        self.job_id = resp.get("job_id")
+        self.fasta = resp.get("fasta", "").encode("latin-1")
+        self.metrics = resp.get("metrics") or {}
+        self.serve = resp.get("serve") or {}
+        self.trace = resp.get("trace")
+
+
+class PolishClient:
+    def __init__(self, socket_path: str | None = None,
+                 port: int | None = None, timeout: float | None = None):
+        self.socket_path = (socket_path
+                            or os.environ.get("RACON_TPU_SERVE_SOCKET")
+                            or DEFAULT_SOCKET)
+        self.port = port
+        self.timeout = timeout
+
+    def _connect(self) -> socket.socket:
+        if self.port:
+            sock = socket.create_connection(("127.0.0.1", self.port),
+                                            timeout=self.timeout)
+        else:
+            sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            sock.settimeout(self.timeout)
+            sock.connect(self.socket_path)
+        return sock
+
+    def request(self, obj: dict) -> dict:
+        """One round trip; raises the ServeError taxonomy on a typed
+        error response."""
+        sock = self._connect()
+        try:
+            send_frame(sock, obj)
+            # results come from a trusted server: accept up to the wire
+            # limit, not the server's anti-abuse request ceiling — a
+            # multi-hundred-MiB polished assembly must come back whole
+            resp = recv_frame(sock, max_frame=WIRE_LIMIT)
+        finally:
+            sock.close()
+        if resp is None:
+            raise ServeError("closed", "server closed the connection",
+                             {})
+        if resp.get("type") == "error":
+            code = resp.get("code", "error")
+            raise _ERROR_TYPES.get(code, ServeError)(
+                code, resp.get("message", ""), resp)
+        return resp
+
+    # ------------------------------------------------------------ calls
+    def submit(self, sequences: str, overlaps: str, target: str, *,
+               options: dict | None = None, priority: int = 0,
+               deadline_s: float | None = None,
+               fault_plan: str | None = None, strict: bool | None = None,
+               trace: bool = False, retries: int = 0) -> PolishResult:
+        """Polish one input triple on the server. Paths are resolved to
+        absolute before they cross the wire (the server's cwd is not the
+        client's). `retries` re-submits after `retry_after` on full-queue
+        rejects — simple client-side backoff."""
+        req = {"type": "submit",
+               "sequences": os.path.abspath(sequences),
+               "overlaps": os.path.abspath(overlaps),
+               "target": os.path.abspath(target)}
+        if options:
+            req["options"] = options
+        if priority:
+            req["priority"] = int(priority)
+        if deadline_s is not None:
+            req["deadline_s"] = float(deadline_s)
+        if fault_plan:
+            req["fault_plan"] = fault_plan
+        if strict is not None:
+            req["strict"] = bool(strict)
+        if trace:
+            req["trace"] = True
+        attempt = 0
+        while True:
+            try:
+                return PolishResult(self.request(req))
+            except QueueFull as exc:
+                if attempt >= retries:
+                    raise
+                attempt += 1
+                time.sleep(exc.retry_after)
+
+    def ping(self) -> dict:
+        return self.request({"type": "ping"})
+
+    def stats(self) -> dict:
+        return self.request({"type": "stats"})
+
+    def shutdown(self) -> dict:
+        return self.request({"type": "shutdown"})
+
+
+# ------------------------------------------------------------------ CLI
+def submit_main(argv: list[str]) -> int:
+    """`racon_tpu submit` entry point: send one job to a running server,
+    polished FASTA on stdout (byte-identical to the one-shot CLI)."""
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="racon_tpu submit",
+        description="submit a polishing job to a running "
+                    "`racon_tpu serve` instance")
+    ap.add_argument("sequences")
+    ap.add_argument("overlaps")
+    ap.add_argument("target")
+    ap.add_argument("--socket", default=None)
+    ap.add_argument("--port", type=int, default=None)
+    ap.add_argument("--timeout", type=float, default=None,
+                    help="socket timeout in seconds (default: none)")
+    ap.add_argument("--priority", type=int, default=0)
+    ap.add_argument("--deadline", type=float, default=None,
+                    help="give up if not STARTED within this many seconds")
+    ap.add_argument("--retries", type=int, default=0,
+                    help="re-submit after retry_after on queue-full")
+    ap.add_argument("-u", "--include-unpolished", action="store_true")
+    ap.add_argument("-f", "--fragment-correction", action="store_true")
+    ap.add_argument("-w", "--window-length", type=int, default=None)
+    ap.add_argument("-q", "--quality-threshold", type=float, default=None)
+    ap.add_argument("-e", "--error-threshold", type=float, default=None)
+    ap.add_argument("--no-trimming", action="store_true")
+    ap.add_argument("-m", "--match", type=int, default=None)
+    ap.add_argument("-x", "--mismatch", type=int, default=None)
+    ap.add_argument("-g", "--gap", type=int, default=None)
+    ap.add_argument("-c", "--tpupoa-batches", type=int, default=None)
+    ap.add_argument("--tpualigner-batches", type=int, default=None)
+    ap.add_argument("--tpu-engine", choices=("session", "fused"),
+                    default=None)
+    args = ap.parse_args(argv)
+
+    options: dict = {}
+    for key, val in (("include_unpolished", args.include_unpolished
+                      or None),
+                     ("fragment_correction", args.fragment_correction
+                      or None),
+                     ("window_length", args.window_length),
+                     ("quality_threshold", args.quality_threshold),
+                     ("error_threshold", args.error_threshold),
+                     ("trim", False if args.no_trimming else None),
+                     ("match", args.match),
+                     ("mismatch", args.mismatch),
+                     ("gap", args.gap),
+                     ("tpu_poa_batches", args.tpupoa_batches),
+                     ("tpu_aligner_batches", args.tpualigner_batches),
+                     ("tpu_engine", args.tpu_engine)):
+        if val is not None:
+            options[key] = val
+
+    client = PolishClient(socket_path=args.socket, port=args.port,
+                          timeout=args.timeout)
+    try:
+        result = client.submit(args.sequences, args.overlaps, args.target,
+                               options=options, priority=args.priority,
+                               deadline_s=args.deadline,
+                               retries=args.retries)
+    except (ServeError, OSError) as exc:
+        print(f"[racon_tpu::serve] error: {exc}", file=sys.stderr)
+        return 1
+    sys.stdout.buffer.write(result.fasta)
+    sys.stdout.buffer.flush()
+    serve = result.serve
+    if serve:
+        print(f"[racon_tpu::serve] job {result.job_id}: queue wait "
+              f"{serve.get('queue_wait_s', 0):.3f}s, exec "
+              f"{serve.get('exec_s', 0):.3f}s", file=sys.stderr)
+    return 0

@@ -1,0 +1,160 @@
+"""Length-prefixed JSON frame protocol for the warm polishing service.
+
+One frame = an 8-byte header (4-byte magic ``RTPU`` + 4-byte big-endian
+payload length) followed by a UTF-8 JSON object payload. JSON keeps the
+wire format debuggable (``socat`` + a hexdump is a full protocol
+analyzer) and dependency-free; the length prefix makes framing O(1) and
+lets the server bound memory BEFORE reading a payload. Polished FASTA
+rides inside the JSON as a latin-1 string — lossless for arbitrary
+bytes, so byte-identity survives the wire.
+
+Malformed-input discipline (the server must outlive every bad client):
+
+  - payload longer than ``max_frame``   -> the declared bytes are read
+    and DISCARDED in bounded chunks (the stream stays in sync), then
+    `FrameTooLarge`; the server answers with a typed error response and
+    the connection remains usable.
+  - payload that is not valid JSON (or not a JSON object) ->
+    `FrameGarbage`; stream is still framed, connection remains usable.
+  - bad magic -> `FrameGarbage` with ``resync=False``: the stream can
+    no longer be trusted byte-for-byte, so the server answers the typed
+    error and then closes THAT connection (the server itself is
+    untouched).
+  - EOF mid-frame -> `FrameTruncated`; the peer is gone, nothing can be
+    answered — the handler cleans up the connection quietly.
+
+Request types: ``submit`` / ``ping`` / ``stats`` / ``shutdown``.
+Response types: ``result`` / ``pong`` / ``stats`` / ``ok`` / ``error``
+(with a machine-readable ``code``; ``queue-full`` errors carry
+``retry_after`` seconds, ``job-failed`` errors carry ``error_type`` from
+the errors.py taxonomy).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import struct
+
+MAGIC = b"RTPU"
+_HEADER = struct.Struct(">4sI")
+
+#: discard granularity while draining an oversized payload
+_DRAIN_CHUNK = 1 << 16
+
+
+def max_frame_bytes() -> int:
+    """The SERVER's receive ceiling (RACON_TPU_SERVE_MAX_FRAME, default
+    256 MiB) — it bounds what an untrusted client can make the server
+    buffer. Clients reading RESULTS from a trusted server use the wire
+    limit instead (PolishClient passes `WIRE_LIMIT`), so a polished
+    assembly bigger than the server's request ceiling still comes back."""
+    try:
+        return int(os.environ.get("RACON_TPU_SERVE_MAX_FRAME", 0)) or \
+            (256 << 20)
+    except ValueError:
+        return 256 << 20
+
+
+class ProtocolError(Exception):
+    """Base for frame-level failures; `code` is the wire error code."""
+
+    code = "bad-frame"
+    #: whether the stream is still framed after this error (the server
+    #: may answer and keep the connection)
+    resync = True
+
+    def __init__(self, message: str, resync: bool | None = None):
+        super().__init__(message)
+        if resync is not None:
+            self.resync = resync
+
+
+class FrameTooLarge(ProtocolError):
+    code = "frame-too-large"
+
+
+class FrameGarbage(ProtocolError):
+    code = "bad-frame"
+
+
+class FrameTruncated(ProtocolError):
+    code = "bad-frame"
+    resync = False
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    """Read exactly `n` bytes; b"" on clean EOF at offset 0,
+    FrameTruncated on EOF mid-read."""
+    chunks = []
+    got = 0
+    while got < n:
+        chunk = sock.recv(min(n - got, _DRAIN_CHUNK))
+        if not chunk:
+            if got == 0:
+                return b""
+            raise FrameTruncated(
+                f"connection closed mid-frame ({got}/{n} bytes)")
+        chunks.append(chunk)
+        got += len(chunk)
+    return b"".join(chunks)
+
+
+#: hard wire limit: the length prefix is a u32
+WIRE_LIMIT = 0xFFFFFFFF
+
+
+def send_frame(sock: socket.socket, obj: dict) -> None:
+    payload = json.dumps(obj, separators=(",", ":")).encode()
+    if len(payload) > WIRE_LIMIT:
+        # the u32 length prefix cannot carry it; raise typed (the
+        # server handler answers with an error frame) instead of
+        # letting struct.error escape mid-send
+        raise FrameTooLarge(
+            f"frame of {len(payload)} bytes exceeds the 4 GiB wire "
+            "limit")
+    sock.sendall(_HEADER.pack(MAGIC, len(payload)) + payload)
+
+
+def recv_frame(sock: socket.socket,
+               max_frame: int | None = None) -> dict | None:
+    """Read one frame; None on clean EOF (peer closed between frames).
+    Raises the ProtocolError taxonomy above on malformed input."""
+    limit = max_frame if max_frame is not None else max_frame_bytes()
+    header = _recv_exact(sock, _HEADER.size)
+    if not header:
+        return None
+    magic, length = _HEADER.unpack(header)
+    if magic != MAGIC:
+        raise FrameGarbage(
+            f"bad frame magic {magic!r} (stream desynced)", resync=False)
+    if length > limit:
+        # the client DID send these bytes: drain them so the stream
+        # stays framed, then report — the connection survives
+        left = length
+        while left > 0:
+            chunk = sock.recv(min(left, _DRAIN_CHUNK))
+            if not chunk:
+                raise FrameTruncated(
+                    "connection closed draining oversized frame")
+            left -= len(chunk)
+        raise FrameTooLarge(
+            f"frame of {length} bytes exceeds limit {limit}")
+    payload = _recv_exact(sock, length)
+    if length and not payload:
+        raise FrameTruncated("connection closed before frame payload")
+    try:
+        obj = json.loads(payload.decode("utf-8"))
+    except (UnicodeDecodeError, json.JSONDecodeError) as exc:
+        raise FrameGarbage(f"frame payload is not JSON ({exc})") from None
+    if not isinstance(obj, dict):
+        raise FrameGarbage(
+            f"frame payload is {type(obj).__name__}, expected object")
+    return obj
+
+
+def error_response(code: str, message: str, **extra) -> dict:
+    out = {"type": "error", "code": code, "message": message}
+    out.update(extra)
+    return out

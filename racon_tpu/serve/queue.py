@@ -1,0 +1,194 @@
+"""Bounded job queue with admission control for the polishing service.
+
+The admission surface is where a warm server defends itself: a queue
+that grows without bound converts overload into unbounded latency for
+EVERYONE (and eventually an OOM), so `JobQueue` is bounded and a submit
+against a full queue is REJECTED immediately with a `retry_after` hint —
+the client backs off instead of camping on a socket. The hint is derived
+from observed service time (EMA) times the work ahead of the would-be
+job, so it tracks the actual drain rate rather than a constant.
+
+Ordering is FIFO within priority: higher `priority` pops first, equal
+priorities pop in submission order (a monotonic sequence number breaks
+heap ties, so starvation within a priority class is impossible).
+
+Per-job deadlines are enforced at POP time: a job whose deadline passed
+while queued is never handed to a worker — it is marked expired, its
+waiter is woken with a typed error, and the `expired` counter bumps.
+(Jobs already executing are not preempted; one process, shared device.)
+
+Draining (`drain()`) flips admission off atomically: every later submit
+raises `Draining`, while already-admitted jobs keep flowing to workers —
+the SIGTERM half of graceful shutdown.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import threading
+import time
+
+
+class AdmissionError(Exception):
+    """Base: the queue refused the job at the door."""
+
+
+class QueueFull(AdmissionError):
+    def __init__(self, retry_after: float):
+        super().__init__(
+            f"job queue full; retry in {retry_after:.2f}s")
+        self.retry_after = retry_after
+
+
+class Draining(AdmissionError):
+    def __init__(self):
+        super().__init__("server is draining; not admitting jobs")
+
+
+class DeadlineExpired(Exception):
+    def __init__(self, waited: float):
+        super().__init__(
+            f"job deadline expired after {waited:.2f}s in queue")
+        self.waited = waited
+
+
+class Job:
+    """One polish request in flight. The handler thread that admitted it
+    blocks on `event`; the worker that executes it fills `response` (a
+    protocol response dict) before setting the event."""
+
+    __slots__ = ("id", "sequences", "overlaps", "target", "options",
+                 "priority", "deadline", "fault_plan", "strict",
+                 "want_trace", "enqueued_t", "started_t", "response",
+                 "event")
+
+    def __init__(self, id_: str, sequences: str, overlaps: str,
+                 target: str, options: dict, priority: int = 0,
+                 deadline_s: float | None = None,
+                 fault_plan: str | None = None,
+                 strict: bool | None = None, want_trace: bool = False):
+        self.id = id_
+        self.sequences = sequences
+        self.overlaps = overlaps
+        self.target = target
+        self.options = options
+        self.priority = int(priority)
+        self.enqueued_t = time.perf_counter()
+        self.deadline = (self.enqueued_t + float(deadline_s)
+                         if deadline_s else None)
+        self.fault_plan = fault_plan
+        self.strict = strict
+        self.want_trace = bool(want_trace)
+        self.started_t: float | None = None
+        self.response: dict | None = None
+        self.event = threading.Event()
+
+    @property
+    def queue_wait_s(self) -> float:
+        return (self.started_t or time.perf_counter()) - self.enqueued_t
+
+
+class JobQueue:
+    """Thread-safe bounded priority queue (see module docstring)."""
+
+    #: retry_after clamp (seconds)
+    RETRY_MIN, RETRY_MAX = 0.05, 60.0
+
+    def __init__(self, maxsize: int, workers: int = 1):
+        self.maxsize = max(1, int(maxsize))
+        self.workers = max(1, int(workers))
+        self._lock = threading.Lock()
+        self._not_empty = threading.Condition(self._lock)
+        self._heap: list = []
+        self._seq = itertools.count()
+        self._draining = False
+        #: EMA of job service seconds, seeded pessimistically so the
+        #: first rejections before any completion still back off
+        self._ema_service_s = 1.0
+        self.counters = {"submitted": 0, "admitted": 0, "rejected_full": 0,
+                         "rejected_draining": 0, "expired": 0,
+                         "completed": 0, "failed": 0}
+
+    # -------------------------------------------------------- admission
+    def _retry_after_locked(self) -> float:
+        """Backoff for a rejected submit (caller holds the lock):
+        estimated time until a slot frees = work ahead / drain rate,
+        from the service-time EMA."""
+        est = (self._ema_service_s * max(1, len(self._heap))
+               / self.workers)
+        return min(max(est, self.RETRY_MIN), self.RETRY_MAX)
+
+    def submit(self, job: Job) -> None:
+        with self._lock:
+            self.counters["submitted"] += 1
+            if self._draining:
+                self.counters["rejected_draining"] += 1
+                raise Draining()
+            if len(self._heap) >= self.maxsize:
+                self.counters["rejected_full"] += 1
+                raise QueueFull(self._retry_after_locked())
+            self.counters["admitted"] += 1
+            heapq.heappush(self._heap,
+                           (-job.priority, next(self._seq), job))
+            self._not_empty.notify()
+
+    # ------------------------------------------------------------- pop
+    def pop(self, timeout: float | None = None) -> Job | None:
+        """Next runnable job, or None on timeout. Deadline-expired jobs
+        are consumed here: their waiters get a typed error and workers
+        never see them."""
+        deadline = (time.monotonic() + timeout
+                    if timeout is not None else None)
+        with self._not_empty:
+            while True:
+                while self._heap:
+                    _, _, job = heapq.heappop(self._heap)
+                    now = time.perf_counter()
+                    if job.deadline is not None and now > job.deadline:
+                        self.counters["expired"] += 1
+                        exc = DeadlineExpired(now - job.enqueued_t)
+                        job.response = {
+                            "type": "error", "code": "deadline-expired",
+                            "message": str(exc), "job_id": job.id}
+                        job.event.set()
+                        continue
+                    job.started_t = now
+                    return job
+                if deadline is not None:
+                    left = deadline - time.monotonic()
+                    if left <= 0 or not self._not_empty.wait(left):
+                        if not self._heap:
+                            return None
+                else:
+                    self._not_empty.wait()
+
+    def task_done(self, job: Job, ok: bool, service_s: float) -> None:
+        with self._lock:
+            self.counters["completed" if ok else "failed"] += 1
+            # EMA over the last ~8 jobs: adapts to workload shifts
+            # without a rejection spike swinging the hint wildly
+            self._ema_service_s += (service_s - self._ema_service_s) / 8.0
+
+    # ----------------------------------------------------------- drain
+    def drain(self) -> None:
+        """Stop admitting; queued jobs keep flowing to workers."""
+        with self._lock:
+            self._draining = True
+            self._not_empty.notify_all()
+
+    @property
+    def draining(self) -> bool:
+        with self._lock:
+            return self._draining
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._heap)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return dict(self.counters, depth=len(self._heap),
+                        maxsize=self.maxsize,
+                        draining=self._draining,
+                        ema_service_s=round(self._ema_service_s, 4))

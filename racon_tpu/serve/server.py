@@ -1,0 +1,691 @@
+"""PolishServer: a long-lived, warm polishing job server.
+
+The one-shot CLI pays engine construction, XLA compilation and ladder
+warmup on EVERY run — the cost profile a high-traffic service cannot
+afford (PR 3 measured warm-vs-cold precompile at 0.67 s vs 1.79 s, and
+that is before interpreter + jax import). `PolishServer` keeps one
+process alive and multiplexes many polish requests through it:
+
+  - ONE warm engine set: the persistent compile cache and the adaptive-
+    ladder posture are armed at startup, a synthetic warmup job runs the
+    full path once, and every later job reuses the process-level jit
+    caches — the warm submit path compiles nothing (asserted via the
+    sched compile telemetry in tools/servebench.py).
+  - requests flow through a bounded `JobQueue` (admission control with
+    retry-after, FIFO-within-priority, per-job deadlines) to a small
+    worker pool;
+  - concurrent jobs' windows merge into shared device batches via the
+    cross-job `WindowBatcher` (byte-identical per-job output);
+  - SIGTERM (or a `shutdown` request) triggers graceful drain: stop
+    admitting, finish in-flight jobs, flush metrics/trace, exit;
+  - per-job failure isolation: a job's `DeviceError` / quarantine storm
+    (fault-injectable per job via its OWN fault plan) produces one typed
+    error response; the server, its warm engines and concurrent jobs
+    survive.
+
+What is NOT isolated: jobs share one process, one device, one host
+thread pool and one jit cache — a hard process crash (OOM, native
+segfault) takes every in-flight job down. The serve layer trades that
+blast radius for warmth; run several servers for fault domains.
+
+Transport: a unix socket (default) or localhost TCP, length-prefixed
+JSON frames (serve/protocol.py). `racon_tpu.cli serve` is the CLI
+surface; `serve.client.PolishClient` the Python one.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import gzip
+import os
+import random
+import socket
+import sys
+import tempfile
+import threading
+import time
+
+from ..errors import RaconError
+from ..obs import trace as obs_trace
+from ..resilience import strict_scope
+from ..utils.logger import log_info
+from .batcher import WindowBatcher
+from .protocol import (ProtocolError, error_response, max_frame_bytes,
+                       recv_frame, send_frame)
+from .queue import Draining, Job, JobQueue, QueueFull
+
+#: request option keys a submit may carry; anything else is rejected
+#: with `bad-request` (a typo'd knob must not silently polish with
+#: defaults)
+ALLOWED_OPTIONS = frozenset((
+    "window_length", "quality_threshold", "error_threshold", "trim",
+    "match", "mismatch", "gap", "fragment_correction",
+    "include_unpolished", "tpu_poa_batches", "tpu_banded_alignment",
+    "tpu_aligner_batches", "tpu_aligner_band_width", "tpu_engine",
+    "tpu_pipeline_depth", "tpu_device_timeout"))
+
+DEFAULT_SOCKET = "/tmp/racon_tpu_serve.sock"
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+class ServeConfig:
+    """Server posture: transport, capacity, and the polish defaults jobs
+    inherit when their request omits an option. Every field defaults
+    from its RACON_TPU_SERVE_* env knob so a bare `racon_tpu serve` is
+    deployable; constructor kwargs win over the environment."""
+
+    def __init__(self, **kw):
+        env = os.environ.get
+        self.socket_path = kw.pop(
+            "socket_path", env("RACON_TPU_SERVE_SOCKET") or DEFAULT_SOCKET)
+        # None = unix socket; an int (including 0 = ephemeral, the real
+        # port is published back into the config) = localhost TCP
+        self.port = kw.pop(
+            "port", _env_int("RACON_TPU_SERVE_PORT", -1)
+            if env("RACON_TPU_SERVE_PORT") else None)
+        self.workers = max(1, kw.pop(
+            "workers", _env_int("RACON_TPU_SERVE_WORKERS", 2)))
+        self.queue_depth = max(1, kw.pop(
+            "queue_depth", _env_int("RACON_TPU_SERVE_QUEUE_DEPTH", 16)))
+        self.drain_timeout_s = kw.pop(
+            "drain_timeout_s", _env_float("RACON_TPU_SERVE_DRAIN_S", 30.0))
+        self.gather_window_s = kw.pop(
+            "gather_window_s",
+            _env_float("RACON_TPU_SERVE_GATHER_MS", 50.0) / 1000.0)
+        self.min_gather = max(1, kw.pop("min_gather", 2))
+        self.warmup = kw.pop("warmup", True)
+        self.max_frame = kw.pop("max_frame", max_frame_bytes())
+        # polish defaults (jobs may override per request, except
+        # num_threads: host threads are a server resource)
+        self.window_length = kw.pop("window_length", 500)
+        self.quality_threshold = kw.pop("quality_threshold", 10.0)
+        self.error_threshold = kw.pop("error_threshold", 0.3)
+        self.trim = kw.pop("trim", True)
+        self.match = kw.pop("match", 3)
+        self.mismatch = kw.pop("mismatch", -5)
+        self.gap = kw.pop("gap", -4)
+        self.job_threads = max(1, kw.pop("job_threads", 2))
+        self.tpu_poa_batches = kw.pop("tpu_poa_batches", 0)
+        self.tpu_aligner_batches = kw.pop("tpu_aligner_batches", 0)
+        self.tpu_aligner_band_width = kw.pop("tpu_aligner_band_width", 0)
+        self.tpu_banded_alignment = kw.pop("tpu_banded_alignment", False)
+        self.tpu_engine = kw.pop("tpu_engine", None)
+        self.tpu_pipeline_depth = kw.pop("tpu_pipeline_depth", 2)
+        self.tpu_device_timeout = kw.pop("tpu_device_timeout", 0.0)
+        self.tpu_adaptive_buckets = kw.pop("tpu_adaptive_buckets", None)
+        self.tpu_compile_cache = kw.pop("tpu_compile_cache", None)
+        if kw:
+            raise RaconError("ServeConfig",
+                             f"unknown option(s): {', '.join(sorted(kw))}")
+
+    @property
+    def address(self) -> str:
+        return (f"127.0.0.1:{self.port}" if self.port is not None
+                else self.socket_path)
+
+
+def make_synth_dataset(dirname: str, seed: int = 11,
+                       genome_len: int = 2000, read_len: int = 400,
+                       step: int = 100) -> tuple[str, str, str]:
+    """Tiny deterministic ONT-shaped dataset (reads/PAF/draft gz
+    triple) — the warmup job's input, also reused by servebench and the
+    serve tests. Overlength pairs are included so the device-aligner
+    fallback path warms too."""
+    rng = random.Random(seed)
+    acgt = b"ACGT"
+    truth = bytes(rng.choice(acgt) for _ in range(genome_len))
+
+    def mutate(s, rate):
+        out = bytearray()
+        for c in s:
+            r = rng.random()
+            if r < rate / 3:
+                continue
+            if r < 2 * rate / 3:
+                out.append(rng.choice(acgt))
+                out.append(c)
+                continue
+            if r < rate:
+                out.append(rng.choice(acgt))
+                continue
+            out.append(c)
+        return bytes(out)
+
+    draft = mutate(truth, 0.04)
+    jobs = [(start, read_len)
+            for start in range(0, genome_len - read_len, step)]
+    jobs += [(0, genome_len - 700), (600, genome_len - 700)]
+    reads, paf = [], []
+    for k, (start, length) in enumerate(jobs):
+        read = mutate(truth[start:start + length], 0.05)
+        reads.append((f"r{k}", read))
+        t_end = min(start + length, len(draft))
+        paf.append(f"r{k}\t{len(read)}\t0\t{len(read)}\t+\tdraft\t"
+                   f"{len(draft)}\t{start}\t{t_end}\t{length}\t"
+                   f"{length}\t60")
+    paths = (os.path.join(dirname, "reads.fasta.gz"),
+             os.path.join(dirname, "ovl.paf.gz"),
+             os.path.join(dirname, "draft.fasta.gz"))
+    with gzip.open(paths[0], "wb") as f:
+        for name, read in reads:
+            f.write(b">" + name.encode() + b"\n" + read + b"\n")
+    with gzip.open(paths[1], "wb") as f:
+        f.write(("\n".join(paf) + "\n").encode())
+    with gzip.open(paths[2], "wb") as f:
+        f.write(b">draft\n" + draft + b"\n")
+    return paths
+
+
+class PolishServer:
+    def __init__(self, config: ServeConfig | None = None, **overrides):
+        self.config = config if config is not None \
+            else ServeConfig(**overrides)
+        cfg = self.config
+        if cfg.tpu_compile_cache:
+            from ..sched import enable_compile_cache
+
+            enable_compile_cache(cfg.tpu_compile_cache)
+        self.queue = JobQueue(cfg.queue_depth, workers=cfg.workers)
+        self.batcher = WindowBatcher(
+            gather_window_s=cfg.gather_window_s,
+            min_gather=min(cfg.min_gather, cfg.workers))
+        self.batcher.active_hint = self._inflight_count
+        self._listener: socket.socket | None = None
+        self._threads: list[threading.Thread] = []
+        self._conns: set[socket.socket] = set()
+        self._conn_lock = threading.Lock()
+        self._job_seq = 0
+        self._job_seq_lock = threading.Lock()
+        self._inflight = 0
+        self._idle = threading.Condition()
+        self._stop_workers = threading.Event()
+        self._draining = threading.Event()
+        self._stopped = threading.Event()
+        self._t_start = time.perf_counter()
+        self._warm: dict | None = None
+
+    # ---------------------------------------------------------- lifecycle
+    def start(self) -> "PolishServer":
+        """Warm up (unless disabled), bind the transport, spawn the
+        worker pool and the accept loop. Returns self; the server is
+        accepting when this returns."""
+        cfg = self.config
+        if cfg.warmup:
+            self.warmup()
+        if cfg.port is not None:
+            lst = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            lst.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            lst.bind(("127.0.0.1", max(0, cfg.port)))
+            if cfg.port <= 0:  # ephemeral: publish the real port
+                cfg.port = lst.getsockname()[1]
+        else:
+            with contextlib.suppress(OSError):
+                os.unlink(cfg.socket_path)
+            lst = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            lst.bind(cfg.socket_path)
+        lst.listen(64)
+        lst.settimeout(0.2)
+        self._listener = lst
+        for i in range(cfg.workers):
+            t = threading.Thread(target=self._worker,
+                                 name=f"racon-tpu-serve-worker-{i}",
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
+        t = threading.Thread(target=self._accept_loop,
+                             name="racon-tpu-serve-accept", daemon=True)
+        t.start()
+        self._threads.append(t)
+        log_info(f"[racon_tpu::serve] listening on {cfg.address} "
+                 f"({cfg.workers} workers, queue depth "
+                 f"{cfg.queue_depth}"
+                 + (f", warm in {self._warm['warmup_s']:.2f}s"
+                    if self._warm else "") + ")")
+        return self
+
+    def warmup(self, paths: tuple[str, str, str] | None = None) -> dict:
+        """Run one job end to end (synthetic by default, or the caller's
+        input triple — servebench passes its own so warmup shapes equal
+        job shapes) so every engine the configured posture uses is jit-
+        built before the first real request."""
+        from ..core.polisher import PolisherType, create_polisher
+
+        cfg = self.config
+        t0 = time.perf_counter()
+        with contextlib.ExitStack() as stack:
+            if paths is None:
+                tmp = stack.enter_context(
+                    tempfile.TemporaryDirectory(prefix="racon_serve_warm_"))
+                paths = make_synth_dataset(tmp)
+            polisher = create_polisher(
+                *paths, PolisherType.kC, cfg.window_length,
+                cfg.quality_threshold, cfg.error_threshold, cfg.trim,
+                cfg.match, cfg.mismatch, cfg.gap,
+                num_threads=cfg.job_threads,
+                tpu_poa_batches=cfg.tpu_poa_batches,
+                tpu_banded_alignment=cfg.tpu_banded_alignment,
+                tpu_aligner_batches=cfg.tpu_aligner_batches,
+                tpu_aligner_band_width=cfg.tpu_aligner_band_width,
+                tpu_engine=cfg.tpu_engine,
+                tpu_pipeline_depth=cfg.tpu_pipeline_depth,
+                tpu_device_timeout=cfg.tpu_device_timeout,
+                tpu_adaptive_buckets=cfg.tpu_adaptive_buckets)
+            polisher.initialize()
+            polisher.polish(True, batcher=self.batcher)
+        compiles, compile_s = self.batcher._compile_totals()
+        self._warm = {"warmup_s": round(time.perf_counter() - t0, 3),
+                      "compiles": compiles,
+                      "compile_s": round(compile_s, 3)}
+        return self._warm
+
+    def drain(self, timeout: float | None = None) -> bool:
+        """Graceful shutdown: stop admitting, finish queued + in-flight
+        jobs (bounded by `timeout`, default config.drain_timeout_s),
+        flush observability, close the transport. True when everything
+        finished inside the budget."""
+        if self._draining.is_set():
+            self._stopped.wait()
+            return True
+        self._draining.set()
+        budget = (timeout if timeout is not None
+                  else self.config.drain_timeout_s)
+        log_info(f"[racon_tpu::serve] draining: {len(self.queue)} queued, "
+                 f"{self._inflight} in flight (budget {budget:.0f}s)")
+        self.queue.drain()
+        if self._listener is not None:
+            with contextlib.suppress(OSError):
+                self._listener.close()
+        deadline = time.monotonic() + budget
+        clean = True
+        with self._idle:
+            while len(self.queue) or self._inflight:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    clean = False
+                    break
+                self._idle.wait(min(left, 0.2))
+        self._stop_workers.set()
+        for t in self._threads:
+            if t is not threading.current_thread():
+                t.join(timeout=2.0)
+        # flush observability BEFORE dropping connections: an armed
+        # trace/metrics artifact must survive the shutdown
+        self._flush_observability()
+        with self._conn_lock:
+            conns = list(self._conns)
+        for c in conns:
+            with contextlib.suppress(OSError):
+                c.shutdown(socket.SHUT_RDWR)
+            with contextlib.suppress(OSError):
+                c.close()
+        if self.config.port is None:
+            with contextlib.suppress(OSError):
+                os.unlink(self.config.socket_path)
+        log_info(f"[racon_tpu::serve] drained "
+                 f"{'cleanly' if clean else 'OVER BUDGET'}: "
+                 f"{self.queue.counters['completed']} jobs completed, "
+                 f"{self.queue.counters['failed']} failed")
+        self._stopped.set()
+        return clean
+
+    def _flush_observability(self) -> None:
+        snap = self.stats_snapshot()
+        q, b = snap["queue"], snap["batcher"]
+        log_info(f"[racon_tpu::serve] lifetime: {q['admitted']} admitted "
+                 f"({q['rejected_full']} full-queue rejects, "
+                 f"{q['expired']} expired), {b['rounds']} batch rounds "
+                 f"({b['multi_job_rounds']} cross-job), "
+                 f"{b['compiles']} compiles {b['compile_s']:.2f}s")
+        metrics_path = os.environ.get("RACON_TPU_METRICS")
+        if metrics_path:
+            import json
+
+            try:
+                with open(metrics_path, "w") as fh:
+                    json.dump(snap, fh, indent=2, sort_keys=True)
+                log_info(f"[racon_tpu::serve] metrics written to "
+                         f"{metrics_path}")
+            except OSError as exc:
+                log_info(f"[racon_tpu::serve] warning: could not write "
+                         f"metrics ({exc})")
+        try:
+            saved = obs_trace.save()
+        except OSError as exc:
+            saved = None
+            log_info(f"[racon_tpu::serve] warning: could not write trace "
+                     f"({exc})")
+        if saved:
+            log_info(f"[racon_tpu::serve] trace written to {saved}")
+
+    # ----------------------------------------------------------- serving
+    def _accept_loop(self) -> None:
+        while not self._draining.is_set():
+            try:
+                conn, _ = self._listener.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            conn.settimeout(None)
+            with self._conn_lock:
+                self._conns.add(conn)
+            t = threading.Thread(target=self._handle, args=(conn,),
+                                 name="racon-tpu-serve-conn", daemon=True)
+            t.start()
+
+    def _handle(self, conn: socket.socket) -> None:
+        try:
+            while True:
+                try:
+                    req = recv_frame(conn, self.config.max_frame)
+                except ProtocolError as exc:
+                    with contextlib.suppress(OSError):
+                        send_frame(conn,
+                                   error_response(exc.code, str(exc)))
+                    if not exc.resync:
+                        return
+                    continue
+                except OSError:
+                    return
+                if req is None:
+                    return
+                try:
+                    resp = self._dispatch(req)
+                except Exception as exc:
+                    # a handler bug answers typed and keeps serving;
+                    # it never takes the process down
+                    resp = error_response(
+                        "internal", f"{type(exc).__name__}: {exc}")
+                try:
+                    send_frame(conn, resp)
+                except ProtocolError as exc:
+                    # response too big for the wire: answer typed
+                    # rather than dying mid-send with a desynced peer
+                    with contextlib.suppress(OSError):
+                        send_frame(conn,
+                                   error_response(exc.code, str(exc)))
+                except OSError:
+                    return
+        finally:
+            with self._conn_lock:
+                self._conns.discard(conn)
+            with contextlib.suppress(OSError):
+                conn.close()
+
+    def _dispatch(self, req: dict) -> dict:
+        rtype = req.get("type")
+        if rtype == "submit":
+            return self._submit(req)
+        if rtype == "ping":
+            return {"type": "pong", "warm": self._warm is not None,
+                    "uptime_s": round(
+                        time.perf_counter() - self._t_start, 3)}
+        if rtype == "stats":
+            return dict(self.stats_snapshot(), type="stats")
+        if rtype == "shutdown":
+            threading.Thread(target=self.drain,
+                             name="racon-tpu-serve-drain",
+                             daemon=True).start()
+            return {"type": "ok", "message": "draining"}
+        return error_response("bad-request",
+                              f"unknown request type {rtype!r}")
+
+    def _submit(self, req: dict) -> dict:
+        for key in ("sequences", "overlaps", "target"):
+            path = req.get(key)
+            if not isinstance(path, str) or not path:
+                return error_response("bad-request",
+                                      f"missing input path {key!r}")
+            if not os.path.isfile(path):
+                return error_response(
+                    "bad-request", f"{key} file not found: {path}")
+        options = req.get("options") or {}
+        if not isinstance(options, dict):
+            return error_response("bad-request", "options must be an object")
+        unknown = set(options) - ALLOWED_OPTIONS
+        if unknown:
+            return error_response(
+                "bad-request",
+                f"unknown option(s): {', '.join(sorted(unknown))}")
+        fault_plan = req.get("fault_plan")
+        if fault_plan:
+            from ..resilience import FaultPlan
+
+            try:
+                FaultPlan.parse(fault_plan)
+            except RaconError as exc:
+                return error_response("bad-request", str(exc))
+        with self._job_seq_lock:
+            self._job_seq += 1
+            job_id = f"j{self._job_seq}"
+        job = Job(job_id, req["sequences"], req["overlaps"], req["target"],
+                  options, priority=int(req.get("priority", 0)),
+                  deadline_s=req.get("deadline_s"),
+                  fault_plan=fault_plan, strict=req.get("strict"),
+                  want_trace=bool(req.get("trace")))
+        try:
+            self.queue.submit(job)
+        except QueueFull as exc:
+            return error_response("queue-full", str(exc),
+                                  retry_after=round(exc.retry_after, 3),
+                                  job_id=job_id)
+        except Draining as exc:
+            return error_response("draining", str(exc), job_id=job_id)
+        job.event.wait()
+        return job.response
+
+    # ------------------------------------------------------------ workers
+    def _worker(self) -> None:
+        while True:
+            job = self.queue.pop(timeout=0.2)
+            if job is None:
+                if self._stop_workers.is_set() and not len(self.queue):
+                    return
+                continue
+            with self._idle:
+                self._inflight += 1
+            t0 = time.perf_counter()
+            try:
+                resp = self._run_job(job)
+                ok = True
+            except Exception as exc:
+                # per-job failure isolation: the job answers typed, the
+                # server and its warm engines survive
+                resp = error_response(
+                    "job-failed", str(exc), job_id=job.id,
+                    error_type=type(exc).__name__,
+                    queue_wait_s=round(job.queue_wait_s, 4))
+                ok = False
+            job.response = resp
+            job.event.set()
+            self.queue.task_done(job, ok, time.perf_counter() - t0)
+            with self._idle:
+                self._inflight -= 1
+                self._idle.notify_all()
+
+    def _run_job(self, job: Job) -> dict:
+        from ..core.polisher import PolisherType, create_polisher
+
+        opts, cfg = job.options, self.config
+        t0 = time.perf_counter()
+        trace_ctx = (obs_trace.scoped() if job.want_trace
+                     else contextlib.nullcontext())
+        with strict_scope(job.strict), trace_ctx as rec:
+            polisher = create_polisher(
+                job.sequences, job.overlaps, job.target,
+                PolisherType.kF if opts.get("fragment_correction")
+                else PolisherType.kC,
+                int(opts.get("window_length", cfg.window_length)),
+                float(opts.get("quality_threshold",
+                               cfg.quality_threshold)),
+                float(opts.get("error_threshold", cfg.error_threshold)),
+                bool(opts.get("trim", cfg.trim)),
+                int(opts.get("match", cfg.match)),
+                int(opts.get("mismatch", cfg.mismatch)),
+                int(opts.get("gap", cfg.gap)),
+                num_threads=cfg.job_threads,
+                tpu_poa_batches=int(
+                    opts.get("tpu_poa_batches", cfg.tpu_poa_batches)),
+                tpu_banded_alignment=bool(
+                    opts.get("tpu_banded_alignment",
+                             cfg.tpu_banded_alignment)),
+                tpu_aligner_batches=int(
+                    opts.get("tpu_aligner_batches",
+                             cfg.tpu_aligner_batches)),
+                tpu_aligner_band_width=int(
+                    opts.get("tpu_aligner_band_width",
+                             cfg.tpu_aligner_band_width)),
+                tpu_engine=opts.get("tpu_engine", cfg.tpu_engine),
+                tpu_pipeline_depth=int(
+                    opts.get("tpu_pipeline_depth",
+                             cfg.tpu_pipeline_depth)),
+                tpu_device_timeout=float(
+                    opts.get("tpu_device_timeout",
+                             cfg.tpu_device_timeout)),
+                tpu_adaptive_buckets=cfg.tpu_adaptive_buckets,
+                tpu_fault_plan=job.fault_plan)
+            polisher.initialize()
+            polished = polisher.polish(
+                not opts.get("include_unpolished", False),
+                batcher=self.batcher)
+        fasta = b"".join(b">" + s.name.encode() + b"\n" + s.data + b"\n"
+                         for s in polished)
+        resp = {"type": "result", "job_id": job.id,
+                "sequences": len(polished),
+                "fasta": fasta.decode("latin-1"),
+                "metrics": polisher.metrics.snapshot(),
+                "serve": {"queue_wait_s": round(job.queue_wait_s, 4),
+                          "exec_s": round(time.perf_counter() - t0, 4),
+                          "batch": getattr(polisher, "serve_round", None)}}
+        if job.want_trace:
+            resp["trace"] = rec.events()
+        return resp
+
+    # -------------------------------------------------------------- misc
+    def _inflight_count(self) -> int:
+        with self._idle:
+            return self._inflight
+
+    def stats_snapshot(self) -> dict:
+        with self._idle:
+            inflight = self._inflight
+        return {"uptime_s": round(time.perf_counter() - self._t_start, 3),
+                "warm": self._warm,
+                "inflight": inflight,
+                "draining": self._draining.is_set(),
+                "queue": self.queue.snapshot(),
+                "batcher": self.batcher.snapshot()}
+
+    @property
+    def address(self) -> str:
+        return self.config.address
+
+
+# ------------------------------------------------------------------ CLI
+def serve_main(argv: list[str]) -> int:
+    """`racon_tpu serve` entry point: run a PolishServer until SIGTERM /
+    SIGINT, then drain gracefully."""
+    import argparse
+    import signal
+
+    ap = argparse.ArgumentParser(
+        prog="racon_tpu serve",
+        description="warm polishing job server (unix socket or "
+                    "localhost TCP; see README 'Serving')")
+    ap.add_argument("--socket", default=None,
+                    help=f"unix socket path (default "
+                         f"RACON_TPU_SERVE_SOCKET or {DEFAULT_SOCKET})")
+    ap.add_argument("--port", type=int, default=None,
+                    help="listen on localhost TCP instead of the unix "
+                         "socket (0 = ephemeral)")
+    ap.add_argument("--workers", type=int, default=None,
+                    help="job worker threads (RACON_TPU_SERVE_WORKERS, "
+                         "default 2)")
+    ap.add_argument("--queue-depth", type=int, default=None,
+                    help="admission-control queue bound "
+                         "(RACON_TPU_SERVE_QUEUE_DEPTH, default 16)")
+    ap.add_argument("--drain-timeout", type=float, default=None,
+                    help="graceful-drain budget in seconds "
+                         "(RACON_TPU_SERVE_DRAIN_S, default 30)")
+    ap.add_argument("--gather-ms", type=float, default=None,
+                    help="cross-job batch gather window in ms "
+                         "(RACON_TPU_SERVE_GATHER_MS, default 50)")
+    ap.add_argument("--no-warmup", action="store_true",
+                    help="skip the synthetic warmup job (first real "
+                         "request pays the compiles)")
+    ap.add_argument("-w", "--window-length", type=int, default=500)
+    ap.add_argument("-q", "--quality-threshold", type=float, default=10.0)
+    ap.add_argument("-e", "--error-threshold", type=float, default=0.3)
+    ap.add_argument("-m", "--match", type=int, default=3)
+    ap.add_argument("-x", "--mismatch", type=int, default=-5)
+    ap.add_argument("-g", "--gap", type=int, default=-4)
+    ap.add_argument("-t", "--threads", type=int, default=2,
+                    help="host threads per job")
+    ap.add_argument("-c", "--tpupoa-batches", type=int, default=0)
+    ap.add_argument("--tpualigner-batches", type=int, default=0)
+    ap.add_argument("--tpualigner-band-width", type=int, default=0)
+    ap.add_argument("--tpu-engine", choices=("session", "fused"),
+                    default=None)
+    ap.add_argument("--tpu-pipeline-depth", type=int, default=2)
+    ap.add_argument("--tpu-adaptive-buckets", action="store_true")
+    ap.add_argument("--tpu-compile-cache", default=None)
+    args = ap.parse_args(argv)
+
+    kw: dict = {
+        "warmup": not args.no_warmup,
+        "window_length": args.window_length,
+        "quality_threshold": args.quality_threshold,
+        "error_threshold": args.error_threshold,
+        "match": args.match, "mismatch": args.mismatch, "gap": args.gap,
+        "job_threads": args.threads,
+        "tpu_poa_batches": args.tpupoa_batches,
+        "tpu_aligner_batches": args.tpualigner_batches,
+        "tpu_aligner_band_width": args.tpualigner_band_width,
+        "tpu_engine": args.tpu_engine,
+        "tpu_pipeline_depth": args.tpu_pipeline_depth,
+        "tpu_adaptive_buckets": args.tpu_adaptive_buckets or None,
+        "tpu_compile_cache": args.tpu_compile_cache,
+    }
+    if args.socket is not None:
+        kw["socket_path"] = args.socket
+    if args.port is not None:
+        kw["port"] = args.port
+    if args.workers is not None:
+        kw["workers"] = args.workers
+    if args.queue_depth is not None:
+        kw["queue_depth"] = args.queue_depth
+    if args.drain_timeout is not None:
+        kw["drain_timeout_s"] = args.drain_timeout
+    if args.gather_ms is not None:
+        kw["gather_window_s"] = args.gather_ms / 1000.0
+
+    try:
+        server = PolishServer(**kw).start()
+    except (RaconError, OSError) as exc:
+        print(f"[racon_tpu::serve] error: {exc}", file=sys.stderr)
+        return 1
+
+    stop = threading.Event()
+
+    def _on_signal(signum, frame):
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _on_signal)
+    signal.signal(signal.SIGINT, _on_signal)
+    while not stop.is_set() and not server._stopped.is_set():
+        stop.wait(0.2)
+    server.drain()
+    return 0

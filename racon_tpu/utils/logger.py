@@ -2,8 +2,9 @@
 
 `Logger` mirrors the reference Logger (src/logger.cpp:20-54): `log()`
 opens a timing section, `log(msg)` closes it printing elapsed seconds,
-`bar(msg)` renders a fixed 20-bin progress bar, `total(msg)` prints
-cumulative elapsed time.
+`bar(msg)` renders a fixed 20-bin progress bar (interactive \r redraws
+only when stderr is a tty; piped/server runs get one completion line
+per phase instead), `total(msg)` prints cumulative elapsed time.
 
 The module-level functions are the observability layer's structured,
 leveled logging (`RACON_TPU_LOG_LEVEL=quiet|info|debug`, default info):
@@ -117,6 +118,15 @@ def reset_dedup() -> None:
         _dedup.clear()
 
 
+def _stderr_is_tty() -> bool:
+    """Checked per bar redraw (cheap, <= 21 calls per phase) rather than
+    cached: tests and the serve layer swap sys.stderr mid-process."""
+    try:
+        return sys.stderr.isatty()
+    except Exception:
+        return False
+
+
 class Logger:
     def __init__(self):
         self._time = 0.0
@@ -158,20 +168,30 @@ class Logger:
                 return
             self._bar = bins
             quiet = log_level() < INFO
-            if not quiet:
+            # the \r redraw protocol is unreadable spam when stderr is a
+            # pipe (bench log tails, server mode): without a tty, emit
+            # ONLY the phase's completion line — byte-identical to the
+            # last line a tty would show. On a tty the classic bar is
+            # preserved byte-for-byte.
+            tty = not quiet and _stderr_is_tty()
+            done = bins == 20 and self._bar_count >= self._bar_total
+            if tty:
                 filled = "=" * bins + (">" if bins < 20 else "")
                 sys.stderr.write(f"{msg} [{filled:<20}] {bins * 5}%")
-            if bins == 20 and self._bar_count >= self._bar_total:
+            if done:
                 elapsed = time.perf_counter() - self._time
                 self._total += elapsed
-                if not quiet:
+                if tty:
                     sys.stderr.write(f" {elapsed:.5f} s\n")
+                elif not quiet:
+                    sys.stderr.write(f"{msg} [{'=' * 20}] 100% "
+                                     f"{elapsed:.5f} s\n")
                 self._bar = 0
                 self._bar_count = 0
                 self._time = time.perf_counter()
-            elif not quiet:
+            elif tty:
                 sys.stderr.write("\r")
-            if not quiet:
+            if tty or (done and not quiet):
                 sys.stderr.flush()
 
     def total(self, msg: str) -> None:

@@ -1,0 +1,622 @@
+"""Serve-layer tests: frame protocol, job queue, cross-job batching
+identity, per-job failure isolation, graceful drain, warm polisher
+reuse, and the TTY-aware progress bars.
+
+The load-bearing contracts, in the order the ISSUE states them:
+
+  - a submitted job's polished FASTA is byte-identical to the one-shot
+    path, INCLUDING when a second concurrent job shares its device
+    batches (per-window consensus is batch-composition-independent);
+  - malformed frames (truncated / oversized / garbage) produce typed
+    error responses and never take the server or the connection down;
+  - full-queue admission rejects carry `retry_after`; deadline-expired
+    jobs are cancelled and counted;
+  - a fault-plan-poisoned job fails with a typed error while the server
+    survives and completes a subsequent clean job;
+  - drain finishes in-flight jobs (the SIGTERM path is exercised in a
+    real subprocess, marked slow).
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import socket
+import struct
+import sys
+import threading
+import time
+
+import pytest
+
+from racon_tpu.core.polisher import PolisherType, create_polisher
+from racon_tpu.serve import (PolishClient, PolishServer, WindowBatcher,
+                             make_synth_dataset)
+from racon_tpu.serve.client import JobFailed, ServeError
+from racon_tpu.serve.protocol import (MAGIC, FrameGarbage, FrameTooLarge,
+                                      FrameTruncated, recv_frame,
+                                      send_frame)
+from racon_tpu.serve.queue import Draining, Job, JobQueue, QueueFull
+
+
+# --------------------------------------------------------------- fixtures
+@pytest.fixture(scope="module")
+def dataset(tmp_path_factory):
+    return make_synth_dataset(str(tmp_path_factory.mktemp("serve_data")))
+
+
+def polish_solo(paths, **kw) -> bytes:
+    p = create_polisher(*paths, PolisherType.kC, 500, 10.0, 0.3,
+                        num_threads=2, **kw)
+    p.initialize()
+    return b"".join(b">" + s.name.encode() + b"\n" + s.data + b"\n"
+                    for s in p.polish())
+
+
+@pytest.fixture(scope="module")
+def solo_bytes(dataset):
+    return polish_solo(dataset)
+
+
+@pytest.fixture(scope="module")
+def server(dataset, tmp_path_factory):
+    sock = str(tmp_path_factory.mktemp("serve_sock") / "s.sock")
+    srv = PolishServer(socket_path=sock, workers=2,
+                       gather_window_s=0.2).start()
+    yield srv
+    srv.drain(timeout=10)
+
+
+@pytest.fixture(scope="module")
+def client(server):
+    return PolishClient(socket_path=server.config.socket_path)
+
+
+# --------------------------------------------------------- frame protocol
+def _pair():
+    a, b = socket.socketpair()
+    a.settimeout(5.0)
+    b.settimeout(5.0)
+    return a, b
+
+
+def test_frame_roundtrip():
+    a, b = _pair()
+    try:
+        send_frame(a, {"type": "ping", "blob": "é" * 10})
+        assert recv_frame(b) == {"type": "ping", "blob": "é" * 10}
+        a.close()
+        assert recv_frame(b) is None  # clean EOF between frames
+    finally:
+        b.close()
+
+
+def test_frame_truncated_mid_payload():
+    a, b = _pair()
+    try:
+        a.sendall(struct.pack(">4sI", MAGIC, 100) + b"only-ten..")
+        a.close()
+        with pytest.raises(FrameTruncated):
+            recv_frame(b)
+    finally:
+        b.close()
+
+
+def test_frame_truncated_mid_header():
+    a, b = _pair()
+    try:
+        a.sendall(b"RT")
+        a.close()
+        with pytest.raises(FrameTruncated):
+            recv_frame(b)
+    finally:
+        b.close()
+
+
+def test_frame_oversized_drains_and_stream_survives():
+    a, b = _pair()
+    try:
+        big = b"x" * 4096
+        a.sendall(struct.pack(">4sI", MAGIC, len(big)) + big)
+        send_frame(a, {"type": "ping"})
+        with pytest.raises(FrameTooLarge):
+            recv_frame(b, max_frame=1024)
+        # the oversized payload was drained: the next frame parses
+        assert recv_frame(b, max_frame=1024) == {"type": "ping"}
+    finally:
+        a.close()
+        b.close()
+
+
+def test_frame_garbage_payload_keeps_stream():
+    a, b = _pair()
+    try:
+        bad = b"{this is not json"
+        a.sendall(struct.pack(">4sI", MAGIC, len(bad)) + bad)
+        send_frame(a, {"ok": 1})
+        with pytest.raises(FrameGarbage) as exc_info:
+            recv_frame(b)
+        assert exc_info.value.resync
+        assert recv_frame(b) == {"ok": 1}
+    finally:
+        a.close()
+        b.close()
+
+
+def test_frame_bad_magic_desyncs():
+    a, b = _pair()
+    try:
+        a.sendall(b"GET / HTTP/1.1\r\n\r\n" + b" " * 16)
+        with pytest.raises(FrameGarbage) as exc_info:
+            recv_frame(b)
+        assert not exc_info.value.resync
+    finally:
+        a.close()
+        b.close()
+
+
+def test_frame_non_object_payload_rejected():
+    a, b = _pair()
+    try:
+        payload = b"[1,2,3]"
+        a.sendall(struct.pack(">4sI", MAGIC, len(payload)) + payload)
+        with pytest.raises(FrameGarbage):
+            recv_frame(b)
+    finally:
+        a.close()
+        b.close()
+
+
+# -------------------------------------------------------------- job queue
+def _job(i, priority=0, deadline_s=None):
+    return Job(f"j{i}", "s", "o", "t", {}, priority=priority,
+               deadline_s=deadline_s)
+
+
+def test_queue_full_reject_carries_retry_after():
+    q = JobQueue(maxsize=2, workers=1)
+    q.submit(_job(0))
+    q.submit(_job(1))
+    with pytest.raises(QueueFull) as exc_info:
+        q.submit(_job(2))
+    assert exc_info.value.retry_after > 0
+    assert q.counters["rejected_full"] == 1
+    assert q.counters["admitted"] == 2
+
+
+def test_queue_fifo_within_priority():
+    q = JobQueue(maxsize=8)
+    q.submit(_job(0, priority=0))
+    q.submit(_job(1, priority=0))
+    q.submit(_job(2, priority=5))
+    q.submit(_job(3, priority=5))
+    order = [q.pop(timeout=0.1).id for _ in range(4)]
+    assert order == ["j2", "j3", "j0", "j1"]
+
+
+def test_queue_deadline_expired_cancelled_and_counted():
+    q = JobQueue(maxsize=8)
+    expired = _job(0, deadline_s=0.01)
+    q.submit(expired)
+    q.submit(_job(1))
+    time.sleep(0.05)
+    job = q.pop(timeout=0.5)
+    assert job.id == "j1"  # the expired job was consumed, not returned
+    assert q.counters["expired"] == 1
+    assert expired.event.is_set()
+    assert expired.response["code"] == "deadline-expired"
+
+
+def test_queue_drain_stops_admission():
+    q = JobQueue(maxsize=8)
+    q.submit(_job(0))
+    q.drain()
+    with pytest.raises(Draining):
+        q.submit(_job(1))
+    # queued work still flows out
+    assert q.pop(timeout=0.1).id == "j0"
+    assert q.counters["rejected_draining"] == 1
+
+
+# --------------------------------------------------- cross-job batching
+def test_cross_job_batch_byte_identical(dataset, solo_bytes,
+                                        tmp_path_factory):
+    """Two concurrent jobs merged into ONE engine pass produce exactly
+    the solo-run bytes each. min_gather=2 with no concurrency hint makes
+    the merge deterministic: the leader waits until the second job
+    joins."""
+    sock = str(tmp_path_factory.mktemp("merge") / "s.sock")
+    srv = PolishServer(socket_path=sock, workers=2, min_gather=2,
+                       gather_window_s=10.0, warmup=False).start()
+    srv.batcher.active_hint = None  # always wait for the joiner
+    try:
+        cl = PolishClient(socket_path=sock)
+        results = [None, None]
+
+        def go(i):
+            results[i] = cl.submit(*dataset)
+
+        threads = [threading.Thread(target=go, args=(i,))
+                   for i in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        for r in results:
+            assert r is not None
+            assert r.fasta == solo_bytes
+            assert r.serve["batch"]["jobs"] == 2
+            assert not r.serve["batch"]["solo"]
+        assert srv.batcher.counters["multi_job_rounds"] == 1
+    finally:
+        srv.drain(timeout=10)
+
+
+def test_batcher_mixed_params_do_not_merge(dataset):
+    """Jobs whose engine parameters differ must not share a pass — and
+    both must still match their own solo bytes."""
+    batcher = WindowBatcher(gather_window_s=0.3, min_gather=2)
+
+    def build(match):
+        p = create_polisher(*dataset, PolisherType.kC, 500, 10.0, 0.3,
+                            match=match, num_threads=2)
+        p.initialize()
+        return p
+
+    pa, pb = build(3), build(5)
+    ta = threading.Thread(target=batcher.consensus, args=(pa,))
+    tb = threading.Thread(target=batcher.consensus, args=(pb,))
+    ta.start()
+    tb.start()
+    ta.join(60)
+    tb.join(60)
+    assert pa.serve_round["jobs"] == 1
+    assert pb.serve_round["jobs"] == 1
+    assert batcher.counters["rounds"] == 2
+    out_a = b"".join(b">" + s.name.encode() + b"\n" + s.data + b"\n"
+                     for s in pa._stitch(True))
+    out_b = b"".join(b">" + s.name.encode() + b"\n" + s.data + b"\n"
+                     for s in pb._stitch(True))
+    assert out_a == polish_solo(dataset)
+    assert out_b == polish_solo(dataset, match=5)
+    assert out_a != out_b  # the scores genuinely differ on this input
+
+
+# ------------------------------------------------------------ end to end
+def test_submit_byte_identical_to_oneshot(client, dataset, solo_bytes):
+    result = client.submit(*dataset)
+    assert result.fasta == solo_bytes
+    assert result.serve["queue_wait_s"] >= 0
+    assert "pipeline" in result.metrics
+
+
+def test_submit_missing_file_typed_error(client, dataset):
+    with pytest.raises(ServeError) as exc_info:
+        client.submit(dataset[0], dataset[1], "/nonexistent/draft.fa.gz")
+    assert exc_info.value.code == "bad-request"
+
+
+def test_submit_unknown_option_typed_error(client, dataset):
+    with pytest.raises(ServeError) as exc_info:
+        client.submit(*dataset, options={"wndow_length": 500})
+    assert exc_info.value.code == "bad-request"
+    assert "wndow_length" in str(exc_info.value)
+
+
+def test_poisoned_job_fails_typed_server_survives(client, dataset,
+                                                  solo_bytes, server):
+    """The acceptance gate: an injected DeviceError fails exactly one
+    job with a typed error; the warm server then completes a clean job
+    byte-identically. Both phases are poisoned in turn."""
+    # alignment-phase poison (device aligner armed for this job only)
+    with pytest.raises(JobFailed) as exc_info:
+        client.submit(*dataset, fault_plan="device:chunk=0:raise",
+                      strict=True, options={"tpu_aligner_batches": 1})
+    assert exc_info.value.error_type == "DeviceError"
+    # consensus-phase poison (host loop pack stage; solo round)
+    solo_before = server.batcher.counters["solo_rounds"]
+    with pytest.raises(JobFailed) as exc_info:
+        client.submit(*dataset, fault_plan="pack:chunk=0:raise",
+                      strict=True)
+    assert exc_info.value.error_type == "DeviceError"
+    # the server survives and the next clean job is byte-identical
+    assert client.submit(*dataset).fasta == solo_bytes
+    assert client.ping()["type"] == "pong"
+    assert server.batcher.counters["solo_rounds"] >= solo_before
+
+
+def test_unpoisoned_fault_plan_degrades_within_job(client, dataset,
+                                                   solo_bytes):
+    """Without strict, the job's own resilience ladder absorbs its
+    injected fault — output still byte-identical, fault counted in the
+    job's OWN metrics, nothing leaks to the next job."""
+    r = client.submit(*dataset, fault_plan="device:chunk=0:raise")
+    assert r.fasta == solo_bytes
+    assert r.metrics["resilience"]["faults"] == 1
+    clean = client.submit(*dataset)
+    assert clean.metrics["resilience"]["faults"] == 0
+
+
+def test_job_trace_scoped_to_response(client, dataset):
+    r = client.submit(*dataset, trace=True)
+    assert isinstance(r.trace, list) and r.trace
+    names = {ev["name"] for ev in r.trace}
+    assert "polisher.initialize" in names
+    # an untraced job's response carries no trace
+    assert client.submit(*dataset).trace is None
+
+
+def test_concurrent_traced_jobs_restore_tracer(client, dataset):
+    """Overlapping trace=True jobs must not leak a dead per-job
+    recorder into the process tracer (scoped() serializes): both get
+    their own events, and the global tracer ends where it started."""
+    from racon_tpu.obs import trace as obs_trace
+
+    before = obs_trace.get_tracer()
+    results = [None, None]
+
+    def go(i):
+        results[i] = client.submit(*dataset, trace=True)
+
+    threads = [threading.Thread(target=go, args=(i,)) for i in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    for r in results:
+        assert r is not None and r.trace
+    assert obs_trace.get_tracer() is before
+
+
+def test_tcp_ephemeral_port(dataset, solo_bytes):
+    """--port 0 means ephemeral localhost TCP (not the unix socket);
+    the bound port is published and serves byte-identical results."""
+    srv = PolishServer(port=0, warmup=False,
+                       gather_window_s=0.0).start()
+    try:
+        assert srv.config.port > 0
+        cl = PolishClient(port=srv.config.port)
+        assert cl.ping()["type"] == "pong"
+        assert cl.submit(*dataset).fasta == solo_bytes
+    finally:
+        srv.drain(timeout=10)
+
+
+def test_server_connection_survives_bad_frames(server):
+    """Garbage and oversized frames on a live connection get typed error
+    responses and the SAME connection keeps working."""
+    sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    sock.settimeout(10.0)
+    sock.connect(server.config.socket_path)
+    try:
+        # garbage JSON payload
+        bad = b"!garbage!"
+        sock.sendall(struct.pack(">4sI", MAGIC, len(bad)) + bad)
+        resp = recv_frame(sock)
+        assert resp["type"] == "error" and resp["code"] == "bad-frame"
+        # same connection still serves
+        send_frame(sock, {"type": "ping"})
+        assert recv_frame(sock)["type"] == "pong"
+        # unknown request type: typed, connection still alive
+        send_frame(sock, {"type": "frobnicate"})
+        resp = recv_frame(sock)
+        assert resp["type"] == "error" and resp["code"] == "bad-request"
+        send_frame(sock, {"type": "stats"})
+        assert recv_frame(sock)["type"] == "stats"
+    finally:
+        sock.close()
+
+
+def test_server_survives_truncated_frame_and_desync(server):
+    """A client that dies mid-frame (and one that talks HTTP at us)
+    costs only its own connection."""
+    for payload in (struct.pack(">4sI", MAGIC, 1000) + b"partial",
+                    b"GET / HTTP/1.1\r\n\r\n"):
+        sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        sock.settimeout(10.0)
+        sock.connect(server.config.socket_path)
+        sock.sendall(payload)
+        sock.close()
+    # fresh connection: the server is untouched
+    cl = PolishClient(socket_path=server.config.socket_path)
+    assert cl.ping()["type"] == "pong"
+
+
+def test_oversized_frame_typed_error(dataset, tmp_path_factory):
+    sock_path = str(tmp_path_factory.mktemp("oversz") / "s.sock")
+    srv = PolishServer(socket_path=sock_path, warmup=False,
+                       max_frame=512).start()
+    try:
+        sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        sock.settimeout(10.0)
+        sock.connect(sock_path)
+        big = b"y" * 2048
+        sock.sendall(struct.pack(">4sI", MAGIC, len(big)) + big)
+        resp = recv_frame(sock)
+        assert resp["type"] == "error"
+        assert resp["code"] == "frame-too-large"
+        send_frame(sock, {"type": "ping"})
+        assert recv_frame(sock)["type"] == "pong"
+        sock.close()
+    finally:
+        srv.drain(timeout=5)
+
+
+# ------------------------------------------------------------------ drain
+def test_drain_finishes_inflight_then_rejects(dataset, solo_bytes,
+                                              tmp_path_factory):
+    sock = str(tmp_path_factory.mktemp("drain") / "s.sock")
+    srv = PolishServer(socket_path=sock, workers=1, warmup=False,
+                       gather_window_s=0.0).start()
+    cl = PolishClient(socket_path=sock)
+    result: list = [None]
+
+    def go():
+        result[0] = cl.submit(*dataset)
+
+    t = threading.Thread(target=go)
+    t.start()
+    # wait until the job is actually admitted, then drain
+    deadline = time.monotonic() + 10
+    while (srv.queue.counters["admitted"] < 1
+           and time.monotonic() < deadline):
+        time.sleep(0.005)
+    assert srv.drain(timeout=30)
+    t.join(timeout=30)
+    assert result[0] is not None and result[0].fasta == solo_bytes
+    assert srv.queue.counters["completed"] == 1
+    # post-drain: admission is closed (transport is gone)
+    with pytest.raises((ServeError, OSError)):
+        cl.submit(*dataset)
+
+
+@pytest.mark.slow
+def test_sigterm_drain_subprocess(dataset, solo_bytes, tmp_path):
+    """Full SIGTERM path in a real `racon_tpu serve` process: an
+    in-flight job finishes, the process exits 0."""
+    import signal
+    import subprocess
+
+    sock = str(tmp_path / "s.sock")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=os.pathsep.join(
+                   p for p in [os.path.dirname(os.path.dirname(
+                       os.path.abspath(__file__)))]
+                   + os.environ.get("PYTHONPATH", "").split(os.pathsep)
+                   if p and "axon_site" not in p))
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "racon_tpu.cli", "serve", "--socket",
+         sock, "--workers", "1", "--no-warmup"],
+        env=env, stderr=subprocess.PIPE)
+    try:
+        cl = PolishClient(socket_path=sock, timeout=30)
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            try:
+                cl.ping()
+                break
+            except (OSError, ServeError):
+                time.sleep(0.2)
+        else:
+            pytest.fail("server never came up")
+        result: list = [None]
+
+        def go():
+            result[0] = cl.submit(*dataset)
+
+        t = threading.Thread(target=go)
+        t.start()
+        time.sleep(0.2)  # let the submit land
+        proc.send_signal(signal.SIGTERM)
+        t.join(timeout=60)
+        rc = proc.wait(timeout=60)
+        assert rc == 0
+        assert result[0] is not None
+        assert result[0].fasta == solo_bytes
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=10)
+
+
+# ------------------------------------------------- warm polisher reuse
+def test_polisher_back_to_back_runs_byte_identical(dataset):
+    fresh = polish_solo(dataset)
+    p = create_polisher(*dataset, PolisherType.kC, 500, 10.0, 0.3,
+                        num_threads=2)
+    outs, stats = [], []
+    for _ in range(2):
+        p.initialize()
+        outs.append(b"".join(b">" + s.name.encode() + b"\n" + s.data
+                             + b"\n" for s in p.polish()))
+        stats.append(p.stage_stats)
+    assert outs[0] == fresh
+    assert outs[1] == fresh
+    # counters describe one run each, not a running total
+    assert stats[0]["chunks"] == stats[1]["chunks"]
+    assert stats[0]["launches"] == stats[1]["launches"]
+
+
+def test_polisher_rebind_warm_reuse(dataset, tmp_path):
+    """rebind() points a warm polisher at new inputs; output matches a
+    fresh polisher on those inputs."""
+    other = make_synth_dataset(str(tmp_path), seed=99)
+    p = create_polisher(*dataset, PolisherType.kC, 500, 10.0, 0.3,
+                        num_threads=2)
+    p.initialize()
+    p.polish()
+    p.rebind(*other)
+    p.initialize()
+    warm = b"".join(b">" + s.name.encode() + b"\n" + s.data + b"\n"
+                    for s in p.polish())
+    assert warm == polish_solo(other)
+    # per-run metrics followed the swap (fresh occupancy object)
+    assert p.metrics.snapshot()["sched"] == p.scheduler.stats.snapshot()
+
+
+def test_polisher_run_counters_reset_between_jobs(dataset):
+    """A fault absorbed in run 1 must not appear in run 2's report."""
+    from racon_tpu.resilience.faults import reset_fault_plan
+
+    os.environ["RACON_TPU_FAULT_PLAN"] = "device:chunk=0:raise"
+    reset_fault_plan()
+    try:
+        p = create_polisher(*dataset, PolisherType.kC, 500, 10.0, 0.3,
+                            num_threads=2)
+        p.initialize()
+        p.polish()
+        assert p.stage_stats["faults"] == 1
+    finally:
+        os.environ.pop("RACON_TPU_FAULT_PLAN", None)
+        reset_fault_plan()
+    p.initialize()
+    p.polish()
+    assert p.stage_stats["faults"] == 0
+
+
+# ------------------------------------------------- TTY-aware progress bars
+class _FakeTTY(io.StringIO):
+    def isatty(self):
+        return True
+
+
+def _drive_bar(stream, ticks=40):
+    from racon_tpu.utils.logger import Logger
+
+    old = sys.stderr
+    sys.stderr = stream
+    try:
+        lg = Logger()
+        lg.log()
+        lg.bar_total(ticks)
+        for _ in range(ticks):
+            lg.bar("[phase] working")
+    finally:
+        sys.stderr = old
+    return stream.getvalue()
+
+
+def test_bar_non_tty_single_line():
+    out = _drive_bar(io.StringIO())
+    assert "\r" not in out
+    assert out.count("\n") == 1
+    assert out.startswith("[phase] working [====================] 100% ")
+
+
+def test_bar_tty_byte_identical_to_classic():
+    out = _drive_bar(_FakeTTY())
+    # the classic protocol: 19 \r redraws then the completion line
+    assert out.count("\r") == 19
+    assert out.startswith("[phase] working [=>                  ] 5%\r")
+    assert " 100% " in out and out.endswith("s\n")
+
+
+def test_bar_quiet_level_silent():
+    from racon_tpu.utils.logger import set_log_level
+
+    set_log_level("quiet")
+    try:
+        out = _drive_bar(io.StringIO())
+    finally:
+        set_log_level(None)
+    assert out == ""

@@ -18,6 +18,13 @@ to the per-window pass. Each cell passes when the injected run
     the injected stall, must set the pace),
   - leaving no orphaned racon-tpu worker thread behind.
 
+A 5th SERVE column runs each row's fault as a per-job fault plan against
+a live PolishServer (racon_tpu/serve/): the poisoned job must fail with
+a TYPED error response (DeviceError / DeviceTimeout / ChunkCorrupt — the
+job is submitted strict, so nothing degrades it away), the server must
+survive, and the NEXT clean job on the same warm server must reproduce
+the clean run's bytes exactly.
+
 Usage: python tools/faultcheck.py [--quick]
   --quick drops the hang cases (the slow rows; the pytest suite tags the
   same cases with the `slow`/`faults` markers so tier-1 skips them too).
@@ -123,10 +130,14 @@ def polish(paths, depth: int, aligner: int, timeout: float,
 
 
 def orphans(grace: float = 3.0) -> list[str]:
+    # racon-tpu-serve-* threads are the live job server's own pool
+    # (the serve column keeps one server up across the whole grid) —
+    # deliberately long-lived, not orphans of an injected run
     deadline = time.perf_counter() + grace
     while time.perf_counter() < deadline:
         alive = [t.name for t in threading.enumerate()
-                 if t.name.startswith("racon-tpu")]
+                 if t.name.startswith("racon-tpu")
+                 and not t.name.startswith("racon-tpu-serve")]
         if not alive:
             return []
         time.sleep(0.05)
@@ -230,6 +241,43 @@ def _run_cell(paths, clean, depth, aligner, spec, timeout,
             + (f" ({', '.join(extras)})" if extras else ""))
 
 
+def run_serve_cell(client, paths, clean, aligner, spec, timeout):
+    """One serve-column cell: the row's fault as a per-job plan, strict,
+    against the shared live server (see module docstring)."""
+    from racon_tpu.serve.client import JobFailed, ServeError
+
+    # the poisoned job must actually FAIL: no watchdog retry may absorb
+    # its one-shot fault (other columns set RETRIES=1; per-job faults
+    # are parsed fresh per submit, so only the retry knob leaks)
+    os.environ["RACON_TPU_DEVICE_RETRIES"] = "0"
+    opts = {"tpu_aligner_batches": aligner}
+    if timeout:
+        opts["tpu_device_timeout"] = timeout
+    t0 = time.perf_counter()
+    try:
+        client.submit(*paths, fault_plan=spec, strict=True, options=opts)
+        return "FAIL poisoned job succeeded"
+    except JobFailed as exc:
+        if exc.error_type not in ("DeviceError", "DeviceTimeout",
+                                  "ChunkCorrupt"):
+            return f"FAIL untyped failure ({exc.error_type})"
+        etype = exc.error_type
+    except ServeError as exc:
+        return f"FAIL {exc.code}: {exc}"
+    except Exception as exc:
+        return f"FAIL {type(exc).__name__}: {exc}"
+    if time.perf_counter() - t0 > WALL_CAP:
+        return f"FAIL over budget ({time.perf_counter() - t0:.0f}s)"
+    try:
+        after = client.submit(*paths,
+                              options={"tpu_aligner_batches": aligner})
+    except Exception as exc:
+        return f"FAIL server did not survive ({type(exc).__name__}: {exc})"
+    if after.fasta != clean[2, aligner]:
+        return "FAIL clean job after fault diverged"
+    return f"pass  {etype}, next clean"
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true",
@@ -261,21 +309,40 @@ def main() -> int:
         width = max(len(m[0]) for m in rows)
         print(f"{'injection point':<{width}}  depth0"
               f"{'':<30}depth2{'':<30}depth2+sched"
-              f"{'':<24}depth2+trace", file=sys.stderr)
+              f"{'':<24}depth2+trace{'':<24}serve", file=sys.stderr)
         # the 4th column runs with span tracing armed: the injected run
         # must additionally produce a valid Chrome trace whose
         # fault/quarantine instant events match the degradation counters
         columns = ((0, False, False), (2, False, False),
                    (2, True, False), (2, False, True))
-        for name, aligner, spec, timeout, _slow in rows:
-            cells = []
-            for depth, adaptive, traced in columns:
-                cell = run_cell(paths, clean, depth, aligner, spec,
-                                timeout, adaptive, trace=traced)
+        # the 5th column submits the fault as a per-job plan against ONE
+        # live warm server shared by every row — surviving the whole
+        # poisoned sequence is itself part of the gate
+        from racon_tpu.serve import PolishClient, PolishServer
+
+        serve_sock = os.path.join(tmp, "faultcheck.sock")
+        server = PolishServer(socket_path=serve_sock, workers=2,
+                              quality_threshold=-1.0,
+                              warmup=False).start()
+        client = PolishClient(socket_path=serve_sock)
+        try:
+            for name, aligner, spec, timeout, _slow in rows:
+                cells = []
+                for depth, adaptive, traced in columns:
+                    cell = run_cell(paths, clean, depth, aligner, spec,
+                                    timeout, adaptive, trace=traced)
+                    failures += cell.startswith("FAIL")
+                    cells.append(f"{cell:<36}")
+                cell = run_serve_cell(client, paths, clean, aligner,
+                                      spec, timeout)
                 failures += cell.startswith("FAIL")
                 cells.append(f"{cell:<36}")
-            print(f"{name:<{width}}  {''.join(cells)}", file=sys.stderr)
-    n_cells = len(columns) * len(rows)
+                print(f"{name:<{width}}  {''.join(cells)}",
+                      file=sys.stderr)
+        finally:
+            os.environ.pop("RACON_TPU_DEVICE_RETRIES", None)
+            server.drain(timeout=30)
+    n_cells = (len(columns) + 1) * len(rows)
     print(f"[faultcheck] {'FAIL' if failures else 'PASS'}: "
           f"{n_cells - failures}/{n_cells} cells green",
           file=sys.stderr)

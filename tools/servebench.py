@@ -1,0 +1,276 @@
+"""Serve-mode benchmark: warm server submits vs cold one-shot CLI runs.
+
+Starts a `PolishServer` (warmed on the benchmark's own inputs, so job
+shapes hit the warm jit caches exactly), submits N concurrent synthetic
+jobs through `PolishClient`, and compares against N sequential COLD CLI
+runs — fresh `python -m racon_tpu.cli` subprocesses, each paying
+interpreter + import + engine construction + compile, which is precisely
+the per-run tax the serve subsystem amortizes.
+
+Two warm phases measure two different claims:
+
+  - SEQUENTIAL warm submits (one at a time — the like-for-like twin of
+    the sequential cold runs, same machine utilization): their p50 is
+    the headline warm latency and must beat the cold p50;
+  - a CONCURRENT wave of N submits: cross-job batch rounds, queue-wait
+    vs execution breakdown, and batch occupancy — the multiplexing
+    story (concurrent p50 embeds queueing on an oversubscribed host, so
+    it is reported, not gated).
+
+Exit status is the acceptance check: 0 only when sequential warm p50
+beats cold p50, no warm job compiled anything (sched compile telemetry:
+the warm path recompiles NOTHING), and every warm job's FASTA equals
+the cold CLI bytes. `--json PATH` writes the summary as a bench-style
+artifact with `occupancy` / `metrics` fields alongside the serve
+numbers (the same field names bench.py publishes).
+
+    python tools/servebench.py --jobs 4 [--genome-kb 20] [--json out.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import gzip
+import json
+import os
+import statistics
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      "/tmp/racon_tpu_jax_cache")
+sys.path = [p for p in sys.path if "axon_site" not in p]
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+def build_dataset(tmpdir: str, genome_kb: int, coverage: int,
+                  read_len: int, seed: int):
+    """Synthetic ONT-style workload via synthbench's simulator (same
+    error model as the scale bench, so serve numbers are comparable)."""
+    import random
+
+    from synthbench import simulate
+
+    rng = random.Random(seed)
+    _, draft, reads, paf = simulate(rng, genome_kb * 1000, coverage,
+                                    read_len, 0.12, 0.10)
+    paths = (os.path.join(tmpdir, "reads.fasta.gz"),
+             os.path.join(tmpdir, "ovl.paf.gz"),
+             os.path.join(tmpdir, "draft.fasta.gz"))
+    with gzip.open(paths[0], "wb", compresslevel=1) as f:
+        for name, read in reads:
+            f.write(b">" + name.encode() + b"\n" + read + b"\n")
+    with gzip.open(paths[1], "wb", compresslevel=1) as f:
+        f.write(("\n".join(paf) + "\n").encode())
+    with gzip.open(paths[2], "wb", compresslevel=1) as f:
+        f.write(b">draft\n" + draft + b"\n")
+    return paths
+
+
+def cold_cli_run(paths, args) -> tuple[float, bytes]:
+    """One fresh-process CLI run: the full cold tax, wall-clocked."""
+    env = {k: v for k, v in os.environ.items() if "axon" not in k.lower()}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = os.pathsep.join(
+        [REPO] + [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+                  if p and "axon_site" not in p])
+    cmd = [sys.executable, "-m", "racon_tpu.cli",
+           "-t", str(args.threads)]
+    if args.tpupoa_batches:
+        cmd += ["-c", str(args.tpupoa_batches)]
+    if args.tpualigner_batches:
+        cmd += ["--tpualigner-batches", str(args.tpualigner_batches)]
+    cmd += list(paths)
+    t0 = time.perf_counter()
+    proc = subprocess.run(cmd, env=env, capture_output=True)
+    dt = time.perf_counter() - t0
+    if proc.returncode != 0:
+        print(proc.stderr.decode(errors="replace"), file=sys.stderr)
+        raise SystemExit(f"[servebench] cold CLI run failed "
+                         f"(rc {proc.returncode})")
+    return dt, proc.stdout
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--jobs", type=int, default=4,
+                    help="concurrent warm submissions")
+    ap.add_argument("--cold-runs", type=int, default=None,
+                    help="sequential cold CLI runs to time "
+                         "(default min(jobs, 3))")
+    ap.add_argument("--genome-kb", type=int, default=20)
+    ap.add_argument("--coverage", type=int, default=20)
+    ap.add_argument("--read-len", type=int, default=4000)
+    ap.add_argument("--seed", type=int, default=42)
+    ap.add_argument("-t", "--threads", type=int, default=2)
+    ap.add_argument("-c", "--tpupoa-batches", type=int, default=0)
+    ap.add_argument("--tpualigner-batches", type=int, default=0)
+    ap.add_argument("--workers", type=int, default=2)
+    ap.add_argument("--json", default=None,
+                    help="write the bench-style JSON artifact here")
+    args = ap.parse_args(argv)
+
+    from racon_tpu.serve import PolishClient, PolishServer
+
+    cold_n = args.cold_runs if args.cold_runs is not None \
+        else min(args.jobs, 3)
+
+    with tempfile.TemporaryDirectory(prefix="racon_servebench_") as tmp:
+        print(f"[servebench] simulating {args.genome_kb} kb at "
+              f"{args.coverage}x ...", file=sys.stderr)
+        paths = build_dataset(tmp, args.genome_kb, args.coverage,
+                              args.read_len, args.seed)
+
+        # ---- cold: N sequential fresh-process CLI runs
+        cold_s: list[float] = []
+        cold_out = None
+        for i in range(cold_n):
+            dt, out = cold_cli_run(paths, args)
+            cold_s.append(dt)
+            cold_out = out
+            print(f"[servebench] cold run {i + 1}/{cold_n}: {dt:.2f}s",
+                  file=sys.stderr)
+
+        # ---- warm: one server, N concurrent submissions
+        sock = os.path.join(tmp, "serve.sock")
+        server = PolishServer(
+            socket_path=sock, workers=args.workers, warmup=False,
+            job_threads=args.threads,
+            tpu_poa_batches=args.tpupoa_batches,
+            tpu_aligner_batches=args.tpualigner_batches)
+        t0 = time.perf_counter()
+        server.warmup(paths=paths)  # warm on the SAME shapes jobs use
+        server.start()
+        warm_ready_s = time.perf_counter() - t0
+        print(f"[servebench] server warm in {warm_ready_s:.2f}s "
+              f"({server._warm['compiles']} compiles "
+              f"{server._warm['compile_s']:.2f}s)", file=sys.stderr)
+
+        client = PolishClient(socket_path=sock)
+
+        # ---- warm sequential: like-for-like vs the cold runs
+        seq_s: list[float] = []
+        seq_results: list = []
+        for i in range(cold_n):
+            t0 = time.perf_counter()
+            seq_results.append(client.submit(*paths))
+            seq_s.append(time.perf_counter() - t0)
+            print(f"[servebench] warm seq run {i + 1}/{cold_n}: "
+                  f"{seq_s[-1]:.2f}s", file=sys.stderr)
+
+        # ---- warm concurrent wave: the multiplexing story
+        results: list = [None] * args.jobs
+        latencies: list = [0.0] * args.jobs
+
+        def submit(i):
+            t = time.perf_counter()
+            results[i] = client.submit(*paths, retries=5)
+            latencies[i] = time.perf_counter() - t
+
+        threads = [threading.Thread(target=submit, args=(i,))
+                   for i in range(args.jobs)]
+        t_wave = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wave_s = time.perf_counter() - t_wave
+
+        snap = server.stats_snapshot()
+        server.drain(timeout=30)
+
+    # ---- analysis
+    fail: list[str] = []
+    all_results = seq_results + results
+    warm_sorted = sorted(latencies)
+    p50 = warm_sorted[len(warm_sorted) // 2]
+    p95 = warm_sorted[min(len(warm_sorted) - 1,
+                          int(len(warm_sorted) * 0.95))]
+    seq_p50 = sorted(seq_s)[len(seq_s) // 2]
+    cold_p50 = sorted(cold_s)[len(cold_s) // 2]
+    compiles_per_job = [
+        (r.serve.get("batch") or {}).get("compiles", 0)
+        for r in all_results]
+    queue_waits = [r.serve["queue_wait_s"] for r in results]
+    exec_s = [r.serve["exec_s"] for r in results]
+
+    if cold_out is not None and any(r.fasta != cold_out
+                                    for r in all_results):
+        fail.append("warm output diverged from cold CLI bytes")
+    if any(compiles_per_job):
+        fail.append(f"warm jobs compiled: {compiles_per_job}")
+    if seq_p50 >= cold_p50:
+        fail.append(f"warm p50 {seq_p50:.2f}s did not beat cold p50 "
+                    f"{cold_p50:.2f}s")
+
+    b = snap["batcher"]
+    print(f"[servebench] warm sequential: p50 {seq_p50:.2f}s vs cold "
+          f"p50 {cold_p50:.2f}s (speedup "
+          f"x{cold_p50 / max(seq_p50, 1e-9):.1f}) "
+          f"[{'OK' if seq_p50 < cold_p50 else 'FAIL'}]", file=sys.stderr)
+    print(f"[servebench] warm concurrent: {args.jobs} jobs in "
+          f"{wave_s:.2f}s ({wave_s / args.jobs:.2f}s/job) — latency "
+          f"p50 {p50:.2f}s p95 {p95:.2f}s mean "
+          f"{statistics.mean(latencies):.2f}s", file=sys.stderr)
+    print(f"[servebench] cold: {len(cold_s)} runs — p50 {cold_p50:.2f}s "
+          f"mean {statistics.mean(cold_s):.2f}s", file=sys.stderr)
+    print(f"[servebench] compiles/job after warmup: {compiles_per_job} "
+          f"[{'OK' if not any(compiles_per_job) else 'FAIL'} target 0]",
+          file=sys.stderr)
+    print(f"[servebench] queue wait mean {statistics.mean(queue_waits):.3f}s "
+          f"max {max(queue_waits):.3f}s; exec mean "
+          f"{statistics.mean(exec_s):.3f}s", file=sys.stderr)
+    print(f"[servebench] batch rounds: {b['rounds']} "
+          f"({b['multi_job_rounds']} cross-job, max "
+          f"{b['max_jobs_in_round']} jobs/round)", file=sys.stderr)
+    for engine, e in (b.get("occupancy") or {}).items():
+        if e.get("buckets"):
+            print(f"[servebench] {engine} occupancy "
+                  f"{e['occupancy_pct']:.1f}% across "
+                  f"{len(e['buckets'])} shapes", file=sys.stderr)
+
+    if args.json:
+        artifact = {
+            "mode": "serve",
+            "jobs": args.jobs,
+            "warm": {"seq_p50_s": round(seq_p50, 3),
+                     "p50_s": round(p50, 3), "p95_s": round(p95, 3),
+                     "mean_s": round(statistics.mean(latencies), 3),
+                     "wave_s": round(wave_s, 3),
+                     "warmup_s": round(warm_ready_s, 3),
+                     "queue_wait_mean_s": round(
+                         statistics.mean(queue_waits), 4),
+                     "compiles_per_job": compiles_per_job},
+            "cold": {"runs": len(cold_s),
+                     "p50_s": round(cold_p50, 3),
+                     "mean_s": round(statistics.mean(cold_s), 3)},
+            "speedup_p50": round(cold_p50 / max(seq_p50, 1e-9), 2),
+            "batch_rounds": {k: b[k] for k in
+                             ("rounds", "multi_job_rounds", "jobs",
+                              "windows", "max_jobs_in_round")},
+            "occupancy": b.get("occupancy", {}),
+            "metrics": {"queue": snap["queue"],
+                        "batcher": {k: v for k, v in b.items()
+                                    if k != "occupancy"}},
+            "pass": not fail,
+        }
+        with open(args.json, "w") as fh:
+            json.dump(artifact, fh, indent=2, sort_keys=True)
+        print(f"[servebench] wrote {args.json}", file=sys.stderr)
+
+    if fail:
+        for f in fail:
+            print(f"[servebench] FAIL: {f}", file=sys.stderr)
+        return 1
+    print("[servebench] PASS", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
