@@ -497,6 +497,26 @@ def main() -> int:
         **stage_fields,
         **aligner_fields,
     }))
+    # optional perf regression gate (tools/perfgate.py): stderr verdict
+    # only — the JSON-line contract above is the artifact either way,
+    # and a gate bug must never cost the round its number
+    if os.environ.get("RACON_TPU_PERFGATE"):
+        try:
+            sys.path.insert(0, os.path.join(
+                os.path.dirname(os.path.abspath(__file__)), "tools"))
+            import perfgate
+
+            ok, delta = perfgate.gate(
+                wps, REFERENCE_CPU_WINDOWS_PER_SEC,
+                float(os.environ.get("RACON_TPU_PERFGATE_TOL", "10")),
+                higher_better=True)
+            print(f"[bench] perfgate {'PASS' if ok else 'FAIL'}: "
+                  f"{wps:.2f} windows/sec vs reference-CPU baseline "
+                  f"{REFERENCE_CPU_WINDOWS_PER_SEC:g} ({delta:+.1f}%)",
+                  file=sys.stderr)
+        except Exception as exc:
+            print(f"[bench] perfgate unavailable ({exc})",
+                  file=sys.stderr)
     return 0
 
 

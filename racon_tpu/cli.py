@@ -23,7 +23,9 @@ usage: racon_tpu [options ...] <sequences> <overlaps> <target sequences>
     and the README "Serving" section):
         serve   run the warm polishing job server (one process keeps the
                 engines compiled; jobs from many clients share device
-                batches)
+                batches; live Prometheus metrics via the `scrape` RPC
+                or `--metrics-port`, post-mortems via the always-on
+                flight recorder and the `debug` RPC)
         submit  send one polishing job to a running server; polished
                 FASTA on stdout, byte-identical to the one-shot run
 

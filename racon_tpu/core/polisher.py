@@ -137,9 +137,16 @@ class Polisher:
         # pipelines (pack / device / unpack / fallback seconds, launch and
         # chunk counts) — the observability half of the overlap design;
         # bench.py emits the snapshot in its JSON artifact
+        from ..obs.hist import HistogramSet
         from ..pipeline import PipelineStats
 
-        self.pipeline_stats = PipelineStats()
+        # per-run latency histograms (obs/hist.py): per-chunk pipeline
+        # stage durations, per-engine compile stalls and the polisher
+        # phase durations, snapshotted as the metrics registry's
+        # `latency` namespace — the serve layer folds each job's set
+        # into its lifetime scrape view
+        self.hists = HistogramSet()
+        self.pipeline_stats = PipelineStats(hists=self.hists)
         # the occupancy-aware batch scheduler (racon_tpu/sched/), shared
         # by the aligner and whichever consensus engine runs: adaptive
         # ladders + sorted packing when armed (CLI flag winning over
@@ -151,6 +158,7 @@ class Polisher:
         self.scheduler = BatchScheduler.from_env(
             adaptive=tpu_adaptive_buckets,
             compile_cache=tpu_compile_cache)
+        self.scheduler.stats.hists = self.hists
 
         self.sequences: list[Sequence] = []
         self.windows: list[Window] = []
@@ -188,6 +196,7 @@ class Polisher:
         # follow it
         self.metrics.register("sched",
                               lambda: self.scheduler.stats.snapshot())
+        self.metrics.register("latency", lambda: self.hists.snapshot())
         self.metrics.register(
             "aligner", lambda: {
                 "pairs": self.n_aligner_pairs,
@@ -237,11 +246,14 @@ class Polisher:
         process run (tests/test_serve.py pins both). Engines, jit caches
         and the compile-cache posture are process-level and deliberately
         stay warm."""
+        from ..obs.hist import HistogramSet
         from ..pipeline import PipelineStats
         from ..sched import OccupancyStats
 
-        self.pipeline_stats = PipelineStats()
+        self.hists = HistogramSet()
+        self.pipeline_stats = PipelineStats(hists=self.hists)
         self.scheduler.stats = OccupancyStats()
+        self.scheduler.stats.hists = self.hists
         self.n_aligner_pairs = 0
         self.n_aligner_device = 0
         self.n_aligner_host_fallback = 0
@@ -424,6 +436,8 @@ class Polisher:
             o.breaking_points = None
 
         log.log("[racon_tpu::Polisher.initialize] transformed data into windows")
+        self.hists.observe("phase.initialize",
+                           time.perf_counter() - t_init)
         tr = trace.get_tracer()
         if tr is not None:
             tr.complete("polisher.initialize", t_init, time.perf_counter(),
@@ -650,6 +664,7 @@ class Polisher:
 
         t_stitch = _time.perf_counter()
         dst = self._stitch(drop_unpolished_sequences)
+        self.hists.observe("phase.stitch", _time.perf_counter() - t_stitch)
         tr = trace.get_tracer()
         if tr is not None:
             tr.complete("polisher.stitch", t_stitch, _time.perf_counter(),
@@ -694,6 +709,7 @@ class Polisher:
         with profile_ctx, pipeline:
             engine.generate_consensus(self.windows, self.trim)
         dt = _time.perf_counter() - t_consensus
+        self.hists.observe("phase.consensus", dt)
         tr = trace.get_tracer()
         if tr is not None:
             tr.complete("polisher.consensus", t_consensus,

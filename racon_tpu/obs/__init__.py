@@ -19,20 +19,34 @@ output and stderr stay byte-identical:
 `jax_profile(phase)` is the optional deep-dive hook: a context manager
 bracketing a device phase with `jax.profiler` when RACON_TPU_PROFILE /
 `--tpu-jax-profile <dir>` names a directory, and a silent no-op when the
-profiler is unavailable on the backend."""
+profiler is unavailable on the backend.
+
+The serve-grade additions (PR 6) build on the same pillars:
+
+  4. LATENCY HISTOGRAMS (`obs.hist`): log-bucketed, thread-safe
+     `Histogram` / `HistogramSet` — p50/p95/p99/max for pipeline stage
+     durations, job latency, queue wait, gather wait, compiles.
+  5. PROMETHEUS EXPOSITION (`obs.prom`): stdlib-only text-format
+     rendering behind the serve layer's `scrape` RPC and optional
+     localhost HTTP endpoint.
+  6. FLIGHT RECORDER (`obs.flight`): an always-on bounded ring of
+     recent spans (a `TraceRecorder` with deque buffers) the serve
+     layer dumps as a Chrome-trace artifact when a job fails, times
+     out, or misses its deadline."""
 
 from __future__ import annotations
 
 import os
 
 from . import trace
+from .hist import Histogram, HistogramSet
 from .metrics import MetricsRegistry
 from ..utils.logger import (log_debug, log_info, log_level, warn_dedup,
                             flush_dedup)
 
-__all__ = ["trace", "MetricsRegistry", "jax_profile",
-           "log_debug", "log_info", "log_level", "warn_dedup",
-           "flush_dedup"]
+__all__ = ["trace", "MetricsRegistry", "Histogram", "HistogramSet",
+           "jax_profile", "log_debug", "log_info", "log_level",
+           "warn_dedup", "flush_dedup"]
 
 
 class _SafeJaxProfile:

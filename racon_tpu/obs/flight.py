@@ -1,0 +1,120 @@
+"""Flight recorder: an always-on bounded ring of recent spans.
+
+Span tracing (obs/trace.py) answers "where did the time go" — but only
+when someone armed it BEFORE the interesting run, and its buffers grow
+without bound, so a long-lived server cannot simply leave it on. The
+flight recorder closes exactly that gap, the way aircraft FDRs and
+inference servers' request recorders do:
+
+  - `FlightRecorder` IS a `TraceRecorder` whose events land in ONE
+    shared bounded ring (`collections.deque(maxlen=...)` — atomic
+    appends under the GIL, no extra lock on the hot path): every
+    existing instrumentation site — pipeline stage spans, engine
+    rounds, XLA compiles, resilience instants — feeds it unchanged,
+    spans keep the exact perf_counter endpoints the stage counters
+    charge (so span sums still pin to stage_stats), and memory is a
+    hard constant (`capacity` events total, RACON_TPU_FLIGHT_EVENTS,
+    default 4096). Old events fall off the back; the recent past is
+    always there. Unlike the base recorder, track ids are keyed by
+    THREAD NAME, not per registration: a long-lived server spawns
+    fresh pack/unpack/fallback threads per job, and per-registration
+    buffers would accumulate one dead ring per thread forever — the
+    name set (`racon-tpu-pack`, `racon-tpu-serve-worker-0`, ...) is
+    small and stable, so both the ring and the track table stay
+    bounded for the process lifetime.
+  - The serve layer installs one at startup when no full trace is armed
+    (server.py), leaves it on for the process lifetime — the measured
+    recording overhead is the same <2% budget as tracing
+    (`tools/synthbench.py --flight` A/Bs it) — and DUMPS it when a job
+    fails, times out, or misses its deadline: `dump()` writes a valid
+    Chrome trace-event JSON (loadable in Perfetto) windowed to the job,
+    with the job's identity, error and stage_stats snapshot riding as a
+    top-level `flight` object. The `debug` RPC returns the same recent
+    events on demand for a live post-mortem.
+
+`dump()` is a module function over ANY TraceRecorder, not a method:
+when a full trace is armed (RACON_TPU_TRACE), the server reuses that
+recorder as its flight source and dump/debug work identically."""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from collections import deque
+
+from .trace import TraceRecorder
+
+#: default total ring capacity (events); a span dict is ~200 bytes, so
+#: the default bounds the recorder around ~1 MB for the process lifetime
+DEFAULT_CAPACITY = 4096
+
+
+def ring_capacity() -> int:
+    try:
+        n = int(os.environ.get("RACON_TPU_FLIGHT_EVENTS", 0))
+    except ValueError:
+        n = 0
+    return n if n > 0 else DEFAULT_CAPACITY
+
+
+class FlightRecorder(TraceRecorder):
+    """TraceRecorder with one shared bounded ring (see module
+    docstring): constant memory and constant `events()` cost no matter
+    how many short-lived threads record into it."""
+
+    def __init__(self, capacity: int | None = None):
+        super().__init__(path=None)
+        self.capacity = capacity if capacity else ring_capacity()
+        # deque.append evicts the oldest event once full — O(1) and
+        # atomic under the GIL, so concurrent recorders need no lock
+        self._ring: deque = deque(maxlen=self.capacity)
+        self._buffers.append(self._ring)  # base events() reads it
+        self._name_tids: dict[str, int] = {}
+
+    def _buf(self) -> deque:
+        # tid keyed by thread NAME (bounded, stable set) instead of the
+        # base class's per-registration tid (one dead buffer per thread
+        # the server ever spawned — the leak this class exists to avoid)
+        tid = getattr(self._local, "tid", None)
+        if tid is None:
+            name = threading.current_thread().name
+            with self._lock:
+                tid = self._name_tids.get(name)
+                if tid is None:
+                    tid = self._next_tid
+                    self._next_tid += 1
+                    self._name_tids[name] = tid
+                    self._threads[tid] = name
+            self._local.tid = tid
+        return self._ring
+
+
+def window_events(recorder: TraceRecorder,
+                  since: float | None = None) -> list[dict]:
+    """The recorder's events, keeping thread-name metadata but dropping
+    spans/instants that START before `since` (a perf_counter timestamp,
+    the clock every span already uses) — the "this job's window" filter
+    for per-job dumps. None = everything still in the ring."""
+    events = recorder.events()
+    if since is None:
+        return events
+    cut = recorder._us(since)
+    return [ev for ev in events
+            if ev.get("ph") == "M" or ev.get("ts", 0.0) >= cut]
+
+
+def dump(recorder: TraceRecorder, path: str,
+         since: float | None = None,
+         flight: dict | None = None) -> str:
+    """Write the ring (optionally windowed to `since`) as Chrome
+    trace-event JSON. `flight` rides as an extra top-level object
+    (job id / reason / error / stage_stats) — Perfetto ignores unknown
+    top-level keys, so the artifact stays loadable AND self-describing."""
+    doc = {"traceEvents": window_events(recorder, since),
+           "displayTimeUnit": "ms"}
+    if flight:
+        doc["flight"] = flight
+    with open(path, "w") as fh:
+        json.dump(doc, fh)
+    return path

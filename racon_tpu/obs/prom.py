@@ -1,0 +1,128 @@
+"""Prometheus text exposition (stdlib-only, text format 0.0.4).
+
+The warm server's telemetry was reachable only as a JSON `stats`
+snapshot — fine for a human with socat, invisible to a scrape-based
+monitoring stack. This module renders counters, gauges and the
+log-bucketed histograms (obs/hist.py) as the Prometheus text format
+every scraper (Prometheus, VictoriaMetrics, Grafana agent, `curl`)
+already speaks:
+
+    # TYPE racon_tpu_serve_jobs_completed_total counter
+    racon_tpu_serve_jobs_completed_total 42
+    # TYPE racon_tpu_job_latency_seconds histogram
+    racon_tpu_job_latency_seconds_bucket{le="0.25"} 12
+    ...
+    racon_tpu_job_latency_seconds_bucket{le="+Inf"} 42
+    racon_tpu_job_latency_seconds_sum 13.9
+    racon_tpu_job_latency_seconds_count 42
+
+No client library, no registry singletons: callers hand `render()` the
+numbers they already have (the serve stats snapshot, a HistogramSet) and
+get back one scrape body. serve/server.py exposes it on the `scrape`
+frame RPC and on the optional localhost HTTP port
+(RACON_TPU_SERVE_METRICS_PORT / `racon_tpu serve --metrics-port`)."""
+
+from __future__ import annotations
+
+import re
+
+from .hist import Histogram, HistogramSet
+
+_NAME_OK = re.compile(r"[^a-zA-Z0-9_:]")
+
+#: every exposed series is namespaced under this prefix
+PREFIX = "racon_tpu_"
+
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+def metric_name(name: str) -> str:
+    """Sanitize a dotted internal name ("pipeline.pack") into a legal
+    Prometheus metric name ("racon_tpu_pipeline_pack")."""
+    clean = _NAME_OK.sub("_", name.replace(".", "_")).strip("_")
+    return PREFIX + clean
+
+
+def _fmt(v) -> str:
+    if v is None:
+        return "0"
+    if isinstance(v, bool):
+        return "1" if v else "0"
+    if isinstance(v, float):
+        if v != v:  # NaN
+            return "NaN"
+        if v == float("inf"):
+            return "+Inf"
+        return repr(v)
+    return str(v)
+
+
+def _le(edge: float) -> str:
+    return "+Inf" if edge == float("inf") else repr(edge)
+
+
+def counter_lines(name: str, value, help_: str | None = None) -> list[str]:
+    n = metric_name(name)
+    if not n.endswith("_total"):
+        n += "_total"
+    out = []
+    if help_:
+        out.append(f"# HELP {n} {help_}")
+    out.append(f"# TYPE {n} counter")
+    out.append(f"{n} {_fmt(value)}")
+    return out
+
+
+def gauge_lines(name: str, value, help_: str | None = None) -> list[str]:
+    n = metric_name(name)
+    out = []
+    if help_:
+        out.append(f"# HELP {n} {help_}")
+    out.append(f"# TYPE {n} gauge")
+    out.append(f"{n} {_fmt(value)}")
+    return out
+
+
+def histogram_lines(name: str, hist: Histogram,
+                    help_: str | None = None) -> list[str]:
+    """Classic cumulative-bucket exposition; `_seconds` unit suffix is
+    appended because every histogram in this codebase observes wall
+    seconds."""
+    n = metric_name(name)
+    if not n.endswith("_seconds"):
+        n += "_seconds"
+    out = []
+    if help_:
+        out.append(f"# HELP {n} {help_}")
+    out.append(f"# TYPE {n} histogram")
+    # one atomic export: buckets/_sum/_count must be mutually
+    # consistent within a scrape even under concurrent observe
+    buckets, count, total = hist.export()
+    for edge, cum in buckets:
+        out.append(f'{n}_bucket{{le="{_le(edge)}"}} {cum}')
+    out.append(f"{n}_sum {_fmt(total)}")
+    out.append(f"{n}_count {count}")
+    return out
+
+
+def render(counters: dict | None = None, gauges: dict | None = None,
+           hists: HistogramSet | None = None) -> str:
+    """One scrape body. `counters` / `gauges` map dotted names to
+    numbers (or to (value, help) pairs); `hists` contributes every
+    histogram it holds. Ends with the trailing newline the text format
+    requires."""
+    lines: list[str] = []
+    for name, value in sorted((counters or {}).items()):
+        help_ = None
+        if isinstance(value, tuple):
+            value, help_ = value
+        lines.extend(counter_lines(name, value, help_))
+    for name, value in sorted((gauges or {}).items()):
+        help_ = None
+        if isinstance(value, tuple):
+            value, help_ = value
+        lines.extend(gauge_lines(name, value, help_))
+    if hists is not None:
+        for name, hist in hists.items():
+            lines.extend(histogram_lines(name, hist))
+    return "\n".join(lines) + "\n"

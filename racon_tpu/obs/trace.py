@@ -92,6 +92,8 @@ class TraceRecorder:
             # the OS reuses idents, so the consensus phase's workers
             # would land on (and relabel) the dead align-phase workers'
             # tracks — every registered thread gets its own track
+            # (obs/flight.py overrides this with a shared bounded ring
+            # and name-keyed tids)
             t = threading.current_thread()
             buf = self._local.buf = []
             with self._lock:
@@ -188,11 +190,52 @@ def configure(path: str | None = None) -> TraceRecorder:
     return _tracer
 
 
+def install(recorder: TraceRecorder) -> TraceRecorder:
+    """Arm a caller-built recorder (e.g. the serve layer's bounded
+    FlightRecorder, obs/flight.py) as the process tracer — every
+    existing hook starts feeding it. Returns the recorder."""
+    global _tracer, _resolved
+    _tracer = recorder
+    _resolved = True
+    return recorder
+
+
 def reset() -> None:
     """Drop the tracer and the env resolution (tests re-arm per case)."""
     global _tracer, _resolved
     _tracer = None
     _resolved = False
+
+
+class _TeeRecorder:
+    """Duck-typed recorder forwarding every event to several recorders
+    — how a scoped per-job trace coexists with an already-armed
+    process recorder (the serve layer's always-on flight ring,
+    obs/flight.py): the job gets its own events AND the ring keeps
+    recording, so a concurrent job's post-mortem dump has no blind
+    window. Only the recording surface (`complete`/`instant`/`span`)
+    fans out; `events`/`save` delegate to the primary recorder."""
+
+    def __init__(self, primary: TraceRecorder, *others: TraceRecorder):
+        self._recs = (primary,) + others
+        self.path = primary.path
+
+    def complete(self, name, t0, t1, args=None) -> None:
+        for rec in self._recs:
+            rec.complete(name, t0, t1, args)
+
+    def instant(self, name, args=None) -> None:
+        for rec in self._recs:
+            rec.instant(name, args)
+
+    def span(self, name: str, **args) -> _Span:
+        return _Span(self, name, args or None)
+
+    def events(self) -> list[dict]:
+        return self._recs[0].events()
+
+    def save(self, path: str | None = None) -> str:
+        return self._recs[0].save(path)
 
 
 class scoped:
@@ -201,6 +244,10 @@ class scoped:
     layer's per-job trace scoping. The recorder is process-global for
     the duration, so spans from concurrent jobs sharing the process land
     in it too (one process, shared device: documented, not hidden).
+    When a recorder is ALREADY armed (the always-on flight ring, or an
+    RACON_TPU_TRACE trace), the scope installs a tee so the outer
+    recorder keeps seeing every span — a traced job must not open a
+    blind window in a concurrent job's flight dump.
 
     Scopes SERIALIZE on a module lock: the save/restore of the global
     tracer is not reentrant (overlapping scopes restoring out of order
@@ -212,9 +259,11 @@ class scoped:
     def __enter__(self) -> TraceRecorder:
         global _tracer, _resolved
         self._lock.acquire()
+        prev = get_tracer()  # resolve the env posture BEFORE saving it
         self._prev = (_tracer, _resolved)
         rec = TraceRecorder(None)
-        _tracer, _resolved = rec, True
+        _tracer = rec if prev is None else _TeeRecorder(rec, prev)
+        _resolved = True
         return rec
 
     def __exit__(self, *exc_info) -> None:

@@ -70,6 +70,17 @@ _STOP = object()
 _INSTANT_KEYS = frozenset(("faults", "retries", "timeouts",
                            "breaker_trips", "quarantined", "cancelled"))
 
+#: stage-seconds keys mirrored into latency histograms when a
+#: HistogramSet is attached (obs/hist.py): each bump is one chunk's
+#: stage duration, so the histogram is the per-chunk distribution of
+#: the same wall-clock the counters total. device_s is NOT here: it is
+#: bumped twice per chunk (dispatch + wait segments), so the loops
+#: observe `pipeline.device` themselves as the per-chunk SUM — one
+#: sample per chunk, comparable with the other stages
+_HIST_KEYS = {"pack_s": "pipeline.pack",
+              "unpack_s": "pipeline.unpack",
+              "fallback_s": "pipeline.fallback"}
+
 
 class PipelineStats:
     """Thread-safe per-stage counters, shareable across pipeline phases.
@@ -87,14 +98,22 @@ class PipelineStats:
                  "quarantined", "cancelled")
     KEYS = _FLOAT_KEYS + _INT_KEYS
 
-    def __init__(self):
+    def __init__(self, hists=None):
         self._lock = threading.Lock()
         self._v = {k: 0.0 for k in self._FLOAT_KEYS}
         self._v.update({k: 0 for k in self._INT_KEYS})
+        #: optional obs.hist.HistogramSet: per-chunk stage durations
+        #: observed as latency distributions (None — one `is None`
+        #: check per bump — when nothing is watching)
+        self.hists = hists
 
     def bump(self, key: str, amount=1) -> None:
         with self._lock:
             self._v[key] += amount
+        if self.hists is not None:
+            name = _HIST_KEYS.get(key)
+            if name is not None:
+                self.hists.observe(name, amount)
         if key in _INSTANT_KEYS:
             tr = trace.get_tracer()
             if tr is not None:
@@ -232,7 +251,8 @@ class DispatchPipeline:
                 t0 = time.perf_counter()
                 handle = dispatch(item, ops)
                 t1 = time.perf_counter()
-                stats.bump("device_s", t1 - t0)
+                disp_dt = t1 - t0
+                stats.bump("device_s", disp_dt)
                 stats.bump("chunks")
                 if tr is not None:
                     tr.complete("pipeline.device", t0, t1,
@@ -241,6 +261,9 @@ class DispatchPipeline:
                 res = wait(handle)
                 t1 = time.perf_counter()
                 stats.bump("device_s", t1 - t0)
+                if stats.hists is not None:
+                    stats.hists.observe("pipeline.device",
+                                        disp_dt + (t1 - t0))
                 if tr is not None:
                     tr.complete("pipeline.device", t0, t1,
                                 dict(args_of(idx, item), seg="wait"))
@@ -305,12 +328,15 @@ class DispatchPipeline:
                     return
                 if abort.is_set():
                     continue
-                idx, item, handle = entry
+                idx, item, handle, disp_dt = entry
                 try:
                     t0 = time.perf_counter()
                     res = wait(handle)
                     t1 = time.perf_counter()
                     stats.bump("device_s", t1 - t0)
+                    if stats.hists is not None:
+                        stats.hists.observe("pipeline.device",
+                                            disp_dt + (t1 - t0))
                     if tr is not None:
                         tr.complete("pipeline.device", t0, t1,
                                     dict(args_of(idx, item), seg="wait"))
@@ -361,7 +387,7 @@ class DispatchPipeline:
                 except Exception as exc:
                     guard(item, exc)
                     continue
-                waiting_q.put((idx, item, handle))
+                waiting_q.put((idx, item, handle, t1 - t0))
         except BaseException:
             # exceptional exit (KeyboardInterrupt is the real case): the
             # workers may be blocked on the bounded queues, so a plain

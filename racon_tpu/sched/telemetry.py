@@ -49,6 +49,11 @@ class OccupancyStats:
         self._lock = threading.Lock()
         self._buckets: dict[tuple[str, str], dict] = {}
         self._compiles: dict[str, dict] = {}
+        #: optional obs.hist.HistogramSet: per-engine compile wall time
+        #: observed as a latency distribution (`compile.<engine>`) —
+        #: the "how long does a new shape stall a round" view the serve
+        #: scrape exposes; None when nothing is watching
+        self.hists = None
 
     def record(self, engine: str, bucket, jobs: int, lanes: int,
                useful_cells: int, total_cells: int) -> None:
@@ -75,6 +80,8 @@ class OccupancyStats:
                 engine, {"compiles": 0, "compile_s": 0.0})
             c["compiles"] += count
             c["compile_s"] += float(seconds)
+        if self.hists is not None:
+            self.hists.observe(f"compile.{engine}", float(seconds))
 
     def record_compile_once(self, engine: str, key,
                             seconds: float) -> bool:

@@ -83,6 +83,10 @@ class WindowBatcher:
         #: — a lone job must not idle out the window for company that
         #: cannot arrive
         self.active_hint = None
+        #: optional obs.hist.HistogramSet (the server's lifetime set):
+        #: leader gather waits and device round durations observed as
+        #: latency distributions for the scrape view
+        self.hists = None
         self._exec_lock = threading.Lock()
         self._round_seq = itertools.count()
         self.counters = {"rounds": 0, "solo_rounds": 0,
@@ -101,8 +105,12 @@ class WindowBatcher:
             # isolation round: injected faults / strict posture stay on
             # this job's own pipeline and never touch a shared batch
             rnd = next(self._round_seq)
+            t0 = time.perf_counter()
             with self._exec_lock:
                 polisher._consensus_pass()
+            if self.hists is not None:
+                self.hists.observe("serve.round",
+                                   time.perf_counter() - t0)
             self._account(1, len(polisher.windows), solo=True)
             polisher.serve_round = {"round": rnd, "jobs": 1,
                                     "windows": len(polisher.windows),
@@ -120,7 +128,8 @@ class WindowBatcher:
         if not leader:
             ticket.event.wait()
         else:
-            deadline = time.monotonic() + self.gather_window_s
+            t_gather = time.monotonic()
+            deadline = t_gather + self.gather_window_s
             hint = self.active_hint
             with self._cond:
                 while len(self._pending[key]) < self.min_gather:
@@ -132,6 +141,9 @@ class WindowBatcher:
                         break
                     self._cond.wait(left)
                 batch = self._pending.pop(key)
+                if self.hists is not None:
+                    self.hists.observe("serve.gather_wait",
+                                       time.monotonic() - t_gather)
                 # release the key BEFORE executing: tickets arriving
                 # mid-round start gathering the next round immediately
                 self._leading.discard(key)
@@ -184,6 +196,8 @@ class WindowBatcher:
                 tr.complete("serve.batch_round", t0, t1,
                             {"round": rnd, "jobs": len(tickets),
                              "windows": len(windows)})
+            if self.hists is not None:
+                self.hists.observe("serve.round", t1 - t0)
         except BaseException as exc:
             # a shared-round failure fails every participant the same
             # way a solo run would have (strict-off degradation happens

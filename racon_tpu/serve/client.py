@@ -151,6 +151,17 @@ class PolishClient:
     def stats(self) -> dict:
         return self.request({"type": "stats"})
 
+    def scrape(self) -> str:
+        """Live Prometheus text exposition (the same body the optional
+        `--metrics-port` HTTP endpoint serves) — counters, gauges and
+        latency histograms, refreshed at call time."""
+        return self.request({"type": "scrape"})["text"]
+
+    def debug(self, max_events: int = 5000) -> dict:
+        """The flight recorder's recent events plus the automatic dump
+        artifacts written so far — the live post-mortem view."""
+        return self.request({"type": "debug", "max_events": max_events})
+
     def shutdown(self) -> dict:
         return self.request({"type": "shutdown"})
 
@@ -174,7 +185,12 @@ def submit_main(argv: list[str]) -> int:
                     help="socket timeout in seconds (default: none)")
     ap.add_argument("--priority", type=int, default=0)
     ap.add_argument("--deadline", type=float, default=None,
-                    help="give up if not STARTED within this many seconds")
+                    help="job deadline in seconds: a job not STARTED in "
+                         "time is cancelled in queue (deadline-expired "
+                         "error); one that runs but FINISHES late still "
+                         "returns its result, counted as an SLO "
+                         "deadline miss (server stats `slo` view + "
+                         "flight-recorder dump)")
     ap.add_argument("--retries", type=int, default=0,
                     help="re-submit after retry_after on queue-full")
     ap.add_argument("-u", "--include-unpolished", action="store_true")

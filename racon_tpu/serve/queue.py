@@ -20,14 +20,33 @@ waiter is woken with a typed error, and the `expired` counter bumps.
 Draining (`drain()`) flips admission off atomically: every later submit
 raises `Draining`, while already-admitted jobs keep flowing to workers —
 the SIGTERM half of graceful shutdown.
+
+SLO accounting rides the same completion path: `task_done` records each
+job's service seconds into BOTH the admission EMA and a rolling window
+(last `ROLLING_JOBS` jobs), and classifies deadline-carrying jobs as
+`deadline_hit` / `deadline_miss` (finished after the deadline it was
+admitted under — distinct from `expired`, which never ran). The
+retry-after hint and the stats/scrape SLO view therefore come from the
+same numbers, by construction. With a `hists` HistogramSet attached the
+queue also observes every popped job's queue wait (`job.queue_wait`).
 """
 
 from __future__ import annotations
 
 import heapq
 import itertools
+import math
 import threading
 import time
+from collections import deque
+
+
+def nearest_rank(sorted_vals, q: float):
+    """Nearest-rank percentile: value at rank ceil(q*n) (1-based) of an
+    ascending list — `int(n*q)` overshoots by one whole rank whenever
+    n*q is integral, reporting the max as p99 for n=100."""
+    n = len(sorted_vals)
+    return sorted_vals[max(0, min(n - 1, math.ceil(q * n) - 1))]
 
 
 class AdmissionError(Exception):
@@ -61,7 +80,7 @@ class Job:
     __slots__ = ("id", "sequences", "overlaps", "target", "options",
                  "priority", "deadline", "fault_plan", "strict",
                  "want_trace", "enqueued_t", "started_t", "response",
-                 "event")
+                 "event", "stats_ref")
 
     def __init__(self, id_: str, sequences: str, overlaps: str,
                  target: str, options: dict, priority: int = 0,
@@ -83,6 +102,10 @@ class Job:
         self.started_t: float | None = None
         self.response: dict | None = None
         self.event = threading.Event()
+        #: live PipelineStats of the polisher executing this job (set by
+        #: the worker) — the flight-recorder dump snapshots it so a
+        #: failed job's artifact carries the stage stats its spans pin to
+        self.stats_ref = None
 
     @property
     def queue_wait_s(self) -> float:
@@ -94,8 +117,10 @@ class JobQueue:
 
     #: retry_after clamp (seconds)
     RETRY_MIN, RETRY_MAX = 0.05, 60.0
+    #: rolling service-time window size (jobs) behind the SLO view
+    ROLLING_JOBS = 64
 
-    def __init__(self, maxsize: int, workers: int = 1):
+    def __init__(self, maxsize: int, workers: int = 1, hists=None):
         self.maxsize = max(1, int(maxsize))
         self.workers = max(1, int(workers))
         self._lock = threading.Lock()
@@ -106,9 +131,15 @@ class JobQueue:
         #: EMA of job service seconds, seeded pessimistically so the
         #: first rejections before any completion still back off
         self._ema_service_s = 1.0
+        #: the same service seconds the EMA eats, kept verbatim for the
+        #: rolling SLO percentiles — one stream, two views
+        self._recent: deque = deque(maxlen=self.ROLLING_JOBS)
+        #: optional obs.hist.HistogramSet (the server's lifetime set)
+        self.hists = hists
         self.counters = {"submitted": 0, "admitted": 0, "rejected_full": 0,
                          "rejected_draining": 0, "expired": 0,
-                         "completed": 0, "failed": 0}
+                         "completed": 0, "failed": 0,
+                         "deadline_hit": 0, "deadline_miss": 0}
 
     # -------------------------------------------------------- admission
     def _retry_after_locked(self) -> float:
@@ -154,6 +185,9 @@ class JobQueue:
                         job.event.set()
                         continue
                     job.started_t = now
+                    if self.hists is not None:
+                        self.hists.observe("job.queue_wait",
+                                           now - job.enqueued_t)
                     return job
                 if deadline is not None:
                     left = deadline - time.monotonic()
@@ -163,12 +197,26 @@ class JobQueue:
                 else:
                     self._not_empty.wait()
 
-    def task_done(self, job: Job, ok: bool, service_s: float) -> None:
+    def task_done(self, job: Job, ok: bool, service_s: float) -> bool:
+        """Account a finished job. Returns True when the job carried a
+        deadline and finished PAST it (the SLO miss the server's flight
+        recorder dumps on) — expired-in-queue jobs never reach here."""
+        missed = (job.deadline is not None
+                  and time.perf_counter() > job.deadline)
         with self._lock:
             self.counters["completed" if ok else "failed"] += 1
+            if job.deadline is not None:
+                self.counters["deadline_miss" if missed
+                              else "deadline_hit"] += 1
             # EMA over the last ~8 jobs: adapts to workload shifts
             # without a rejection spike swinging the hint wildly
             self._ema_service_s += (service_s - self._ema_service_s) / 8.0
+            self._recent.append(service_s)
+        if self.hists is not None:
+            self.hists.observe("job.service", service_s)
+            self.hists.observe("job.latency",
+                               time.perf_counter() - job.enqueued_t)
+        return missed
 
     # ----------------------------------------------------------- drain
     def drain(self) -> None:
@@ -188,7 +236,17 @@ class JobQueue:
 
     def snapshot(self) -> dict:
         with self._lock:
-            return dict(self.counters, depth=len(self._heap),
-                        maxsize=self.maxsize,
-                        draining=self._draining,
-                        ema_service_s=round(self._ema_service_s, 4))
+            recent = sorted(self._recent)
+            out = dict(self.counters, depth=len(self._heap),
+                       maxsize=self.maxsize,
+                       draining=self._draining,
+                       ema_service_s=round(self._ema_service_s, 4))
+        if recent:
+            n = len(recent)
+            out["recent"] = {
+                "jobs": n,
+                "p50_s": round(nearest_rank(recent, 0.50), 4),
+                "p95_s": round(nearest_rank(recent, 0.95), 4),
+                "p99_s": round(nearest_rank(recent, 0.99), 4),
+                "mean_s": round(sum(recent) / n, 4)}
+        return out

@@ -45,8 +45,13 @@ import tempfile
 import threading
 import time
 
+from collections import deque
+
 from ..errors import RaconError
+from ..obs import flight as obs_flight
+from ..obs import prom as obs_prom
 from ..obs import trace as obs_trace
+from ..obs.hist import HistogramSet
 from ..resilience import strict_scope
 from ..utils.logger import log_info
 from .batcher import WindowBatcher
@@ -108,6 +113,36 @@ class ServeConfig:
         self.min_gather = max(1, kw.pop("min_gather", 2))
         self.warmup = kw.pop("warmup", True)
         self.max_frame = kw.pop("max_frame", max_frame_bytes())
+        # telemetry exposition: None = no HTTP endpoint (the scrape RPC
+        # is always available); an int (0 = ephemeral, published back)
+        # serves Prometheus text on localhost HTTP. The env value is
+        # parsed STRICTLY: a typo'd port must fail at startup, not
+        # silently bind an ephemeral one Prometheus will never find
+        if "metrics_port" in kw:
+            self.metrics_port = kw.pop("metrics_port")
+        else:
+            raw = env("RACON_TPU_SERVE_METRICS_PORT")
+            if raw:
+                try:
+                    self.metrics_port = int(raw)
+                except ValueError:
+                    raise RaconError(
+                        "ServeConfig",
+                        f"invalid RACON_TPU_SERVE_METRICS_PORT {raw!r} "
+                        "(expected an integer)") from None
+            else:
+                self.metrics_port = None
+        if self.metrics_port is not None and self.metrics_port < 0:
+            raise RaconError(
+                "ServeConfig",
+                f"invalid metrics port {self.metrics_port} "
+                "(expected >= 0; 0 = ephemeral)")
+        # flight recorder: directory for automatic per-job dumps when a
+        # job fails / times out / misses its deadline; empty string or
+        # None disables dumping (the ring itself stays on)
+        self.flight_dir = kw.pop(
+            "flight_dir", env("RACON_TPU_SERVE_FLIGHT_DIR",
+                              "/tmp/racon_tpu_flight"))
         # polish defaults (jobs may override per request, except
         # num_threads: host threads are a server resource)
         self.window_length = kw.pop("window_length", 500)
@@ -198,11 +233,26 @@ class PolishServer:
             from ..sched import enable_compile_cache
 
             enable_compile_cache(cfg.tpu_compile_cache)
-        self.queue = JobQueue(cfg.queue_depth, workers=cfg.workers)
+        #: server-lifetime latency histograms (obs/hist.py): job
+        #: end-to-end / queue wait / gather wait / batch rounds /
+        #: pipeline stages / compiles — the scrape RPC's distribution view
+        self.hists = HistogramSet()
+        self.queue = JobQueue(cfg.queue_depth, workers=cfg.workers,
+                              hists=self.hists)
         self.batcher = WindowBatcher(
             gather_window_s=cfg.gather_window_s,
             min_gather=min(cfg.min_gather, cfg.workers))
         self.batcher.active_hint = self._inflight_count
+        self.batcher.hists = self.hists
+        self.batcher.pipeline_stats.hists = self.hists
+        self.batcher.scheduler.stats.hists = self.hists
+        #: flight recorder (obs/flight.py): installed at start() unless
+        #: a full trace is already armed (then that recorder serves as
+        #: the flight source too)
+        self._flight: obs_trace.TraceRecorder | None = None
+        self._flight_installed = False
+        self._dumps: deque = deque(maxlen=8)
+        self._http = None
         self._listener: socket.socket | None = None
         self._threads: list[threading.Thread] = []
         self._conns: set[socket.socket] = set()
@@ -223,8 +273,20 @@ class PolishServer:
         worker pool and the accept loop. Returns self; the server is
         accepting when this returns."""
         cfg = self.config
+        # always-on flight recorder: when no full trace is armed,
+        # install the bounded ring as the process tracer so every span
+        # hook feeds it (<2% overhead, synthbench --flight A/Bs it);
+        # an armed RACON_TPU_TRACE recorder doubles as the flight source
+        tr = obs_trace.get_tracer()
+        if tr is None:
+            self._flight = obs_trace.install(obs_flight.FlightRecorder())
+            self._flight_installed = True
+        else:
+            self._flight = tr
         if cfg.warmup:
             self.warmup()
+        if cfg.metrics_port is not None:
+            self._start_metrics_http()
         if cfg.port is not None:
             lst = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
             lst.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -253,8 +315,59 @@ class PolishServer:
                  f"({cfg.workers} workers, queue depth "
                  f"{cfg.queue_depth}"
                  + (f", warm in {self._warm['warmup_s']:.2f}s"
-                    if self._warm else "") + ")")
+                    if self._warm else "")
+                 + (f", metrics on 127.0.0.1:{cfg.metrics_port}"
+                    if self._http is not None else "") + ")")
         return self
+
+    def _start_metrics_http(self) -> None:
+        """Serve Prometheus text on localhost HTTP (stdlib only). Bind
+        failure raises at start() — an operator asked for a port they
+        cannot have — but once up, NO handler error ever propagates:
+        a scrape bug answers 500 and the polish server keeps serving."""
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        polish_server = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def do_GET(self):
+                try:
+                    path = self.path.split("?", 1)[0]
+                    if path in ("/metrics", "/"):
+                        body = polish_server.prometheus_text().encode()
+                        self.send_response(200)
+                        self.send_header("Content-Type",
+                                         obs_prom.CONTENT_TYPE)
+                        self.send_header("Content-Length", str(len(body)))
+                        self.end_headers()
+                        self.wfile.write(body)
+                    elif path == "/healthz":
+                        body = (b"draining\n" if polish_server._draining
+                                .is_set() else b"ok\n")
+                        self.send_response(200)
+                        self.send_header("Content-Type", "text/plain")
+                        self.send_header("Content-Length", str(len(body)))
+                        self.end_headers()
+                        self.wfile.write(body)
+                    else:
+                        self.send_error(404)
+                except Exception as exc:  # noqa: BLE001 — see docstring
+                    with contextlib.suppress(Exception):
+                        self.send_error(
+                            500, f"{type(exc).__name__}: {exc}")
+
+            def log_message(self, *args):  # scrapes must not spam stderr
+                pass
+
+        httpd = ThreadingHTTPServer(
+            ("127.0.0.1", self.config.metrics_port), _Handler)
+        httpd.daemon_threads = True
+        self.config.metrics_port = httpd.server_address[1]
+        self._http = httpd
+        t = threading.Thread(target=httpd.serve_forever,
+                             name="racon-tpu-serve-metrics-http",
+                             daemon=True)
+        t.start()
 
     def warmup(self, paths: tuple[str, str, str] | None = None) -> dict:
         """Run one job end to end (synthetic by default, or the caller's
@@ -324,6 +437,16 @@ class PolishServer:
         # flush observability BEFORE dropping connections: an armed
         # trace/metrics artifact must survive the shutdown
         self._flush_observability()
+        if self._http is not None:
+            with contextlib.suppress(Exception):
+                self._http.shutdown()
+                self._http.server_close()
+            self._http = None
+        # uninstall the flight ring (only if still ours): later runs in
+        # this process must re-resolve tracing from their own environment
+        if self._flight_installed \
+                and obs_trace.get_tracer() is self._flight:
+            obs_trace.reset()
         with self._conn_lock:
             conns = list(self._conns)
         for c in conns:
@@ -435,6 +558,13 @@ class PolishServer:
                         time.perf_counter() - self._t_start, 3)}
         if rtype == "stats":
             return dict(self.stats_snapshot(), type="stats")
+        if rtype == "scrape":
+            return {"type": "metrics",
+                    "content_type": obs_prom.CONTENT_TYPE,
+                    "text": self.prometheus_text()}
+        if rtype == "debug":
+            return self.debug_snapshot(
+                max_events=int(req.get("max_events", 5000)))
         if rtype == "shutdown":
             threading.Thread(target=self.drain,
                              name="racon-tpu-serve-drain",
@@ -510,8 +640,36 @@ class PolishServer:
                     queue_wait_s=round(job.queue_wait_s, 4))
                 ok = False
             job.response = resp
-            job.event.set()
-            self.queue.task_done(job, ok, time.perf_counter() - t0)
+            try:
+                # fold the job's own latency histograms (align phase,
+                # solo rounds, polisher phases, compiles) into the
+                # lifetime scrape view — on FAILURE too: the
+                # pathological jobs are exactly the ones the p99s must
+                # not exclude. (Shared batch rounds already observe
+                # into the server set directly.)
+                if job.stats_ref is not None \
+                        and job.stats_ref.hists is not None:
+                    self.hists.merge(job.stats_ref.hists)
+                missed = self.queue.task_done(
+                    job, ok, time.perf_counter() - t0)
+                if not ok or missed:
+                    # post-mortem artifact: the flight ring windowed to
+                    # this job, with its stage stats riding along
+                    # (obs/flight.py). Written BEFORE the waiter is
+                    # unblocked, so a client reacting to its error
+                    # response finds the dump already listed by `debug`
+                    self._flight_dump(
+                        job,
+                        "job-failed" if not ok else "deadline-miss",
+                        resp)
+            except Exception as exc:  # noqa: BLE001
+                # telemetry accounting must never kill the worker or
+                # strand the waiter blocked on job.event
+                log_info(f"[racon_tpu::serve] warning: post-job "
+                         f"telemetry failed ({type(exc).__name__}: "
+                         f"{exc})")
+            finally:
+                job.event.set()
             with self._idle:
                 self._inflight -= 1
                 self._idle.notify_all()
@@ -557,6 +715,9 @@ class PolishServer:
                              cfg.tpu_device_timeout)),
                 tpu_adaptive_buckets=cfg.tpu_adaptive_buckets,
                 tpu_fault_plan=job.fault_plan)
+            # live ref for the flight dump: a job that dies mid-phase
+            # still gets its partial stage stats into the artifact
+            job.stats_ref = polisher.pipeline_stats
             polisher.initialize()
             polished = polisher.polish(
                 not opts.get("include_unpolished", False),
@@ -574,6 +735,83 @@ class PolishServer:
             resp["trace"] = rec.events()
         return resp
 
+    # -------------------------------------------------- flight recorder
+    def _flight_dump(self, job: Job, reason: str,
+                     resp: dict | None) -> None:
+        """Write the flight ring, windowed to `job`, as a Chrome-trace
+        artifact named for the job. Best-effort by design: a full disk
+        or unwritable directory loses the artifact, never the server."""
+        dirpath = self.config.flight_dir
+        if not dirpath or self._flight is None:
+            return
+        try:
+            os.makedirs(dirpath, exist_ok=True)
+            path = os.path.join(dirpath,
+                                f"flight_{job.id}_{reason}.json")
+            info = {"job_id": job.id, "reason": reason,
+                    "queue_wait_s": round(job.queue_wait_s, 4),
+                    "error_type": (resp or {}).get("error_type"),
+                    "message": (resp or {}).get("message"),
+                    "stage_stats": (job.stats_ref.snapshot()
+                                    if job.stats_ref is not None
+                                    else None)}
+            obs_flight.dump(self._flight, path,
+                            since=job.started_t, flight=info)
+            self._dumps.append(path)
+            log_info(f"[racon_tpu::serve] flight recorder dumped to "
+                     f"{path} ({reason})")
+        except Exception as exc:  # noqa: BLE001 — full disk, an
+            # unserializable span arg, anything: the artifact is lost,
+            # never the job response or the server
+            log_info(f"[racon_tpu::serve] warning: could not write "
+                     f"flight dump ({type(exc).__name__}: {exc})")
+
+    def debug_snapshot(self, max_events: int = 5000) -> dict:
+        """The `debug` RPC body: the flight ring's most recent events
+        (bounded so the response frame stays small) plus the automatic
+        dump artifacts written so far."""
+        events: list = []
+        if self._flight is not None:
+            events = obs_flight.window_events(self._flight)
+            if max_events > 0 and len(events) > max_events:
+                # keep thread metadata, trim the oldest spans
+                meta = [e for e in events if e.get("ph") == "M"]
+                rest = [e for e in events if e.get("ph") != "M"]
+                events = meta + rest[-max_events:]
+        return {"type": "debug", "events": events,
+                "dumps": list(self._dumps),
+                "flight_installed": self._flight_installed}
+
+    # --------------------------------------------------------- exposition
+    def prometheus_text(self) -> str:
+        """One Prometheus scrape body (obs/prom.py): lifetime counters,
+        live gauges and every latency histogram — refreshed at call
+        time, safe to call at any lifecycle point including drain."""
+        q = self.queue.snapshot()
+        b = self.batcher.snapshot()
+        counters = {f"serve.jobs.{k}": q[k] for k in (
+            "submitted", "admitted", "rejected_full",
+            "rejected_draining", "expired", "completed", "failed",
+            "deadline_hit", "deadline_miss")}
+        counters["serve.batch.rounds"] = b["rounds"]
+        counters["serve.batch.multi_job_rounds"] = b["multi_job_rounds"]
+        counters["serve.batch.windows"] = b["windows"]
+        counters["serve.compiles"] = b["compiles"]
+        gauges = {
+            "serve.uptime_seconds":
+                round(time.perf_counter() - self._t_start, 3),
+            "serve.queue_depth": q["depth"],
+            "serve.queue_capacity": q["maxsize"],
+            "serve.inflight": self._inflight_count(),
+            "serve.draining": self._draining.is_set(),
+            "serve.service_time_ema_seconds": q["ema_service_s"],
+        }
+        for engine, e in (b.get("occupancy") or {}).items():
+            if "occupancy_pct" in e:
+                gauges[f"sched.{engine}.occupancy_pct"] = \
+                    e["occupancy_pct"]
+        return obs_prom.render(counters, gauges, self.hists)
+
     # -------------------------------------------------------------- misc
     def _inflight_count(self) -> int:
         with self._idle:
@@ -582,12 +820,29 @@ class PolishServer:
     def stats_snapshot(self) -> dict:
         with self._idle:
             inflight = self._inflight
+        q = self.queue.snapshot()
+        latency = self.hists.get("job.latency")
+        deadlined = q["deadline_hit"] + q["deadline_miss"]
         return {"uptime_s": round(time.perf_counter() - self._t_start, 3),
                 "warm": self._warm,
                 "inflight": inflight,
                 "draining": self._draining.is_set(),
-                "queue": self.queue.snapshot(),
-                "batcher": self.batcher.snapshot()}
+                "queue": q,
+                "batcher": self.batcher.snapshot(),
+                # the SLO view: deadline hit/miss plus the rolling
+                # latency window — the SAME service-time stream the
+                # admission retry-after EMA is computed from
+                "slo": {"deadline_hit": q["deadline_hit"],
+                        "deadline_miss": q["deadline_miss"],
+                        "expired": q["expired"],
+                        "miss_rate": round(
+                            q["deadline_miss"] / deadlined, 4)
+                        if deadlined else 0.0,
+                        "recent": q.get("recent"),
+                        "latency": (latency.snapshot()
+                                    if latency is not None else None)},
+                "flight": {"dumps": list(self._dumps),
+                           "installed": self._flight_installed}}
 
     @property
     def address(self) -> str:
@@ -626,6 +881,16 @@ def serve_main(argv: list[str]) -> int:
     ap.add_argument("--no-warmup", action="store_true",
                     help="skip the synthetic warmup job (first real "
                          "request pays the compiles)")
+    ap.add_argument("--metrics-port", type=int, default=None,
+                    help="serve Prometheus text metrics on this "
+                         "localhost HTTP port (0 = ephemeral; "
+                         "RACON_TPU_SERVE_METRICS_PORT; the `scrape` "
+                         "RPC works regardless)")
+    ap.add_argument("--flight-dir", default=None,
+                    help="directory for automatic flight-recorder "
+                         "dumps of failed / deadline-missed jobs "
+                         "(RACON_TPU_SERVE_FLIGHT_DIR, default "
+                         "/tmp/racon_tpu_flight; '' disables)")
     ap.add_argument("-w", "--window-length", type=int, default=500)
     ap.add_argument("-q", "--quality-threshold", type=float, default=10.0)
     ap.add_argument("-e", "--error-threshold", type=float, default=0.3)
@@ -663,6 +928,10 @@ def serve_main(argv: list[str]) -> int:
         kw["socket_path"] = args.socket
     if args.port is not None:
         kw["port"] = args.port
+    if args.metrics_port is not None:
+        kw["metrics_port"] = args.metrics_port
+    if args.flight_dir is not None:
+        kw["flight_dir"] = args.flight_dir
     if args.workers is not None:
         kw["workers"] = args.workers
     if args.queue_depth is not None:

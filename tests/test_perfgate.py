@@ -1,0 +1,137 @@
+"""tools/perfgate.py: the perf regression gate.
+
+The ISSUE's acceptance pair: the gate must PASS on the repo's own
+current artifacts and demonstrably FAIL on a synthetic -20% artifact.
+Plus the plumbing: artifact-shape extraction (bench wrapper, raw bench
+line, servebench), baseline resolution order, and the exit-status
+contract (0 pass / 1 regression / 2 broken gate)."""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import perfgate  # noqa: E402
+
+
+def bench_artifact(value, vs_ratio=None, rc=0, wrapped=True,
+                   metric="sample_polish_consensus_throughput_host"):
+    inner = {"metric": metric, "value": value, "unit": "windows/sec"}
+    if vs_ratio is not None:
+        inner["vs_baseline"] = vs_ratio
+    return {"n": 1, "rc": rc, "parsed": inner} if wrapped else inner
+
+
+def write(path, doc):
+    with open(path, "w") as fh:
+        json.dump(doc, fh)
+    return str(path)
+
+
+# ------------------------------------------------------------- extraction
+def test_extract_bench_shapes():
+    got = perfgate.extract(bench_artifact(80.0, 1.6))
+    assert got["value"] == 80.0 and got["higher_better"]
+    assert got["vs_baseline"] == 1.6
+    raw = perfgate.extract(bench_artifact(50.0, wrapped=False))
+    assert raw["value"] == 50.0
+
+
+def test_extract_rejects_failed_artifacts():
+    with pytest.raises(perfgate.GateError):
+        perfgate.extract(bench_artifact(80.0, rc=124))
+    with pytest.raises(perfgate.GateError):
+        perfgate.extract(bench_artifact(0.0))
+    with pytest.raises(perfgate.GateError):
+        perfgate.extract(bench_artifact(
+            0.0, metric="sample_polish_consensus_throughput_failed"))
+    with pytest.raises(perfgate.GateError):
+        perfgate.extract({"totally": "unrelated"})
+
+
+def test_extract_servebench_artifact():
+    got = perfgate.extract({"mode": "serve",
+                            "warm": {"seq_p50_s": 0.30},
+                            "cold": {"p50_s": 0.41}})
+    assert got["value"] == 0.30
+    assert not got["higher_better"]  # p50 seconds: lower is better
+
+
+# ------------------------------------------------------------- gate math
+def test_gate_directions():
+    ok, delta = perfgate.gate(95.0, 100.0, 10.0, higher_better=True)
+    assert ok and delta == pytest.approx(-5.0)
+    ok, _ = perfgate.gate(80.0, 100.0, 10.0, higher_better=True)
+    assert not ok  # -20% windows/s
+    ok, delta = perfgate.gate(0.33, 0.30, 15.0, higher_better=False)
+    assert ok and delta == pytest.approx(-9.09, abs=0.01)
+    ok, _ = perfgate.gate(0.40, 0.30, 10.0, higher_better=False)
+    assert not ok  # 33% slower p50
+    with pytest.raises(perfgate.GateError):
+        perfgate.gate(1.0, 0.0, 10.0, higher_better=True)
+
+
+# ----------------------------------------------------------- end to end
+def test_synthetic_minus_20_pct_fails(tmp_path):
+    write(tmp_path / "BENCH_r01.json", bench_artifact(100.0, 2.0))
+    write(tmp_path / "BENCH_r02.json", bench_artifact(80.0, 1.6))
+    # -20% vs the previous round
+    assert perfgate.main(["--dir", str(tmp_path),
+                          "--against", "auto"]) == 1
+    # -20% vs the reference-CPU baseline the artifact itself records
+    write(tmp_path / "BENCH_r03.json", bench_artifact(40.0, 0.8))
+    assert perfgate.main(["--dir", str(tmp_path)]) == 1
+
+
+def test_within_tolerance_passes(tmp_path):
+    write(tmp_path / "BENCH_r01.json", bench_artifact(100.0, 2.0))
+    write(tmp_path / "BENCH_r02.json", bench_artifact(95.0, 1.9))
+    assert perfgate.main(["--dir", str(tmp_path),
+                          "--against", "auto"]) == 0
+    assert perfgate.main(["--dir", str(tmp_path)]) == 0
+
+
+def test_against_auto_skips_unusable_rounds(tmp_path):
+    write(tmp_path / "BENCH_r01.json", bench_artifact(100.0, 2.0))
+    write(tmp_path / "BENCH_r02.json", bench_artifact(90.0, rc=124))
+    write(tmp_path / "BENCH_r03.json", bench_artifact(95.0, 1.9))
+    # r02 timed out: the reference must be r01, and 95 vs 100 passes
+    assert perfgate.main(["--dir", str(tmp_path),
+                          "--against", "auto"]) == 0
+
+
+def test_baseline_json_published_wins(tmp_path):
+    write(tmp_path / "BENCH_r01.json", bench_artifact(80.0, 1.6))
+    write(tmp_path / "BASELINE.json",
+          {"metric": "x", "published": {"windows_per_sec": 100.0}})
+    assert perfgate.main(["--dir", str(tmp_path)]) == 1  # 80 vs 100
+    assert perfgate.main(["--dir", str(tmp_path),
+                          "--tolerance-pct", "25"]) == 0
+
+
+def test_explicit_ref_value_and_broken_gate(tmp_path):
+    write(tmp_path / "BENCH_r01.json", bench_artifact(80.0))
+    assert perfgate.main(["--dir", str(tmp_path),
+                          "--ref-value", "80"]) == 0
+    # no vs_baseline, no published baseline, no ref: broken gate = 2
+    assert perfgate.main(["--dir", str(tmp_path)]) == 2
+    assert perfgate.main(["--dir", str(tmp_path / "empty")]) == 2
+
+
+def test_repo_current_artifacts_pass():
+    """The acceptance half: the default invocation against the repo's
+    own committed artifacts exits 0."""
+    if not perfgate.find_artifacts(REPO):
+        pytest.skip("no BENCH artifacts in this checkout")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "perfgate.py")],
+        capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stderr
+    assert "[perfgate] PASS" in proc.stderr

@@ -1,0 +1,210 @@
+"""Perf regression gate: compare the newest bench artifact to baseline.
+
+Every round leaves a `BENCH_r*.json` artifact (and servebench can write
+its own with `--json`), but nothing READ them — a PR could halve
+windows/s and CI would stay green. `perfgate` closes the loop with one
+line of verdict and an exit status:
+
+    python tools/perfgate.py                    # newest BENCH_r*.json
+    python tools/perfgate.py --artifact out.json --tolerance-pct 10
+    python tools/perfgate.py --against auto     # vs the previous round
+
+Metric extraction understands both artifact shapes:
+
+  - bench.py lines (possibly wrapped by the driver as {"parsed": ...}):
+    `value` in windows/sec, HIGHER is better. Artifacts whose metric
+    ends in `_failed`, whose value is 0, or whose rc is nonzero are
+    SKIPPED (a timed-out round is not a baseline and not a candidate).
+  - servebench `--json` artifacts (`"mode": "serve"`): warm sequential
+    p50 seconds, LOWER is better.
+
+Baseline resolution, in order:
+
+  1. `--ref-value X` — an explicit number (CI pinning a known-good run).
+  2. `--against PATH` — another artifact; `--against auto` = the newest
+     usable artifact BEFORE the candidate (round-over-round gating;
+     noisier, so pick your tolerance accordingly).
+  3. BASELINE.json `published.windows_per_sec` when someone has
+     published a measured baseline there.
+  4. The artifact's own `vs_baseline` ratio, which bench.py defines
+     against the reference CPU implementation's throughput — the
+    `value / vs_baseline` quotient IS the baseline the repo has been
+     comparing against since round 1 (50 windows/s on the sample).
+
+The default tolerance is 10%: a candidate more than 10% WORSE than the
+baseline (slower windows/s, or higher serve p50) exits 1. bench.py runs
+the gate automatically after emitting its metric line when
+RACON_TPU_PERFGATE=1 (stderr verdict only — the JSON-line contract is
+untouched).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class GateError(Exception):
+    """Artifact unusable / baseline unresolvable (exit 2, not 1: a
+    broken gate must be distinguishable from a real regression)."""
+
+
+def load_artifact(path: str) -> dict:
+    try:
+        with open(path) as fh:
+            doc = json.load(fh)
+    except (OSError, ValueError) as exc:
+        raise GateError(f"cannot read artifact {path}: {exc}") from None
+    if not isinstance(doc, dict):
+        raise GateError(f"artifact {path} is not a JSON object")
+    return doc
+
+
+def extract(doc: dict, path: str = "<artifact>") -> dict:
+    """Normalize an artifact into {name, value, unit, higher_better,
+    vs_baseline?}. Raises GateError for unusable artifacts."""
+    if doc.get("rc") not in (None, 0):
+        raise GateError(f"{path}: recorded rc={doc.get('rc')} "
+                        "(failed round — not comparable)")
+    inner = doc.get("parsed", doc)
+    if not isinstance(inner, dict):
+        raise GateError(f"{path}: no parsed metric")
+    if inner.get("mode") == "serve" or ("warm" in inner
+                                        and "cold" in inner):
+        warm = inner.get("warm") or {}
+        value = warm.get("seq_p50_s", warm.get("p50_s"))
+        if not value:
+            raise GateError(f"{path}: serve artifact without a p50")
+        return {"name": "serve warm seq p50", "value": float(value),
+                "unit": "s", "higher_better": False}
+    if inner.get("unit") == "windows/sec":
+        metric = str(inner.get("metric", ""))
+        value = float(inner.get("value") or 0.0)
+        if not value or metric.endswith("_failed"):
+            raise GateError(f"{path}: failed/zero bench metric")
+        out = {"name": metric, "value": value, "unit": "windows/sec",
+               "higher_better": True}
+        if inner.get("vs_baseline"):
+            out["vs_baseline"] = float(inner["vs_baseline"])
+        return out
+    raise GateError(f"{path}: unrecognized artifact shape "
+                    f"(keys {sorted(inner)[:8]})")
+
+
+def _round_number(path: str) -> int:
+    m = re.search(r"_r(\d+)\.json$", os.path.basename(path))
+    return int(m.group(1)) if m else -1
+
+
+def find_artifacts(dirname: str) -> list[str]:
+    """BENCH_r*.json in round order (oldest first)."""
+    paths = glob.glob(os.path.join(dirname, "BENCH_r*.json"))
+    return sorted(paths, key=_round_number)
+
+
+def resolve_baseline(cand: dict, args, candidate_path: str) -> tuple:
+    """-> (reference_value, description). See module docstring."""
+    if args.ref_value is not None:
+        return float(args.ref_value), "explicit --ref-value"
+    if args.against:
+        if args.against == "auto":
+            prior = [p for p in find_artifacts(args.dir)
+                     if _round_number(p) < _round_number(candidate_path)]
+            for path in reversed(prior):
+                try:
+                    ref = extract(load_artifact(path), path)
+                except GateError:
+                    continue
+                if ref["higher_better"] == cand["higher_better"]:
+                    return ref["value"], os.path.basename(path)
+            raise GateError("--against auto: no usable prior artifact")
+        ref = extract(load_artifact(args.against), args.against)
+        if ref["higher_better"] != cand["higher_better"]:
+            raise GateError("--against artifact measures a different "
+                            "direction than the candidate")
+        return ref["value"], os.path.basename(args.against)
+    baseline_path = os.path.join(args.dir, "BASELINE.json")
+    if os.path.isfile(baseline_path):
+        published = (load_artifact(baseline_path).get("published")
+                     or {})
+        if published.get("windows_per_sec") and cand["higher_better"]:
+            return (float(published["windows_per_sec"]),
+                    "BASELINE.json published")
+    if cand.get("vs_baseline"):
+        # bench.py's own comparison point: value / vs_baseline is the
+        # reference-CPU windows/s every artifact is ratioed against
+        return (cand["value"] / cand["vs_baseline"],
+                "reference-CPU baseline (value/vs_baseline)")
+    raise GateError("no baseline: BASELINE.json publishes no "
+                    "windows_per_sec and the artifact carries no "
+                    "vs_baseline (use --ref-value or --against)")
+
+
+def gate(candidate: float, reference: float, tolerance_pct: float,
+         higher_better: bool) -> tuple[bool, float]:
+    """-> (ok, delta_pct). delta_pct is signed improvement: positive =
+    better than the reference, whatever the metric direction."""
+    if reference <= 0:
+        raise GateError(f"non-positive reference value {reference}")
+    if higher_better:
+        delta = (candidate / reference - 1.0) * 100.0
+    else:
+        delta = (reference / candidate - 1.0) * 100.0
+    return delta >= -abs(tolerance_pct), delta
+
+
+def run(args) -> int:
+    if args.artifact:
+        candidate_path = args.artifact
+    else:
+        arts = find_artifacts(args.dir)
+        if not arts:
+            raise GateError(f"no BENCH_r*.json under {args.dir}")
+        candidate_path = arts[-1]
+    cand = extract(load_artifact(candidate_path), candidate_path)
+    reference, ref_desc = resolve_baseline(cand, args, candidate_path)
+    ok, delta = gate(cand["value"], reference, args.tolerance_pct,
+                     cand["higher_better"])
+    verdict = "PASS" if ok else "FAIL"
+    print(f"[perfgate] {verdict}: {os.path.basename(candidate_path)} "
+          f"{cand['name']} = {cand['value']:g} {cand['unit']} vs "
+          f"{reference:g} ({ref_desc}): {delta:+.1f}% "
+          f"(tolerance -{abs(args.tolerance_pct):g}%)",
+          file=sys.stderr)
+    return 0 if ok else 1
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="perf regression gate over bench/servebench "
+                    "artifacts (see module docstring)")
+    ap.add_argument("--dir", default=REPO,
+                    help="directory holding BENCH_r*.json / "
+                         "BASELINE.json (default: repo root)")
+    ap.add_argument("--artifact", default=None,
+                    help="candidate artifact (default: newest "
+                         "BENCH_r*.json in --dir)")
+    ap.add_argument("--against", default=None,
+                    help="reference artifact path, or 'auto' for the "
+                         "newest usable prior round")
+    ap.add_argument("--ref-value", type=float, default=None,
+                    help="explicit reference value (wins over "
+                         "everything)")
+    ap.add_argument("--tolerance-pct", type=float, default=10.0,
+                    help="allowed regression in percent (default 10)")
+    args = ap.parse_args(argv)
+    try:
+        return run(args)
+    except GateError as exc:
+        print(f"[perfgate] ERROR: {exc}", file=sys.stderr)
+        return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -97,6 +97,90 @@ def cold_cli_run(paths, args) -> tuple[float, bytes]:
     return dt, proc.stdout
 
 
+def check_slo(args, PolishClient, PolishServer) -> int:
+    """`--check-slo`: one warm server, one concurrent wave with per-job
+    deadlines, three gated cells printed as a faultcheck-style row —
+    p99 end-to-end latency, deadline-miss rate (from the server's OWN
+    SLO accounting, the same numbers admission control uses), and a
+    live `scrape` that must return Prometheus text with populated
+    latency histograms. Exit 0 only when every cell passes."""
+    with tempfile.TemporaryDirectory(prefix="racon_slo_") as tmp:
+        print(f"[servebench] SLO gate: {args.jobs} jobs, deadline "
+              f"{args.deadline:.0f}s, p99<= {args.slo_p99:.1f}s, "
+              f"miss-rate<= {args.slo_miss_rate:.2f}", file=sys.stderr)
+        paths = build_dataset(tmp, args.genome_kb, args.coverage,
+                              args.read_len, args.seed)
+        sock = os.path.join(tmp, "serve.sock")
+        server = PolishServer(
+            socket_path=sock, workers=args.workers, warmup=False,
+            job_threads=args.threads,
+            flight_dir=os.path.join(tmp, "flight"),
+            tpu_poa_batches=args.tpupoa_batches,
+            tpu_aligner_batches=args.tpualigner_batches)
+        server.warmup(paths=paths)
+        server.start()
+        client = PolishClient(socket_path=sock)
+
+        latencies = [None] * args.jobs
+
+        def submit(i):
+            t0 = time.perf_counter()
+            try:
+                client.submit(*paths, deadline_s=args.deadline,
+                              retries=5)
+            except Exception as exc:
+                print(f"[servebench] job {i} failed: {exc}",
+                      file=sys.stderr)
+                return
+            latencies[i] = time.perf_counter() - t0
+
+        threads = [threading.Thread(target=submit, args=(i,))
+                   for i in range(args.jobs)]
+        for t in threads:
+            t.start()
+        # scrape mid-wave: the live-exposition contract is part of the
+        # gate (must answer while jobs are executing)
+        live = client.scrape()
+        for t in threads:
+            t.join()
+        snap = client.stats()
+        server.drain(timeout=30)
+
+    from racon_tpu.serve.queue import nearest_rank
+
+    cells = []
+    done = sorted(v for v in latencies if v is not None)
+    if len(done) < args.jobs:
+        cells.append(("completed", False,
+                      f"{len(done)}/{args.jobs} jobs"))
+    p99 = nearest_rank(done, 0.99) if done else float("inf")
+    cells.append(("p99", p99 <= args.slo_p99,
+                  f"{p99:.2f}s <= {args.slo_p99:.1f}s"))
+    slo = snap.get("slo") or {}
+    miss_rate = float(slo.get("miss_rate", 1.0))
+    cells.append(("miss-rate", miss_rate <= args.slo_miss_rate,
+                  f"{miss_rate:.2f} <= {args.slo_miss_rate:.2f} "
+                  f"({slo.get('deadline_miss', '?')} missed, "
+                  f"{slo.get('expired', '?')} expired)"))
+    hist_lines = [ln for ln in live.splitlines()
+                  if "_bucket{" in ln]
+    populated = any(not ln.rstrip().endswith(" 0")
+                    for ln in hist_lines)
+    cells.append(("scrape", bool(hist_lines) and populated,
+                  f"{len(live.splitlines())} lines, "
+                  f"{len(hist_lines)} buckets, "
+                  f"{'populated' if populated else 'EMPTY'}"))
+    row = "  ".join(f"{name} {'pass' if ok else 'FAIL'} ({detail})"
+                    for name, ok, detail in cells)
+    failures = sum(not ok for _, ok, _ in cells)
+    print(f"[servebench] slo  {row}", file=sys.stderr)
+    print(f"[servebench] SLO gate "
+          f"{'PASS' if not failures else 'FAIL'}: "
+          f"{len(cells) - failures}/{len(cells)} cells green",
+          file=sys.stderr)
+    return 1 if failures else 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--jobs", type=int, default=4,
@@ -114,9 +198,27 @@ def main(argv=None) -> int:
     ap.add_argument("--workers", type=int, default=2)
     ap.add_argument("--json", default=None,
                     help="write the bench-style JSON artifact here")
+    ap.add_argument("--check-slo", action="store_true",
+                    help="SLO gate mode: run a small concurrent wave "
+                         "with per-job deadlines and assert p99 latency "
+                         "/ deadline-miss-rate / scrape validity "
+                         "(faultcheck-style pass/fail row, exit status "
+                         "is the gate)")
+    ap.add_argument("--slo-p99", type=float, default=60.0,
+                    help="--check-slo: p99 end-to-end latency bound in "
+                         "seconds (default 60)")
+    ap.add_argument("--slo-miss-rate", type=float, default=0.0,
+                    help="--check-slo: allowed deadline-miss rate "
+                         "(default 0 — no misses)")
+    ap.add_argument("--deadline", type=float, default=120.0,
+                    help="--check-slo: per-job deadline_s attached to "
+                         "every wave job (default 120)")
     args = ap.parse_args(argv)
 
     from racon_tpu.serve import PolishClient, PolishServer
+
+    if args.check_slo:
+        return check_slo(args, PolishClient, PolishServer)
 
     cold_n = args.cold_runs if args.cold_runs is not None \
         else min(args.jobs, 3)
@@ -186,14 +288,15 @@ def main(argv=None) -> int:
         server.drain(timeout=30)
 
     # ---- analysis
+    from racon_tpu.serve.queue import nearest_rank
+
     fail: list[str] = []
     all_results = seq_results + results
     warm_sorted = sorted(latencies)
-    p50 = warm_sorted[len(warm_sorted) // 2]
-    p95 = warm_sorted[min(len(warm_sorted) - 1,
-                          int(len(warm_sorted) * 0.95))]
-    seq_p50 = sorted(seq_s)[len(seq_s) // 2]
-    cold_p50 = sorted(cold_s)[len(cold_s) // 2]
+    p50 = nearest_rank(warm_sorted, 0.50)
+    p95 = nearest_rank(warm_sorted, 0.95)
+    seq_p50 = nearest_rank(sorted(seq_s), 0.50)
+    cold_p50 = nearest_rank(sorted(cold_s), 0.50)
     compiles_per_job = [
         (r.serve.get("batch") or {}).get("compiles", 0)
         for r in all_results]

@@ -176,6 +176,13 @@ def main(argv=None):
                          "to PATH and report trace-recording overhead vs "
                          "an untraced baseline run of the same workload "
                          "(target: < 2%%)")
+    ap.add_argument("--flight", action="store_true",
+                    help="A/B the always-on flight recorder "
+                         "(obs/flight.py, the bounded ring the serve "
+                         "layer installs) against an unrecorded "
+                         "baseline run of the same workload "
+                         "(target: < 2%% — the serve-mode overhead "
+                         "budget)")
     args = ap.parse_args(argv)
 
     from racon_tpu.core.polisher import create_polisher, PolisherType
@@ -249,6 +256,27 @@ def main(argv=None):
             print(f"[synthbench] trace overhead: {overhead:+.2f}% "
                   f"(baseline {base_polish_s:.2f}s, traced "
                   f"{polish_s:.2f}s, {n_events} events) "
+                  f"[{'OK' if overhead < 2.0 else 'OVER'} 2% target]",
+                  file=sys.stderr)
+        elif args.flight:
+            # same A/B discipline as --trace (warmup discarded, then
+            # baseline, then recorded), but with the serve layer's
+            # bounded FlightRecorder installed — the number that backs
+            # the "always-on costs <2%" claim in README "Serving"
+            from racon_tpu.obs import flight as obs_flight
+            from racon_tpu.obs import trace as obs_trace
+
+            run_polish()  # warmup, discarded
+            _, _, _, _, base_polish_s = run_polish()
+            rec = obs_trace.install(obs_flight.FlightRecorder())
+            polisher, polished, n_windows, init_s, polish_s = run_polish()
+            n_events = len(rec.events())
+            obs_trace.reset()
+            overhead = ((polish_s - base_polish_s) / base_polish_s * 100
+                        if base_polish_s > 0 else 0.0)
+            print(f"[synthbench] flight-recorder overhead: "
+                  f"{overhead:+.2f}% (baseline {base_polish_s:.2f}s, "
+                  f"recorded {polish_s:.2f}s, {n_events} ring events) "
                   f"[{'OK' if overhead < 2.0 else 'OVER'} 2% target]",
                   file=sys.stderr)
         else:
