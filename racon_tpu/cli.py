@@ -25,9 +25,13 @@ usage: racon_tpu [options ...] <sequences> <overlaps> <target sequences>
                 engines compiled; jobs from many clients share device
                 batches; live Prometheus metrics via the `scrape` RPC
                 or `--metrics-port`, post-mortems via the always-on
-                flight recorder and the `debug` RPC)
+                flight recorder and the `debug` RPC, an auditable
+                lifecycle journal via `--journal`)
         submit  send one polishing job to a running server; polished
-                FASTA on stdout, byte-identical to the one-shot run
+                FASTA on stdout, byte-identical to the one-shot run;
+                `--progress` streams live phase/window progress (incl.
+                queue position) and `--trace-out t.json` writes one
+                merged client+server Chrome trace of the request
 
     #default output is stdout
     <sequences>

@@ -165,6 +165,20 @@ class Polisher:
         self.targets_coverages: list[int] = []
         self.dummy_quality = b"!" * window_length
         self.logger = Logger()
+        # live progress hook (serve mode: the server forwards these as
+        # interleaved progress frames; see README "End-to-end tracing &
+        # progress"): callable(event_dict) or None — the zero-overhead
+        # default. Events carry phase / done / total; emission is
+        # best-effort and monotonic per phase (emit_progress).
+        self.progress_hook = None
+        self._progress_phase: str | None = None
+        self._progress_hwm: tuple[str, int, int] = ("", 0, 0)
+        import threading as _threading
+
+        # built eagerly: a lazy check-then-set would race the first two
+        # concurrent bar ticks (pipeline unpack worker vs fallback
+        # pool) into two different locks, defeating the monotone HWM
+        self._progress_lock = _threading.Lock()
         self._num_targets = 0
         #: completed initialize()+polish() cycles — a reused (warm)
         #: polisher resets its per-run counters at the next initialize()
@@ -237,6 +251,49 @@ class Polisher:
         this next to `stages` in its JSON artifact."""
         return self.scheduler.stats.snapshot()
 
+    # ------------------------------------------------------- progress
+    def emit_progress(self, done, total, phase: str | None = None,
+                      **extra) -> None:
+        """Push one live-progress event at the armed hook. Contract the
+        serve layer's progress frames inherit: per phase, `done` and
+        `total` are monotonically non-decreasing (a fallback engine
+        re-arming a smaller bar inside the same phase cannot make the
+        client's bar run backwards), and emission NEVER raises — live
+        progress is decoration on a run, not a dependency of it."""
+        hook = self.progress_hook
+        if hook is None:
+            return
+        ph = phase or self._progress_phase or "run"
+        # the hook is invoked INSIDE the lock: two concurrent bar ticks
+        # that computed done=5 and done=6 under the lock could
+        # otherwise deliver 6 then 5 and run the client's bar
+        # backwards; hooks only enqueue (Job.notify_progress appends to
+        # a deque), so holding the lock across them is safe and cheap
+        with self._progress_lock:
+            hwm_phase, hwm_done, hwm_total = self._progress_hwm
+            if ph != hwm_phase:
+                hwm_done = hwm_total = 0
+            d = max(int(done), hwm_done)
+            t = max(int(total), hwm_total)
+            self._progress_hwm = (ph, d, t)
+            ev = {"phase": ph, "done": min(d, t), "total": t}
+            ev.update(extra)
+            try:
+                hook(ev)
+            except Exception:  # noqa: BLE001 — see docstring
+                pass
+
+    def _progress_tick(self, count: int, total: int) -> None:
+        """Logger.on_bar adapter: bar bin transitions become progress
+        events attributed to the phase currently running."""
+        self.emit_progress(min(count, total), total)
+
+    def _arm_progress(self) -> None:
+        """Wire the (per-run) logger's bar ticks into the progress hook;
+        called at phase starts because _reset_run_state swaps loggers."""
+        if self.progress_hook is not None:
+            self.logger.on_bar = self._progress_tick
+
     # ------------------------------------------------------- warm reuse
     def _reset_run_state(self) -> None:
         """Fresh per-run counters for a warm-reused polisher: a second
@@ -260,6 +317,8 @@ class Polisher:
         self.logger = Logger()
         self.targets_coverages = []
         self._num_targets = 0
+        self._progress_phase = None
+        self._progress_hwm = ("", 0, 0)
 
     def rebind(self, sequences_path: str, overlaps_path: str,
                target_path: str) -> "Polisher":
@@ -295,6 +354,7 @@ class Polisher:
         # run that crashed before its flush must not leave keys behind
         # that would silently swallow this run's first warnings
         reset_dedup()
+        self._arm_progress()
         t_init = time.perf_counter()
         log = self.logger
         log.log()
@@ -379,6 +439,7 @@ class Polisher:
         for i, seq in enumerate(self.sequences):
             seq.transmute(has_name[i], has_data[i], has_reverse_data[i])
 
+        self._progress_phase = "align"
         with trace.span("polisher.align_overlaps"):
             self.find_overlap_breaking_points(overlaps)
 
@@ -436,6 +497,9 @@ class Polisher:
             o.breaking_points = None
 
         log.log("[racon_tpu::Polisher.initialize] transformed data into windows")
+        # announce the window total as consensus progress zero: the
+        # client's bar knows its denominator before the first round
+        self.emit_progress(0, len(self.windows), phase="consensus")
         self.hists.observe("phase.initialize",
                            time.perf_counter() - t_init)
         tr = trace.get_tracer()
@@ -664,6 +728,8 @@ class Polisher:
 
         t_stitch = _time.perf_counter()
         dst = self._stitch(drop_unpolished_sequences)
+        self.emit_progress(len(self.windows), len(self.windows),
+                           phase="stitch", sequences=len(dst))
         self.hists.observe("phase.stitch", _time.perf_counter() - t_stitch)
         tr = trace.get_tracer()
         if tr is not None:
@@ -689,6 +755,9 @@ class Polisher:
         from ..ops.poa import BatchPOA
 
         self.logger.log()
+        self._progress_phase = "consensus"
+        self._arm_progress()
+        self.emit_progress(0, len(self.windows))
 
         profile_ctx = (jax_profile("consensus") if self.tpu_poa_batches > 0
                        else contextlib.nullcontext())
@@ -709,6 +778,12 @@ class Polisher:
         with profile_ctx, pipeline:
             engine.generate_consensus(self.windows, self.trim)
         dt = _time.perf_counter() - t_consensus
+        snap_occ = self.scheduler.stats.snapshot()
+        self.emit_progress(
+            len(self.windows), len(self.windows),
+            occupancy={e: round(v["occupancy_pct"], 1)
+                       for e, v in snap_occ.items()
+                       if "occupancy_pct" in v} or None)
         self.hists.observe("phase.consensus", dt)
         tr = trace.get_tracer()
         if tr is not None:

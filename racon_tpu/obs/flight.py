@@ -58,6 +58,18 @@ def ring_capacity() -> int:
     return n if n > 0 else DEFAULT_CAPACITY
 
 
+def default_dump_dir() -> str | None:
+    """RACON_TPU_FLIGHT_DIR: process-wide default directory for flight
+    dump artifacts — keeps them out of whatever the working directory
+    happens to be. The serve layer's own RACON_TPU_SERVE_FLIGHT_DIR /
+    `serve --flight-dir` wins over it (ServeConfig), and the serve
+    startup validates the resolved directory STRICTLY: an unwritable
+    path fails the start instead of silently losing every post-mortem
+    (serve/server.py, mirroring the --metrics-port strict-parse
+    behavior). None when unset or empty."""
+    return os.environ.get("RACON_TPU_FLIGHT_DIR") or None
+
+
 class FlightRecorder(TraceRecorder):
     """TraceRecorder with one shared bounded ring (see module
     docstring): constant memory and constant `events()` cost no matter

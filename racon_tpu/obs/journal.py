@@ -1,0 +1,244 @@
+"""Durable serve event journal: a size-bounded JSONL lifecycle log.
+
+The flight recorder (obs/flight.py) answers "what was the process doing
+around the failure" — spans, in memory, dumped on demand. What it cannot
+answer is the auditor's question: "what happened to job X last Tuesday",
+because the ring forgets and dumps only happen on failure. `Journal`
+closes that gap the way inference servers' request logs do:
+
+  - ONE LINE PER LIFECYCLE TRANSITION, as JSON (JSONL): received,
+    admitted / rejected (with retry_after), started, round joined,
+    finished / failed / deadline-miss, expired, drain — each keyed by
+    job id and (when the client minted one) trace id, stamped with wall
+    time. `jq` is a full query engine over it; `tools/obsreport.py`
+    renders per-job timelines from it alongside flight dumps.
+  - SIZE-BOUNDED, not append-forever: when the file would exceed
+    `max_bytes` (RACON_TPU_JOURNAL_MAX_BYTES, default 8 MiB) it rotates
+    to `<path>.1` (one older generation kept, previous `.1` replaced),
+    so a long-lived server's journal is a hard ~2x`max_bytes` disk
+    constant. `read_journal()` reads both generations in order.
+  - STRICT AT OPEN, BEST-EFFORT AFTER: the constructor raises on an
+    unwritable path (serve startup turns that into a failed start,
+    mirroring the `--metrics-port` strict-parse discipline — an
+    operator who asked for an audit trail must not silently run
+    without one), but a mid-run write failure only bumps `dropped`:
+    a full disk loses journal lines, never jobs.
+
+Consistency is checkable, not assumed: `check_consistency()` verifies
+every journaled job reaches exactly ONE terminal state and that
+started/terminal pairs balance — `tools/servebench.py` runs it as part
+of its gate, so a lifecycle path that forgets to journal its exit shows
+up as a red bench cell, not a silent audit hole."""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+DEFAULT_MAX_BYTES = 8 << 20
+
+#: events that end a job's lifecycle; `check_consistency` requires
+#: exactly one per journaled job. `deadline-miss` is an annotation on a
+#: finished-late job (it still terminates via `finished`), not terminal.
+TERMINAL_EVENTS = frozenset((
+    "finished", "failed", "expired", "rejected-full",
+    "rejected-draining"))
+
+#: terminal states that imply the job actually ran (must pair with a
+#: `started` event)
+RAN_EVENTS = frozenset(("finished", "failed"))
+
+
+def journal_max_bytes() -> int:
+    try:
+        n = int(os.environ.get("RACON_TPU_JOURNAL_MAX_BYTES", 0))
+    except ValueError:
+        n = 0
+    return n if n > 0 else DEFAULT_MAX_BYTES
+
+
+class Journal:
+    """Append-only JSONL event log with one-generation rotation (see
+    module docstring). Thread-safe: one lock around write+rotate; every
+    line is flushed so a crashed server's journal ends at the last
+    completed transition, not mid-buffer."""
+
+    def __init__(self, path: str, max_bytes: int | None = None):
+        self.path = path
+        self.max_bytes = max_bytes if max_bytes else journal_max_bytes()
+        self.events = 0
+        self.dropped = 0
+        self._lock = threading.Lock()
+        #: lines queued by stage() — encoded but not yet on disk; any
+        #: later record()/flush_staged()/close() writes them first, so
+        #: relative order is fixed at stage time
+        self._staged: list[str] = []
+        self._closed = False
+        # strict open: a bad path must fail the CALLER now, not lose
+        # every line later (serve startup converts this to a failed
+        # start)
+        self._fh = open(path, "a", encoding="utf-8")
+        self._size = self._fh.tell()
+
+    def _encode(self, event: str, job: str | None,
+                trace: str | None, fields: dict) -> str | None:
+        doc: dict = {"t": round(time.time(), 6), "event": event}
+        if job is not None:
+            doc["job"] = job
+        if trace is not None:
+            doc["trace"] = trace
+        for k, v in fields.items():
+            if v is not None:
+                doc[k] = v
+        try:
+            # ensure_ascii (the json default) is load-bearing: it keeps
+            # every line pure ASCII, so len(line) == on-disk bytes and
+            # the max_bytes accounting in _write_locked stays exact
+            return json.dumps(doc, separators=(",", ":"),
+                              default=str) + "\n"
+        except ValueError:
+            self.dropped += 1
+            return None
+
+    def record(self, event: str, job: str | None = None,
+               trace: str | None = None, **fields) -> None:
+        """Append one lifecycle line (draining any staged lines first,
+        in order). Never raises: after a successful open, journal loss
+        is accounted (`dropped`), not fatal."""
+        line = self._encode(event, job, trace, fields)
+        if line is None:
+            return
+        with self._lock:
+            self._write_locked(line)
+
+    def stage(self, event: str, job: str | None = None,
+              trace: str | None = None, **fields) -> None:
+        """Queue one line WITHOUT touching the disk — for callers
+        holding a hot lock (the JobQueue fires admitted/expired under
+        its mutex, and a stalled journal device must not stall every
+        submit/pop/scrape behind it). Staged lines keep their relative
+        order and are flushed by the next record()/flush_staged()/
+        close(); until then they are memory-only (the one crash-
+        durability exception to the flush-per-line rule)."""
+        line = self._encode(event, job, trace, fields)
+        if line is None:
+            return
+        with self._lock:
+            self._staged.append(line)
+
+    def flush_staged(self) -> None:
+        """Write any staged lines now — called from lock-free contexts
+        (the serve handler after its job resolves)."""
+        with self._lock:
+            self._write_locked(None)
+
+    def _write_locked(self, line: str | None) -> None:
+        if self._closed:
+            self.dropped += len(self._staged) + (1 if line else 0)
+            self._staged.clear()
+            return
+        pending, self._staged = self._staged, []
+        if line is not None:
+            pending.append(line)
+        for ln in pending:
+            try:
+                if self._fh is None:
+                    # a failed rotation (or transient reopen failure)
+                    # dropped the handle; write failures are TRANSIENT
+                    # by contract, so retry the open on every line —
+                    # the journal heals when the condition clears
+                    self._fh = open(self.path, "a", encoding="utf-8")
+                    self._size = self._fh.tell()
+                if self._size + len(ln) > self.max_bytes:
+                    self._rotate_locked()
+                self._fh.write(ln)
+                self._fh.flush()
+                self._size += len(ln)
+                self.events += 1
+            except OSError:
+                self.dropped += 1
+
+    def _rotate_locked(self) -> None:
+        # drop the handle FIRST: if replace/reopen raises, _fh is None
+        # and the next write retries the open instead of writing into
+        # a permanently-closed file
+        try:
+            self._fh.close()
+        except OSError:
+            pass
+        self._fh = None
+        os.replace(self.path, self.path + ".1")
+        self._fh = open(self.path, "a", encoding="utf-8")
+        self._size = 0
+
+    def close(self) -> None:
+        with self._lock:
+            if not self._closed:
+                try:
+                    self._write_locked(None)
+                    if self._fh is not None:
+                        self._fh.close()
+                except OSError:
+                    pass
+                self._fh = None
+                self._closed = True
+
+
+def read_journal(path: str) -> list[dict]:
+    """Entries from both generations (`<path>.1` first, then `<path>`),
+    oldest first. Unparseable lines (a torn write at crash) are skipped,
+    not fatal — the journal is evidence, and partial evidence beats an
+    exception."""
+    entries: list[dict] = []
+    for p in (path + ".1", path):
+        if not os.path.isfile(p):
+            continue
+        with open(p, encoding="utf-8", errors="replace") as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    doc = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(doc, dict):
+                    entries.append(doc)
+    return entries
+
+
+def check_consistency(entries: list[dict]) -> list[str]:
+    """Lifecycle invariants over journal entries; returns human-readable
+    problem strings (empty = consistent):
+
+      - every job reaches EXACTLY one terminal state;
+      - finished/failed jobs have a `started` event (when their start of
+        life — `received` — is inside the journal window; rotation may
+        have cut older jobs' early events, which is not an error);
+      - a `started` job never also terminates as expired/rejected.
+    """
+    jobs: dict[str, list[str]] = {}
+    for e in entries:
+        job = e.get("job")
+        if job:
+            jobs.setdefault(str(job), []).append(str(e.get("event")))
+    problems: list[str] = []
+    for job, events in sorted(jobs.items()):
+        terminal = [e for e in events if e in TERMINAL_EVENTS]
+        if not terminal:
+            problems.append(f"job {job}: no terminal state ({events})")
+        elif len(terminal) > 1:
+            problems.append(
+                f"job {job}: {len(terminal)} terminal states {terminal}")
+        started = "started" in events
+        if started and terminal and terminal[0] not in RAN_EVENTS:
+            problems.append(
+                f"job {job}: started but terminated as {terminal[0]}")
+        if (not started and terminal
+                and terminal[0] in RAN_EVENTS
+                and "received" in events):
+            problems.append(
+                f"job {job}: {terminal[0]} without a started event")
+    return problems

@@ -20,7 +20,15 @@ No client library, no registry singletons: callers hand `render()` the
 numbers they already have (the serve stats snapshot, a HistogramSet) and
 get back one scrape body. serve/server.py exposes it on the `scrape`
 frame RPC and on the optional localhost HTTP port
-(RACON_TPU_SERVE_METRICS_PORT / `racon_tpu serve --metrics-port`)."""
+(RACON_TPU_SERVE_METRICS_PORT / `racon_tpu serve --metrics-port`).
+
+Restart semantics (the process_start_time_seconds convention): every
+counter here resets at process start, so the serve exposition pairs its
+cumulative series with the `racon_tpu_serve_uptime_seconds` and
+`racon_tpu_serve_start_time_seconds` gauges — a counter reset with a
+CHANGED start_time is a restart, with an unchanged one a bug; a flat
+queue-depth gauge plus advancing uptime is a quiet server, not a dead
+one."""
 
 from __future__ import annotations
 

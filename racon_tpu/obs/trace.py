@@ -110,6 +110,16 @@ class TraceRecorder:
         # fail the faultcheck gate and misrender in Perfetto
         return round(max(0.0, t - self._base) * 1e6, 3)
 
+    def rebase(self, base: float) -> None:
+        """Move the recorder's time zero EARLIER, to perf_counter
+        `base`, so spans that predate its creation — a serve job's
+        queue wait — keep their real offsets instead of clamping to 0.
+        Only valid before events are recorded with the old base (the
+        serve layer calls it first thing inside a fresh per-job scope);
+        later-or-equal bases are ignored."""
+        if base < self._base:
+            self._base = base
+
     def complete(self, name: str, t0: float, t1: float,
                  args: dict | None = None) -> None:
         """Record a finished span from its `time.perf_counter` endpoints
@@ -280,6 +290,29 @@ def save(path: str | None = None) -> str | None:
     if tr is None or not (path or tr.path):
         return None
     return tr.save(path)
+
+
+def rebase_events(events: list[dict], pid: int, shift_us: float = 0.0,
+                  name: str | None = None) -> list[dict]:
+    """Re-stamp a snapshot of trace events onto process `pid`, shifting
+    span/instant timestamps by `shift_us` — how a REMOTE recorder's
+    events (the serve layer's per-job trace, whose clock is the
+    server's perf_counter) merge into a local timeline as their own
+    Perfetto process track. Returns fresh event dicts (inputs are not
+    mutated), prefixed with a `process_name` metadata event when `name`
+    is given; thread metadata ("M") keeps its original timestampless
+    shape so track labels survive the move."""
+    out: list[dict] = []
+    if name is not None:
+        out.append({"name": "process_name", "ph": "M", "pid": pid,
+                    "args": {"name": name}})
+    for ev in events:
+        ev = dict(ev)
+        ev["pid"] = pid
+        if ev.get("ph") != "M" and "ts" in ev:
+            ev["ts"] = round(max(0.0, ev["ts"] + shift_us), 3)
+        out.append(ev)
+    return out
 
 
 def span(name: str, **args):

@@ -52,6 +52,67 @@ class _Ticket:
         self.round_info: dict | None = None
 
 
+class _RoundProgress:
+    """Duck-typed Logger for shared rounds: the engine sees the usual
+    `bar_total`/`bar` surface, but instead of stderr the bin-level ticks
+    fan out to every participating job's live-progress hook, scaled to
+    that job's own window count (a tick in a shared round advances every
+    participant's bar by its share — windows are not attributable to
+    jobs mid-engine, fractions of the round are). Monotonicity across
+    re-armed bars (an engine's fallback pass calls bar_total again) is
+    enforced downstream by Polisher.emit_progress' per-phase
+    high-water mark. Silent by design: shared rounds never print."""
+
+    def __init__(self, tickets, round_no: int):
+        self._jobs = [(t.polisher, len(t.polisher.windows))
+                      for t in tickets
+                      if t.polisher.progress_hook is not None]
+        self._round = round_no
+        self._total = 1
+        self._count = 0
+        self._bins = 0
+        self._lock = threading.Lock()
+
+    @property
+    def active(self) -> bool:
+        return bool(self._jobs)
+
+    def bar_total(self, total: int) -> None:
+        with self._lock:
+            self._total = max(1, int(total))
+            self._count = 0
+            self._bins = 0
+
+    def bar(self, msg: str) -> None:
+        with self._lock:
+            self._count += 1
+            bins = min(20 * self._count // self._total, 20)
+            if bins == self._bins:
+                return
+            self._bins = bins
+            frac = min(1.0, self._count / self._total)
+        for polisher, n in self._jobs:
+            polisher.emit_progress(int(frac * n), n, phase="consensus",
+                                   round=self._round)
+
+    # the rest of the Logger surface, defensively no-op
+    def log(self, msg=None) -> None:
+        pass
+
+    def total(self, msg) -> None:
+        pass
+
+
+def _trace_ids(tickets) -> list[str]:
+    """The client-minted trace ids riding this round's jobs (the server
+    stamps `serve_trace_id` on each job's polisher) — tagged onto the
+    gather/round spans so a merged client+server trace can attribute
+    shared rounds."""
+    return [tid for tid in
+            (getattr(t.polisher, "serve_trace_id", None) for t in tickets)
+            if tid]
+
+
 def _engine_key(p) -> tuple:
     """Engine-parameter identity: jobs share a pass only when every
     knob that can influence a window's consensus bytes matches."""
@@ -129,6 +190,7 @@ class WindowBatcher:
             ticket.event.wait()
         else:
             t_gather = time.monotonic()
+            t_gather_pc = time.perf_counter()
             deadline = t_gather + self.gather_window_s
             hint = self.active_hint
             with self._cond:
@@ -147,6 +209,12 @@ class WindowBatcher:
                 # release the key BEFORE executing: tickets arriving
                 # mid-round start gathering the next round immediately
                 self._leading.discard(key)
+            tr = trace.get_tracer()
+            if tr is not None:
+                tr.complete("serve.gather_wait", t_gather_pc,
+                            time.perf_counter(),
+                            {"jobs": len(batch),
+                             "trace_ids": _trace_ids(batch)})
             self._execute(batch)
         if ticket.error is not None:
             raise ticket.error
@@ -168,6 +236,7 @@ class WindowBatcher:
         for t in tickets:
             windows.extend(t.polisher.windows)
         rnd = next(self._round_seq)
+        progress = _RoundProgress(tickets, rnd)
         try:
             with self._exec_lock:
                 pre_c, pre_s = self._compile_totals()
@@ -183,7 +252,9 @@ class WindowBatcher:
                                   device_batches=p0.tpu_poa_batches,
                                   banded=p0.tpu_banded_alignment,
                                   band_width=p0.tpu_aligner_band_width,
-                                  logger=None, engine=p0.tpu_engine,
+                                  logger=(progress if progress.active
+                                          else None),
+                                  engine=p0.tpu_engine,
                                   pipeline=pipeline,
                                   scheduler=self.scheduler)
                 t0 = time.perf_counter()
@@ -195,7 +266,8 @@ class WindowBatcher:
             if tr is not None:
                 tr.complete("serve.batch_round", t0, t1,
                             {"round": rnd, "jobs": len(tickets),
-                             "windows": len(windows)})
+                             "windows": len(windows),
+                             "trace_ids": _trace_ids(tickets)})
             if self.hists is not None:
                 self.hists.observe("serve.round", t1 - t0)
         except BaseException as exc:
@@ -213,6 +285,11 @@ class WindowBatcher:
                 "compile_s": round(post_s - pre_s, 3),
                 "round_s": round(t1 - t0, 4)}
         self._account(len(tickets), len(windows), solo=False)
+        for polisher, n in progress._jobs:
+            # the round is done: every participant's consensus bar
+            # completes even if the engine's tick quantization stopped
+            # short of the last bin
+            polisher.emit_progress(n, n, phase="consensus", round=rnd)
         for t in tickets:
             t.round_info = dict(info, job_windows=len(t.polisher.windows))
             t.event.set()

@@ -15,15 +15,30 @@ strings:
 
 `racon_tpu submit ...` (cli.py) is the CLI face: same three positional
 inputs as the one-shot CLI, polished FASTA on stdout — byte-identical
-to the one-shot run, just served warm.
+to the one-shot run, just served warm. Two observability extras ride
+the same submit (README "End-to-end tracing & progress"):
+
+  - `--progress` / `submit(..., on_progress=cb)`: the server interleaves
+    `progress` frames (queue position while pending, then phase /
+    windows-done / total) before the final result frame — live
+    visibility into a job that used to be a black box until its bytes
+    arrived.
+  - `--trace-out t.json` / `submit_traced(...)`: the client mints a
+    `trace_id`, estimates the server's perf_counter offset from an
+    RTT-bracketed ping handshake, records its OWN spans (connect /
+    submit / wait / receive, progress instants), asks the server for
+    the job's server-side trace, and merges both into one Chrome-trace
+    JSON — two Perfetto process tracks on a single timeline.
 """
 
 from __future__ import annotations
 
+import json
 import os
 import socket
 import sys
 import time
+import uuid
 
 from .protocol import WIRE_LIMIT, recv_frame, send_frame
 from .server import DEFAULT_SOCKET
@@ -59,7 +74,8 @@ _ERROR_TYPES = {"queue-full": QueueFull, "draining": ServerDraining,
 
 
 class PolishResult:
-    __slots__ = ("job_id", "fasta", "metrics", "serve", "trace")
+    __slots__ = ("job_id", "fasta", "metrics", "serve", "trace",
+                 "trace_base_mono")
 
     def __init__(self, resp: dict):
         self.job_id = resp.get("job_id")
@@ -67,6 +83,9 @@ class PolishResult:
         self.metrics = resp.get("metrics") or {}
         self.serve = resp.get("serve") or {}
         self.trace = resp.get("trace")
+        #: the server-side recorder's time zero in SERVER perf_counter
+        #: terms — merge_trace() needs it to rebase server spans
+        self.trace_base_mono = resp.get("trace_base_mono")
 
 
 class PolishClient:
@@ -88,16 +107,56 @@ class PolishClient:
             sock.connect(self.socket_path)
         return sock
 
-    def request(self, obj: dict) -> dict:
+    def request(self, obj: dict, on_progress=None,
+                recorder=None) -> dict:
         """One round trip; raises the ServeError taxonomy on a typed
-        error response."""
+        error response. Interleaved `progress` frames (a `submit` with
+        "progress": true) are handed to `on_progress` as they arrive;
+        the method returns on the first non-progress frame. `recorder`
+        (an obs.trace.TraceRecorder) captures client-side spans —
+        connect / submit / wait / receive plus a `client.progress`
+        instant per progress frame — passed PER CALL so one client may
+        serve concurrent threads without a traced request absorbing an
+        unrelated request's spans."""
+        rec = recorder
+        t0 = time.perf_counter()
         sock = self._connect()
+        if rec is not None:
+            rec.complete("client.connect", t0, time.perf_counter())
+        frames = 0
         try:
+            t_send = time.perf_counter()
             send_frame(sock, obj)
-            # results come from a trusted server: accept up to the wire
-            # limit, not the server's anti-abuse request ceiling — a
-            # multi-hundred-MiB polished assembly must come back whole
-            resp = recv_frame(sock, max_frame=WIRE_LIMIT)
+            t_wait = time.perf_counter()
+            if rec is not None:
+                rec.complete("client.submit", t_send, t_wait,
+                             {"type": obj.get("type")})
+            while True:
+                # results come from a trusted server: accept up to the
+                # wire limit, not the server's anti-abuse request
+                # ceiling — a multi-hundred-MiB polished assembly must
+                # come back whole
+                resp = recv_frame(sock, max_frame=WIRE_LIMIT)
+                # stamped AFTER the recv: the blocking time (server
+                # compute + transfer) belongs to client.wait — stamping
+                # before would charge a whole no-progress polish to
+                # client.receive and ~0 to wait
+                t_frame = time.perf_counter()
+                if resp is None or resp.get("type") != "progress":
+                    break
+                frames += 1
+                if rec is not None:
+                    rec.instant("client.progress",
+                                {k: resp[k] for k in
+                                 ("phase", "done", "total", "position",
+                                  "job_id") if k in resp})
+                if on_progress is not None:
+                    on_progress(resp)
+            if rec is not None:
+                now = time.perf_counter()
+                rec.complete("client.wait", t_wait, t_frame,
+                             {"progress_frames": frames})
+                rec.complete("client.receive", t_frame, now)
         finally:
             sock.close()
         if resp is None:
@@ -109,16 +168,45 @@ class PolishClient:
                 code, resp.get("message", ""), resp)
         return resp
 
+    def clock_sync(self, samples: int = 3) -> dict:
+        """Estimate the server's perf_counter offset from RTT-bracketed
+        pings: for each sample, offset = server_mono - client RTT
+        midpoint; the minimum-RTT sample wins (least queueing noise).
+        Returns {"offset_s", "rtt_s"} — merge_trace() uses the offset
+        to put server spans on the client timeline, good to ~rtt/2."""
+        best = None
+        for _ in range(max(1, samples)):
+            t0 = time.perf_counter()
+            pong = self.request({"type": "ping"})
+            t1 = time.perf_counter()
+            mono = pong.get("mono_s")
+            if mono is None:
+                raise ServeError(
+                    "bad-response",
+                    "server ping carries no mono_s clock sample "
+                    "(pre-tracing server?)", pong)
+            cand = {"offset_s": float(mono) - (t0 + t1) / 2.0,
+                    "rtt_s": t1 - t0}
+            if best is None or cand["rtt_s"] < best["rtt_s"]:
+                best = cand
+        return best
+
     # ------------------------------------------------------------ calls
     def submit(self, sequences: str, overlaps: str, target: str, *,
                options: dict | None = None, priority: int = 0,
                deadline_s: float | None = None,
                fault_plan: str | None = None, strict: bool | None = None,
-               trace: bool = False, retries: int = 0) -> PolishResult:
+               trace: bool = False, trace_id: str | None = None,
+               on_progress=None, recorder=None,
+               retries: int = 0) -> PolishResult:
         """Polish one input triple on the server. Paths are resolved to
         absolute before they cross the wire (the server's cwd is not the
         client's). `retries` re-submits after `retry_after` on full-queue
-        rejects — simple client-side backoff."""
+        rejects — simple client-side backoff. `on_progress` (callable
+        taking each progress frame dict) turns on the server's live
+        progress stream; `trace_id` stamps the job's server-side spans,
+        journal lines and progress frames with a client-chosen
+        correlation id."""
         req = {"type": "submit",
                "sequences": os.path.abspath(sequences),
                "overlaps": os.path.abspath(overlaps),
@@ -135,15 +223,45 @@ class PolishClient:
             req["strict"] = bool(strict)
         if trace:
             req["trace"] = True
+        if trace_id:
+            req["trace_id"] = str(trace_id)
+        if on_progress is not None:
+            req["progress"] = True
         attempt = 0
         while True:
             try:
-                return PolishResult(self.request(req))
+                return PolishResult(
+                    self.request(req, on_progress=on_progress,
+                                 recorder=recorder))
             except QueueFull as exc:
                 if attempt >= retries:
                     raise
                 attempt += 1
                 time.sleep(exc.retry_after)
+
+    def submit_traced(self, sequences: str, overlaps: str, target: str,
+                      *, trace_out: str | None = None, on_progress=None,
+                      **kw) -> tuple[PolishResult, dict]:
+        """One end-to-end traced submit: mints a trace_id (unless `kw`
+        carries one), handshakes the server clock offset, records
+        client-side spans, requests the server-side per-job trace, and
+        merges both into a single Chrome-trace JSON (written to
+        `trace_out` when given). Returns (result, merged_doc)."""
+        from ..obs.trace import TraceRecorder
+
+        kw.pop("trace", None)
+        trace_id = kw.pop("trace_id", None) or uuid.uuid4().hex[:16]
+        clock = self.clock_sync()
+        rec = TraceRecorder(None)
+        result = self.submit(sequences, overlaps, target,
+                             trace=True, trace_id=trace_id,
+                             on_progress=on_progress, recorder=rec,
+                             **kw)
+        doc = merge_trace(result, rec, clock, trace_id=trace_id)
+        if trace_out:
+            with open(trace_out, "w") as fh:
+                json.dump(doc, fh)
+        return result, doc
 
     def ping(self) -> dict:
         return self.request({"type": "ping"})
@@ -164,6 +282,68 @@ class PolishClient:
 
     def shutdown(self) -> dict:
         return self.request({"type": "shutdown"})
+
+
+def merge_trace(result: PolishResult, client_rec, clock: dict,
+                trace_id: str | None = None) -> dict:
+    """Merge the server's per-job trace (`result.trace`, timestamps in
+    the SERVER recorder's timeline) with the client recorder's events
+    into one Chrome-trace document on the client clock: client spans on
+    pid 1, server spans on pid 2, both labeled via process_name
+    metadata. A server event at ts (µs past `result.trace_base_mono`)
+    lands at server_mono - offset on the client's perf_counter, then
+    rebases onto the client recorder's zero. Accuracy is the handshake's
+    ±rtt/2 — microseconds on localhost, which is what the transports
+    here are."""
+    from ..obs.trace import rebase_events
+
+    events = rebase_events(client_rec.events(), pid=1,
+                           name="racon_tpu client")
+    if result.trace and result.trace_base_mono is not None:
+        shift_us = ((result.trace_base_mono - clock["offset_s"])
+                    - client_rec._base) * 1e6
+        events += rebase_events(result.trace, pid=2, shift_us=shift_us,
+                                name="racon_tpu server")
+    events.sort(key=lambda e: (e.get("ph") != "M", e.get("ts", 0.0)))
+    return {"traceEvents": events, "displayTimeUnit": "ms",
+            "trace_context": {
+                "trace_id": trace_id,
+                "job_id": result.job_id,
+                "clock_offset_s": round(clock["offset_s"], 6),
+                "clock_rtt_s": round(clock["rtt_s"], 6)}}
+
+
+class _ProgressPrinter:
+    """stderr renderer for `submit --progress`: a \\r-redrawn status
+    line on a tty, one line per phase transition when stderr is a pipe
+    (so logs stay readable, mirroring the Logger bar discipline)."""
+
+    def __init__(self):
+        self._last_phase = None
+        self._tty = sys.stderr.isatty()
+
+    def __call__(self, ev: dict) -> None:
+        phase = ev.get("phase", "?")
+        if phase == "queued":
+            text = (f"queued at position {ev.get('position', '?')} "
+                    f"(depth {ev.get('depth', '?')})")
+        elif ev.get("total"):
+            unit = (" windows" if phase in ("consensus", "stitch")
+                    else "")  # align counts overlap pairs
+            text = f"{phase} {ev.get('done', 0)}/{ev['total']}{unit}"
+        else:
+            text = phase
+        if self._tty:
+            sys.stderr.write(f"\r[racon_tpu::submit] {text:<56}")
+            sys.stderr.flush()
+        elif phase != self._last_phase:
+            print(f"[racon_tpu::submit] {text}", file=sys.stderr)
+        self._last_phase = phase
+
+    def close(self) -> None:
+        if self._tty and self._last_phase is not None:
+            sys.stderr.write("\n")
+            sys.stderr.flush()
 
 
 # ------------------------------------------------------------------ CLI
@@ -193,6 +373,18 @@ def submit_main(argv: list[str]) -> int:
                          "flight-recorder dump)")
     ap.add_argument("--retries", type=int, default=0,
                     help="re-submit after retry_after on queue-full")
+    ap.add_argument("--progress", action="store_true",
+                    help="stream live progress to stderr while the job "
+                         "runs: queue position while pending, then "
+                         "phase / windows-done / total as the server "
+                         "interleaves progress frames before the "
+                         "result")
+    ap.add_argument("--trace-out", metavar="PATH", default=None,
+                    help="end-to-end trace: record client-side spans, "
+                         "fetch the job's server-side spans, and write "
+                         "ONE merged Chrome-trace JSON (open in "
+                         "Perfetto) with both sides on a handshake-"
+                         "aligned timeline")
     ap.add_argument("-u", "--include-unpolished", action="store_true")
     ap.add_argument("-f", "--fragment-correction", action="store_true")
     ap.add_argument("-w", "--window-length", type=int, default=None)
@@ -228,14 +420,30 @@ def submit_main(argv: list[str]) -> int:
 
     client = PolishClient(socket_path=args.socket, port=args.port,
                           timeout=args.timeout)
+    on_progress = _ProgressPrinter() if args.progress else None
+    common = dict(options=options, priority=args.priority,
+                  deadline_s=args.deadline, retries=args.retries,
+                  on_progress=on_progress)
+    trace_doc = None
     try:
-        result = client.submit(args.sequences, args.overlaps, args.target,
-                               options=options, priority=args.priority,
-                               deadline_s=args.deadline,
-                               retries=args.retries)
+        if args.trace_out:
+            # trace_out deliberately NOT passed through: the artifact
+            # is written below, AFTER the polished bytes reach stdout —
+            # an unwritable trace path must not discard a completed
+            # polish (same posture as the metrics/trace flush in
+            # emit_observability)
+            result, trace_doc = client.submit_traced(
+                args.sequences, args.overlaps, args.target, **common)
+        else:
+            result = client.submit(args.sequences, args.overlaps,
+                                   args.target, **common)
     except (ServeError, OSError) as exc:
+        if on_progress is not None:
+            on_progress.close()
         print(f"[racon_tpu::serve] error: {exc}", file=sys.stderr)
         return 1
+    if on_progress is not None:
+        on_progress.close()
     sys.stdout.buffer.write(result.fasta)
     sys.stdout.buffer.flush()
     serve = result.serve
@@ -243,4 +451,15 @@ def submit_main(argv: list[str]) -> int:
         print(f"[racon_tpu::serve] job {result.job_id}: queue wait "
               f"{serve.get('queue_wait_s', 0):.3f}s, exec "
               f"{serve.get('exec_s', 0):.3f}s", file=sys.stderr)
+    if trace_doc is not None:
+        try:
+            with open(args.trace_out, "w") as fh:
+                json.dump(trace_doc, fh)
+            print(f"[racon_tpu::serve] merged client+server trace "
+                  f"written to {args.trace_out} (open in "
+                  "https://ui.perfetto.dev)", file=sys.stderr)
+        except OSError as exc:
+            print(f"[racon_tpu::serve] warning: could not write trace "
+                  f"to {args.trace_out} ({exc}); polished FASTA is "
+                  "unaffected", file=sys.stderr)
     return 0

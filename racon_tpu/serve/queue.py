@@ -80,13 +80,16 @@ class Job:
     __slots__ = ("id", "sequences", "overlaps", "target", "options",
                  "priority", "deadline", "fault_plan", "strict",
                  "want_trace", "enqueued_t", "started_t", "response",
-                 "event", "stats_ref")
+                 "event", "stats_ref", "trace_id", "want_progress",
+                 "_progress", "_progress_cv")
 
     def __init__(self, id_: str, sequences: str, overlaps: str,
                  target: str, options: dict, priority: int = 0,
                  deadline_s: float | None = None,
                  fault_plan: str | None = None,
-                 strict: bool | None = None, want_trace: bool = False):
+                 strict: bool | None = None, want_trace: bool = False,
+                 trace_id: str | None = None,
+                 want_progress: bool = False):
         self.id = id_
         self.sequences = sequences
         self.overlaps = overlaps
@@ -99,6 +102,13 @@ class Job:
         self.fault_plan = fault_plan
         self.strict = strict
         self.want_trace = bool(want_trace)
+        #: client-minted trace-context id: rides every progress frame,
+        #: journal line and serve-side span for this job, so a client
+        #: artifact and the server's telemetry correlate by construction
+        self.trace_id = trace_id
+        self.want_progress = bool(want_progress)
+        self._progress: deque = deque()
+        self._progress_cv = threading.Condition()
         self.started_t: float | None = None
         self.response: dict | None = None
         self.event = threading.Event()
@@ -110,6 +120,26 @@ class Job:
     @property
     def queue_wait_s(self) -> float:
         return (self.started_t or time.perf_counter()) - self.enqueued_t
+
+    # -------------------------------------------------- progress relay
+    def notify_progress(self, ev: dict) -> None:
+        """Queue one progress event for the handler thread streaming
+        this job's connection (server.py). Worker/pipeline threads call
+        it (via the polisher's progress hook); a no-op unless the
+        client asked for progress, so the clean path stays free."""
+        if not self.want_progress:
+            return
+        with self._progress_cv:
+            self._progress.append(ev)
+            self._progress_cv.notify()
+
+    def next_progress(self, timeout: float | None = None) -> dict | None:
+        """Pop the oldest pending progress event, waiting up to
+        `timeout` for one; None when nothing arrived."""
+        with self._progress_cv:
+            if not self._progress and timeout:
+                self._progress_cv.wait(timeout)
+            return self._progress.popleft() if self._progress else None
 
 
 class JobQueue:
@@ -127,6 +157,11 @@ class JobQueue:
         self._not_empty = threading.Condition(self._lock)
         self._heap: list = []
         self._seq = itertools.count()
+        #: bumped on every push/pop: progress streamers poll queue
+        #: position while their job is pending, and the version lets
+        #: them skip the O(n log n) position() recompute (and its lock
+        #: acquisition) when nothing moved
+        self._version = 0
         self._draining = False
         #: EMA of job service seconds, seeded pessimistically so the
         #: first rejections before any completion still back off
@@ -136,6 +171,17 @@ class JobQueue:
         self._recent: deque = deque(maxlen=self.ROLLING_JOBS)
         #: optional obs.hist.HistogramSet (the server's lifetime set)
         self.hists = hists
+        #: optional callable(event: str, job: Job, **fields) fired on
+        #: queue-side lifecycle transitions (`admitted`, `started`,
+        #: `expired`) — the server wires its event journal
+        #: (obs/journal.py) and the progress relay here. `admitted` and
+        #: `expired` fire UNDER the queue lock (admitted must
+        #: happen-before the popping worker's started): the callback
+        #: must not call back into the queue; `started` fires on the
+        #: worker thread after pop releases the lock, keeping the
+        #: per-job disk write off the hot lock. Exceptions are
+        #: swallowed — accounting must never strand a job.
+        self.on_event = None
         self.counters = {"submitted": 0, "admitted": 0, "rejected_full": 0,
                          "rejected_draining": 0, "expired": 0,
                          "completed": 0, "failed": 0,
@@ -162,6 +208,13 @@ class JobQueue:
             self.counters["admitted"] += 1
             heapq.heappush(self._heap,
                            (-job.priority, next(self._seq), job))
+            self._version += 1
+            # fired UNDER the lock deliberately: a worker can pop this
+            # job the instant the lock releases, and the journal's
+            # `admitted` line must happen-before its `started` line.
+            # The on_event contract keeps under-lock callbacks disk-
+            # free (the server STAGES this event; see its sink)
+            self._notify("admitted", job, depth=len(self._heap))
             self._not_empty.notify()
 
     # ------------------------------------------------------------- pop
@@ -171,10 +224,12 @@ class JobQueue:
         never see them."""
         deadline = (time.monotonic() + timeout
                     if timeout is not None else None)
+        popped: Job | None = None
         with self._not_empty:
-            while True:
+            while popped is None:
                 while self._heap:
                     _, _, job = heapq.heappop(self._heap)
+                    self._version += 1
                     now = time.perf_counter()
                     if job.deadline is not None and now > job.deadline:
                         self.counters["expired"] += 1
@@ -182,13 +237,18 @@ class JobQueue:
                         job.response = {
                             "type": "error", "code": "deadline-expired",
                             "message": str(exc), "job_id": job.id}
+                        self._notify("expired", job,
+                                     waited_s=round(exc.waited, 4))
                         job.event.set()
                         continue
                     job.started_t = now
                     if self.hists is not None:
                         self.hists.observe("job.queue_wait",
                                            now - job.enqueued_t)
-                    return job
+                    popped = job
+                    break
+                if popped is not None:
+                    break
                 if deadline is not None:
                     left = deadline - time.monotonic()
                     if left <= 0 or not self._not_empty.wait(left):
@@ -196,6 +256,14 @@ class JobQueue:
                             return None
                 else:
                     self._not_empty.wait()
+        # fired OUTSIDE the lock: `started` triggers a journal write
+        # (disk) on the per-job hot path, and the admitted->started
+        # ordering is already guaranteed by `admitted` firing under the
+        # submit lock that this pop had to wait out
+        self._notify("started", popped,
+                     queue_wait_s=round(
+                         popped.started_t - popped.enqueued_t, 4))
+        return popped
 
     def task_done(self, job: Job, ok: bool, service_s: float) -> bool:
         """Account a finished job. Returns True when the job carried a
@@ -218,6 +286,33 @@ class JobQueue:
                                time.perf_counter() - job.enqueued_t)
         return missed
 
+    def _notify(self, event: str, job: Job, **fields) -> None:
+        cb = self.on_event
+        if cb is None:
+            return
+        try:
+            cb(event, job, **fields)
+        except Exception:  # noqa: BLE001 — see on_event contract
+            pass
+
+    @property
+    def version(self) -> int:
+        with self._lock:
+            return self._version
+
+    def position(self, job: Job) -> int | None:
+        """0-based count of queued jobs that would pop before `job`, or
+        None once the job is no longer queued (started / expired) — the
+        live queue-position number the progress stream reports while a
+        job is pending."""
+        with self._lock:
+            # heap entries sort exactly in pop order: (-priority, seq)
+            # is unique, so the job object itself is never compared
+            for i, (_, _, j) in enumerate(sorted(self._heap)):
+                if j is job:
+                    return i
+        return None
+
     # ----------------------------------------------------------- drain
     def drain(self) -> None:
         """Stop admitting; queued jobs keep flowing to workers."""
@@ -237,9 +332,14 @@ class JobQueue:
     def snapshot(self) -> dict:
         with self._lock:
             recent = sorted(self._recent)
+            oldest = min((j.enqueued_t for _, _, j in self._heap),
+                         default=None)
             out = dict(self.counters, depth=len(self._heap),
                        maxsize=self.maxsize,
                        draining=self._draining,
+                       oldest_wait_s=(
+                           round(time.perf_counter() - oldest, 4)
+                           if oldest is not None else 0.0),
                        ema_service_s=round(self._ema_service_s, 4))
         if recent:
             n = len(recent)

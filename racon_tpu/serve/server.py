@@ -52,6 +52,7 @@ from ..obs import flight as obs_flight
 from ..obs import prom as obs_prom
 from ..obs import trace as obs_trace
 from ..obs.hist import HistogramSet
+from ..obs.journal import Journal
 from ..resilience import strict_scope
 from ..utils.logger import log_info
 from .batcher import WindowBatcher
@@ -139,10 +140,29 @@ class ServeConfig:
                 "(expected >= 0; 0 = ephemeral)")
         # flight recorder: directory for automatic per-job dumps when a
         # job fails / times out / misses its deadline; empty string or
-        # None disables dumping (the ring itself stays on)
+        # None disables dumping (the ring itself stays on). Resolution:
+        # kwarg > RACON_TPU_SERVE_FLIGHT_DIR > the process-wide
+        # RACON_TPU_FLIGHT_DIR (obs/flight.py) > the /tmp default.
+        # start() validates the resolved directory STRICTLY — a bad
+        # path fails the start, mirroring the --metrics-port discipline
+        #: whether the operator CHOSE the flight dir (kwarg or either
+        #: env knob): only then is startup validation strict — the
+        #: built-in /tmp default keeps PR-6's best-effort-per-dump
+        #: posture, so a plain `racon_tpu serve` on a host where
+        #: another user owns /tmp/racon_tpu_flight still starts
+        self.flight_dir_explicit = (
+            "flight_dir" in kw
+            or env("RACON_TPU_SERVE_FLIGHT_DIR") is not None
+            or obs_flight.default_dump_dir() is not None)
         self.flight_dir = kw.pop(
             "flight_dir", env("RACON_TPU_SERVE_FLIGHT_DIR",
-                              "/tmp/racon_tpu_flight"))
+                              obs_flight.default_dump_dir()
+                              or "/tmp/racon_tpu_flight"))
+        # durable event journal (obs/journal.py): JSONL lifecycle log of
+        # every job transition, keyed by job + trace id; None (the
+        # default) disables it. Also validated strictly at start()
+        self.journal_path = kw.pop(
+            "journal", env("RACON_TPU_SERVE_JOURNAL") or None)
         # polish defaults (jobs may override per request, except
         # num_threads: host threads are a server resource)
         self.window_length = kw.pop("window_length", 500)
@@ -265,6 +285,11 @@ class PolishServer:
         self._draining = threading.Event()
         self._stopped = threading.Event()
         self._t_start = time.perf_counter()
+        #: wall-clock start time: exposed as the
+        #: racon_tpu_serve_start_time_seconds gauge so a dashboard can
+        #: tell a restarted server from a quiet one
+        self._t_wall_start = time.time()
+        self.journal: Journal | None = None
         self._warm: dict | None = None
 
     # ---------------------------------------------------------- lifecycle
@@ -273,6 +298,39 @@ class PolishServer:
         worker pool and the accept loop. Returns self; the server is
         accepting when this returns."""
         cfg = self.config
+        # strict startup validation (the --metrics-port discipline): an
+        # operator who configured a flight-dump directory or an audit
+        # journal must find out NOW that the path is unusable, not at
+        # the first failed job / first lifecycle line
+        if cfg.flight_dir and cfg.flight_dir_explicit:
+            try:
+                os.makedirs(cfg.flight_dir, exist_ok=True)
+                probe = os.path.join(cfg.flight_dir,
+                                     f".probe_{os.getpid()}")
+                with open(probe, "w"):
+                    pass
+                os.unlink(probe)
+            except OSError as exc:
+                raise RaconError(
+                    "PolishServer.start",
+                    f"flight dump directory {cfg.flight_dir!r} is not "
+                    f"writable ({exc}); point --flight-dir / "
+                    "RACON_TPU_SERVE_FLIGHT_DIR / RACON_TPU_FLIGHT_DIR "
+                    "at a writable directory, or '' to disable "
+                    "dumping") from None
+        if cfg.journal_path:
+            try:
+                self.journal = Journal(cfg.journal_path)
+            except OSError as exc:
+                raise RaconError(
+                    "PolishServer.start",
+                    f"cannot open serve journal {cfg.journal_path!r} "
+                    f"({exc}); point --journal / "
+                    "RACON_TPU_SERVE_JOURNAL at a writable path") \
+                    from None
+        # queue-side lifecycle transitions (started / expired) feed the
+        # journal and the live progress relay
+        self.queue.on_event = self._on_queue_event
         # always-on flight recorder: when no full trace is armed,
         # install the bounded ring as the process tracer so every span
         # hook feeds it (<2% overhead, synthbench --flight A/Bs it);
@@ -311,14 +369,39 @@ class PolishServer:
                              name="racon-tpu-serve-accept", daemon=True)
         t.start()
         self._threads.append(t)
+        if self.journal is not None:
+            self.journal.record("serve-start", address=cfg.address,
+                                pid=os.getpid(), workers=cfg.workers,
+                                queue_depth=cfg.queue_depth)
         log_info(f"[racon_tpu::serve] listening on {cfg.address} "
                  f"({cfg.workers} workers, queue depth "
                  f"{cfg.queue_depth}"
                  + (f", warm in {self._warm['warmup_s']:.2f}s"
                     if self._warm else "")
                  + (f", metrics on 127.0.0.1:{cfg.metrics_port}"
-                    if self._http is not None else "") + ")")
+                    if self._http is not None else "")
+                 + (f", journal {cfg.journal_path}"
+                    if self.journal is not None else "") + ")")
         return self
+
+    def _on_queue_event(self, event: str, job: Job, **fields) -> None:
+        """JobQueue.on_event sink: journal the transition and, for a
+        progress-streaming job, announce the queue->worker handoff.
+        `admitted`/`expired` arrive UNDER the queue mutex, so they are
+        STAGED (memory-only, order-preserving) rather than written — a
+        stalled journal disk must not serialize every submit/pop/scrape
+        behind it; the handler flushes once its job resolves."""
+        if event == "started" and job.want_progress:
+            job.notify_progress(
+                {"phase": "start",
+                 "queue_wait_s": fields.get("queue_wait_s")})
+        if self.journal is not None:
+            if event in ("admitted", "expired"):
+                self.journal.stage(event, job=job.id,
+                                   trace=job.trace_id, **fields)
+            else:
+                self.journal.record(event, job=job.id,
+                                    trace=job.trace_id, **fields)
 
     def _start_metrics_http(self) -> None:
         """Serve Prometheus text on localhost HTTP (stdlib only). Bind
@@ -415,6 +498,10 @@ class PolishServer:
         self._draining.set()
         budget = (timeout if timeout is not None
                   else self.config.drain_timeout_s)
+        if self.journal is not None:
+            self.journal.record("drain", queued=len(self.queue),
+                                inflight=self._inflight,
+                                budget_s=round(budget, 1))
         log_info(f"[racon_tpu::serve] draining: {len(self.queue)} queued, "
                  f"{self._inflight} in flight (budget {budget:.0f}s)")
         self.queue.drain()
@@ -457,6 +544,12 @@ class PolishServer:
         if self.config.port is None:
             with contextlib.suppress(OSError):
                 os.unlink(self.config.socket_path)
+        if self.journal is not None:
+            self.journal.record(
+                "serve-stop", clean=clean,
+                completed=self.queue.counters["completed"],
+                failed=self.queue.counters["failed"])
+            self.journal.close()
         log_info(f"[racon_tpu::serve] drained "
                  f"{'cleanly' if clean else 'OVER BUDGET'}: "
                  f"{self.queue.counters['completed']} jobs completed, "
@@ -526,7 +619,7 @@ class PolishServer:
                 if req is None:
                     return
                 try:
-                    resp = self._dispatch(req)
+                    resp = self._dispatch(req, conn)
                 except Exception as exc:
                     # a handler bug answers typed and keeps serving;
                     # it never takes the process down
@@ -548,14 +641,18 @@ class PolishServer:
             with contextlib.suppress(OSError):
                 conn.close()
 
-    def _dispatch(self, req: dict) -> dict:
+    def _dispatch(self, req: dict, conn: socket.socket) -> dict:
         rtype = req.get("type")
         if rtype == "submit":
-            return self._submit(req)
+            return self._submit(req, conn)
         if rtype == "ping":
+            # mono_s is the clock-handshake sample: a tracing client
+            # RTT-brackets it to estimate this process's perf_counter
+            # offset, so merged client+server traces share one timeline
             return {"type": "pong", "warm": self._warm is not None,
                     "uptime_s": round(
-                        time.perf_counter() - self._t_start, 3)}
+                        time.perf_counter() - self._t_start, 3),
+                    "mono_s": time.perf_counter()}
         if rtype == "stats":
             return dict(self.stats_snapshot(), type="stats")
         if rtype == "scrape":
@@ -573,7 +670,14 @@ class PolishServer:
         return error_response("bad-request",
                               f"unknown request type {rtype!r}")
 
-    def _submit(self, req: dict) -> dict:
+    #: trace ids come from untrusted clients and ride journal lines,
+    #: file-adjacent artifacts and Prometheus-adjacent text — constrain
+    #: them to a boring charset instead of sanitizing at every sink
+    _TRACE_ID_OK = frozenset(
+        "abcdefghijklmnopqrstuvwxyz"
+        "ABCDEFGHIJKLMNOPQRSTUVWXYZ0123456789-_.")
+
+    def _submit(self, req: dict, conn: socket.socket) -> dict:
         for key in ("sequences", "overlaps", "target"):
             path = req.get(key)
             if not isinstance(path, str) or not path:
@@ -590,6 +694,14 @@ class PolishServer:
             return error_response(
                 "bad-request",
                 f"unknown option(s): {', '.join(sorted(unknown))}")
+        trace_id = req.get("trace_id")
+        if trace_id is not None and (
+                not isinstance(trace_id, str)
+                or not 0 < len(trace_id) <= 64
+                or not set(trace_id) <= self._TRACE_ID_OK):
+            return error_response(
+                "bad-request",
+                "trace_id must be 1-64 chars of [A-Za-z0-9._-]")
         fault_plan = req.get("fault_plan")
         if fault_plan:
             from ..resilience import FaultPlan
@@ -605,16 +717,93 @@ class PolishServer:
                   options, priority=int(req.get("priority", 0)),
                   deadline_s=req.get("deadline_s"),
                   fault_plan=fault_plan, strict=req.get("strict"),
-                  want_trace=bool(req.get("trace")))
+                  want_trace=bool(req.get("trace")),
+                  trace_id=trace_id,
+                  want_progress=bool(req.get("progress")))
+        if self.journal is not None:
+            self.journal.record("received", job=job.id, trace=trace_id,
+                                priority=job.priority or None,
+                                deadline_s=req.get("deadline_s"))
         try:
             self.queue.submit(job)
         except QueueFull as exc:
+            if self.journal is not None:
+                self.journal.record("rejected-full", job=job.id,
+                                    trace=trace_id,
+                                    retry_after=round(exc.retry_after, 3))
             return error_response("queue-full", str(exc),
                                   retry_after=round(exc.retry_after, 3),
                                   job_id=job_id)
         except Draining as exc:
+            if self.journal is not None:
+                self.journal.record("rejected-draining", job=job.id,
+                                    trace=trace_id)
             return error_response("draining", str(exc), job_id=job_id)
-        job.event.wait()
+        # `admitted` is STAGED by the queue's on_event hook under the
+        # submit lock (ordering vs `started` fixed at stage time, no
+        # disk I/O behind the queue mutex); flushed below once the job
+        # resolves, covering the expired-in-queue path too
+        if not job.want_progress:
+            job.event.wait()
+        else:
+            self._stream_progress(job, conn)
+        if self.journal is not None:
+            self.journal.flush_staged()
+        return job.response
+
+    def _stream_progress(self, job: Job, conn: socket.socket) -> dict:
+        """Forward the job's progress events as interleaved `progress`
+        frames on the submitting connection while waiting for the
+        result — including queue-position updates while the job is
+        still pending. Returns the final response for the handler to
+        send LAST, so the wire order is progress*, result. A client
+        that stops reading only loses its progress frames (the first
+        send error stops forwarding); the job itself runs to completion
+        and is accounted normally either way."""
+        seq = 0
+        last_pos = None
+        send_ok = True
+
+        def push(ev: dict) -> None:
+            nonlocal seq, send_ok
+            if not send_ok:
+                return
+            seq += 1
+            frame = {"type": "progress", "job_id": job.id, "seq": seq}
+            if job.trace_id:
+                frame["trace_id"] = job.trace_id
+            frame.update(ev)
+            try:
+                send_frame(conn, frame)
+            except (OSError, ProtocolError):
+                send_ok = False
+
+        last_version = None
+        while True:
+            ev = job.next_progress(timeout=0.05)
+            if ev is not None:
+                push(ev)
+                continue
+            if job.event.is_set():
+                break
+            # position recomputes (O(n log n) under the queue mutex)
+            # only when the queue actually moved, and not at all once
+            # the client stopped reading
+            if job.started_t is None and send_ok:
+                version = self.queue.version
+                if version != last_version:
+                    last_version = version
+                    pos = self.queue.position(job)
+                    if pos is not None and pos != last_pos:
+                        last_pos = pos
+                        push({"phase": "queued", "position": pos,
+                              "depth": len(self.queue)})
+        # the worker set the event after its last notify: drain the tail
+        while True:
+            ev = job.next_progress()
+            if ev is None:
+                break
+            push(ev)
         return job.response
 
     # ------------------------------------------------------------ workers
@@ -650,8 +839,26 @@ class PolishServer:
                 if job.stats_ref is not None \
                         and job.stats_ref.hists is not None:
                     self.hists.merge(job.stats_ref.hists)
-                missed = self.queue.task_done(
-                    job, ok, time.perf_counter() - t0)
+                service_s = time.perf_counter() - t0
+                missed = self.queue.task_done(job, ok, service_s)
+                if self.journal is not None:
+                    rnd = ((resp.get("serve") or {}).get("batch")
+                           if ok else None) or {}
+                    if rnd:
+                        self.journal.record(
+                            "round", job=job.id, trace=job.trace_id,
+                            round=rnd.get("round"),
+                            jobs=rnd.get("jobs"),
+                            windows=rnd.get("windows"))
+                    if missed:
+                        self.journal.record("deadline-miss", job=job.id,
+                                            trace=job.trace_id)
+                    self.journal.record(
+                        "finished" if ok else "failed",
+                        job=job.id, trace=job.trace_id,
+                        service_s=round(service_s, 4),
+                        sequences=resp.get("sequences"),
+                        error_type=resp.get("error_type"))
                 if not ok or missed:
                     # post-mortem artifact: the flight ring windowed to
                     # this job, with its stage stats riding along
@@ -682,6 +889,15 @@ class PolishServer:
         trace_ctx = (obs_trace.scoped() if job.want_trace
                      else contextlib.nullcontext())
         with strict_scope(job.strict), trace_ctx as rec:
+            if job.want_trace:
+                # the job's timeline starts at ENQUEUE, not at this
+                # worker pop: rebase the fresh per-job recorder so the
+                # queue-wait span keeps its real offset, then record it
+                # tagged with the client's trace context
+                rec.rebase(job.enqueued_t)
+                rec.complete("serve.queue_wait", job.enqueued_t,
+                             job.started_t or t0,
+                             {"job": job.id, "trace_id": job.trace_id})
             polisher = create_polisher(
                 job.sequences, job.overlaps, job.target,
                 PolisherType.kF if opts.get("fragment_correction")
@@ -718,6 +934,12 @@ class PolishServer:
             # live ref for the flight dump: a job that dies mid-phase
             # still gets its partial stage stats into the artifact
             job.stats_ref = polisher.pipeline_stats
+            # trace context + live progress ride the polisher: the
+            # batcher tags shared-round spans with serve_trace_id, and
+            # progress events relay through the job to the handler
+            polisher.serve_trace_id = job.trace_id
+            if job.want_progress:
+                polisher.progress_hook = job.notify_progress
             polisher.initialize()
             polished = polisher.polish(
                 not opts.get("include_unpolished", False),
@@ -732,7 +954,13 @@ class PolishServer:
                           "exec_s": round(time.perf_counter() - t0, 4),
                           "batch": getattr(polisher, "serve_round", None)}}
         if job.want_trace:
+            rec.complete("serve.job", t0, time.perf_counter(),
+                         {"job": job.id, "trace_id": job.trace_id})
             resp["trace"] = rec.events()
+            # the recorder's time zero in SERVER perf_counter terms:
+            # with the ping handshake's clock offset, the client maps
+            # every server span onto its own timeline (client.py)
+            resp["trace_base_mono"] = rec._base
         return resp
 
     # -------------------------------------------------- flight recorder
@@ -797,11 +1025,22 @@ class PolishServer:
         counters["serve.batch.multi_job_rounds"] = b["multi_job_rounds"]
         counters["serve.batch.windows"] = b["windows"]
         counters["serve.compiles"] = b["compiles"]
+        if self.journal is not None:
+            counters["serve.journal.events"] = self.journal.events
+            counters["serve.journal.dropped"] = self.journal.dropped
         gauges = {
-            "serve.uptime_seconds":
+            "serve.uptime_seconds": (
                 round(time.perf_counter() - self._t_start, 3),
+                "seconds since this server process started serving"),
+            "serve.start_time_seconds": (
+                round(self._t_wall_start, 3),
+                "unix time the server started (restart detector: a "
+                "counter reset with an unchanged start_time is a bug, "
+                "with a changed one a restart)"),
             "serve.queue_depth": q["depth"],
             "serve.queue_capacity": q["maxsize"],
+            "serve.queue_oldest_wait_seconds": q.get("oldest_wait_s",
+                                                     0.0),
             "serve.inflight": self._inflight_count(),
             "serve.draining": self._draining.is_set(),
             "serve.service_time_ema_seconds": q["ema_service_s"],
@@ -842,7 +1081,11 @@ class PolishServer:
                         "latency": (latency.snapshot()
                                     if latency is not None else None)},
                 "flight": {"dumps": list(self._dumps),
-                           "installed": self._flight_installed}}
+                           "installed": self._flight_installed},
+                "journal": ({"path": self.config.journal_path,
+                             "events": self.journal.events,
+                             "dropped": self.journal.dropped}
+                            if self.journal is not None else None)}
 
     @property
     def address(self) -> str:
@@ -889,8 +1132,17 @@ def serve_main(argv: list[str]) -> int:
     ap.add_argument("--flight-dir", default=None,
                     help="directory for automatic flight-recorder "
                          "dumps of failed / deadline-missed jobs "
-                         "(RACON_TPU_SERVE_FLIGHT_DIR, default "
-                         "/tmp/racon_tpu_flight; '' disables)")
+                         "(RACON_TPU_SERVE_FLIGHT_DIR, falling back to "
+                         "RACON_TPU_FLIGHT_DIR, default "
+                         "/tmp/racon_tpu_flight; '' disables; an "
+                         "unwritable path fails the start)")
+    ap.add_argument("--journal", default=None,
+                    help="durable JSONL event journal of every job "
+                         "lifecycle transition, keyed by job and trace "
+                         "id (RACON_TPU_SERVE_JOURNAL; size-bounded "
+                         "via RACON_TPU_JOURNAL_MAX_BYTES; render with "
+                         "tools/obsreport.py; an unwritable path fails "
+                         "the start)")
     ap.add_argument("-w", "--window-length", type=int, default=500)
     ap.add_argument("-q", "--quality-threshold", type=float, default=10.0)
     ap.add_argument("-e", "--error-threshold", type=float, default=0.3)
@@ -932,6 +1184,8 @@ def serve_main(argv: list[str]) -> int:
         kw["metrics_port"] = args.metrics_port
     if args.flight_dir is not None:
         kw["flight_dir"] = args.flight_dir
+    if args.journal is not None:
+        kw["journal"] = args.journal
     if args.workers is not None:
         kw["workers"] = args.workers
     if args.queue_depth is not None:

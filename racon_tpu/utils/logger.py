@@ -139,6 +139,11 @@ class Logger:
         # worker and fallback pool (pipeline/__init__.py); the tick
         # read-modify-write needs the lock or progress is lost
         self._bar_lock = threading.Lock()
+        #: optional callable(count, total) fired at the same bin
+        #: transitions the bar redraws at (<= 21 calls per phase) —
+        #: the polisher's live-progress hook (core/polisher.py). Called
+        #: OUTSIDE the bar lock so the callback may take its own locks.
+        self.on_bar = None
 
     def log(self, msg: str | None = None) -> None:
         now = time.perf_counter()
@@ -166,6 +171,7 @@ class Logger:
             bins = min(20 * self._bar_count // self._bar_total, 20)
             if bins == self._bar and bins < 20:
                 return
+            notify = (self._bar_count, self._bar_total)
             self._bar = bins
             quiet = log_level() < INFO
             # the \r redraw protocol is unreadable spam when stderr is a
@@ -193,6 +199,8 @@ class Logger:
                 sys.stderr.write("\r")
             if tty or (done and not quiet):
                 sys.stderr.flush()
+        if self.on_bar is not None:
+            self.on_bar(*notify)
 
     def total(self, msg: str) -> None:
         # an open log() section counts its elapsed time even with no bar

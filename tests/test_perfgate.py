@@ -56,12 +56,28 @@ def test_extract_rejects_failed_artifacts():
         perfgate.extract({"totally": "unrelated"})
 
 
+def serve_artifact(p50=0.30, miss_rate=None):
+    doc = {"mode": "serve", "warm": {"seq_p50_s": p50},
+           "cold": {"p50_s": 0.41}}
+    if miss_rate is not None:
+        doc["slo"] = {"deadline_hit": 4, "deadline_miss": 0,
+                      "expired": 0, "miss_rate": miss_rate}
+    return doc
+
+
 def test_extract_servebench_artifact():
-    got = perfgate.extract({"mode": "serve",
-                            "warm": {"seq_p50_s": 0.30},
-                            "cold": {"p50_s": 0.41}})
+    got = perfgate.extract(serve_artifact())
     assert got["value"] == 0.30
     assert not got["higher_better"]  # p50 seconds: lower is better
+    assert "slo_miss_rate" not in got  # legacy artifact: no slo view
+    got = perfgate.extract(serve_artifact(miss_rate=0.25))
+    assert got["slo_miss_rate"] == 0.25
+
+
+def test_extract_missing_p50_names_key():
+    with pytest.raises(perfgate.GateError, match="warm.seq_p50_s"):
+        perfgate.extract({"mode": "serve", "warm": {},
+                          "cold": {"p50_s": 0.41}})
 
 
 # ------------------------------------------------------------- gate math
@@ -123,6 +139,40 @@ def test_explicit_ref_value_and_broken_gate(tmp_path):
     # no vs_baseline, no published baseline, no ref: broken gate = 2
     assert perfgate.main(["--dir", str(tmp_path)]) == 2
     assert perfgate.main(["--dir", str(tmp_path / "empty")]) == 2
+
+
+def test_serve_slo_miss_rate_gated(tmp_path, capsys):
+    # miss-free artifact passes with the p50 matching its reference
+    write(tmp_path / "BENCH_r01.json", serve_artifact(miss_rate=0.0))
+    assert perfgate.main(["--dir", str(tmp_path),
+                          "--ref-value", "0.30"]) == 0
+    # a deadline-missing wave fails even though the p50 is identical
+    write(tmp_path / "BENCH_r02.json", serve_artifact(miss_rate=0.5))
+    assert perfgate.main(["--dir", str(tmp_path),
+                          "--ref-value", "0.30"]) == 1
+    assert "slo miss-rate" in capsys.readouterr().err
+    # an explicit laxer limit admits it
+    assert perfgate.main(["--dir", str(tmp_path),
+                          "--ref-value", "0.30",
+                          "--slo-miss-rate", "0.6"]) == 0
+
+
+def test_missing_gated_slo_metric_rc2(tmp_path, capsys):
+    # legacy serve artifact without an slo view: fine by default...
+    write(tmp_path / "BENCH_r01.json", serve_artifact())
+    assert perfgate.main(["--dir", str(tmp_path),
+                          "--ref-value", "0.30"]) == 0
+    # ...but an EXPLICITLY requested miss-rate gate over it is a broken
+    # gate with the dotted key named, not a KeyError traceback
+    assert perfgate.main(["--dir", str(tmp_path),
+                          "--ref-value", "0.30",
+                          "--slo-miss-rate", "0.0"]) == 2
+    assert "slo.miss_rate" in capsys.readouterr().err
+    # ...and so is one over a bench artifact, which cannot carry it
+    write(tmp_path / "BENCH_r03.json", bench_artifact(100.0, 2.0))
+    assert perfgate.main(["--dir", str(tmp_path),
+                          "--slo-miss-rate", "0.0"]) == 2
+    assert "slo.miss_rate" in capsys.readouterr().err
 
 
 def test_repo_current_artifacts_pass():

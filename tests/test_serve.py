@@ -574,6 +574,224 @@ def test_polisher_run_counters_reset_between_jobs(dataset):
     assert p.stage_stats["faults"] == 0
 
 
+# ------------------------------------- end-to-end tracing & live progress
+def _serve_pair(tmp_path_factory, transport, **kw):
+    """A (server, client) pair on the requested transport."""
+    kw.setdefault("warmup", False)
+    kw.setdefault("gather_window_s", 0.0)
+    if transport == "tcp":
+        srv = PolishServer(port=0, **kw).start()
+        return srv, PolishClient(port=srv.config.port)
+    sock = str(tmp_path_factory.mktemp("ept") / "s.sock")
+    srv = PolishServer(socket_path=sock, **kw).start()
+    return srv, PolishClient(socket_path=sock)
+
+
+@pytest.mark.parametrize("transport", ["unix", "tcp"])
+def test_progress_frames_interleaved(dataset, solo_bytes,
+                                     tmp_path_factory, transport):
+    """The acceptance gate, on both transports: progress frames arrive
+    before the result, seq and windows-done counts are monotonically
+    non-decreasing, the stream ends at stitch, and the result bytes are
+    untouched by the streaming."""
+    srv, cl = _serve_pair(tmp_path_factory, transport)
+    try:
+        evs: list = []
+        r = cl.submit(*dataset, on_progress=evs.append,
+                      trace_id="tid-interleave")
+        assert r.fasta == solo_bytes
+        assert evs, "no progress frames before the result frame"
+        assert all(e["type"] == "progress" for e in evs)
+        assert all(e["job_id"] == r.job_id for e in evs)
+        assert all(e["trace_id"] == "tid-interleave" for e in evs)
+        seqs = [e["seq"] for e in evs]
+        assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)
+        cons = [e for e in evs if e["phase"] == "consensus"]
+        assert cons, "no consensus progress"
+        dones = [e["done"] for e in cons]
+        assert dones == sorted(dones), "windows-done ran backwards"
+        assert cons[-1]["done"] == cons[-1]["total"] > 0
+        assert "start" in {e["phase"] for e in evs}
+        assert evs[-1]["phase"] == "stitch"
+        # a plain submit on the same server gets NO progress frames
+        # (off by default) and identical bytes
+        assert cl.submit(*dataset).fasta == solo_bytes
+    finally:
+        srv.drain(timeout=10)
+
+
+def test_progress_queue_position_while_pending(dataset,
+                                               tmp_path_factory):
+    """A job stuck behind a busy single worker streams queued-position
+    frames before it ever starts."""
+    srv, cl = _serve_pair(tmp_path_factory, "unix", workers=1)
+    try:
+        blocker_done = threading.Event()
+
+        def blocker():
+            try:
+                cl.submit(*dataset,
+                          fault_plan="device:chunk=0:hang=0.8")
+            finally:
+                blocker_done.set()
+
+        t = threading.Thread(target=blocker)
+        t.start()
+        deadline = time.monotonic() + 10
+        while (srv.queue.counters["admitted"] < 1
+               and time.monotonic() < deadline):
+            time.sleep(0.005)
+        time.sleep(0.1)  # let the worker pop it
+        evs: list = []
+        cl.submit(*dataset, on_progress=evs.append)
+        queued = [e for e in evs if e["phase"] == "queued"]
+        assert queued, f"no queued-position frames: {evs[:5]}"
+        assert queued[0]["position"] >= 0
+        assert queued[0]["depth"] >= 1
+        # the queued frames precede every execution-phase frame
+        assert evs.index(queued[-1]) < evs.index(
+            next(e for e in evs if e["phase"] == "start"))
+        t.join(timeout=30)
+        assert blocker_done.is_set()
+    finally:
+        srv.drain(timeout=10)
+
+
+def test_concurrent_jobs_no_progress_bleed(dataset, solo_bytes,
+                                           tmp_path_factory):
+    """Two concurrent progress-streaming jobs merged into ONE shared
+    device round: each stream carries only its own job id and trace id,
+    both outputs stay byte-identical."""
+    srv, cl = _serve_pair(tmp_path_factory, "unix", workers=2,
+                          min_gather=2, gather_window_s=10.0)
+    srv.batcher.active_hint = None  # always wait for the joiner
+    try:
+        evs: list = [[], []]
+        results: list = [None, None]
+
+        def go(i):
+            results[i] = cl.submit(*dataset, on_progress=evs[i].append,
+                                   trace_id=f"tid-{i}")
+
+        threads = [threading.Thread(target=go, args=(i,))
+                   for i in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert results[0] is not None and results[1] is not None
+        assert results[0].job_id != results[1].job_id
+        assert results[0].serve["batch"]["jobs"] == 2  # truly shared
+        for i in (0, 1):
+            assert results[i].fasta == solo_bytes
+            assert evs[i], f"job {i} saw no progress"
+            assert {e["job_id"] for e in evs[i]} == \
+                {results[i].job_id}, "cross-job job_id bleed"
+            assert {e["trace_id"] for e in evs[i]} == {f"tid-{i}"}, \
+                "cross-job trace_id bleed"
+            cons = [e for e in evs[i] if e["phase"] == "consensus"]
+            dones = [e["done"] for e in cons]
+            assert dones == sorted(dones)
+            assert cons[-1]["done"] == cons[-1]["total"] > 0
+    finally:
+        srv.drain(timeout=10)
+
+
+def test_bad_trace_id_rejected(client, dataset):
+    with pytest.raises(ServeError) as exc_info:
+        client.submit(*dataset, trace_id="no spaces allowed")
+    assert exc_info.value.code == "bad-request"
+    assert "trace_id" in str(exc_info.value)
+
+
+def test_trace_out_merged_artifact(client, server, dataset, tmp_path):
+    """The acceptance gate: one traced submit against the WARM module
+    server produces a single valid Chrome-trace JSON holding both
+    client- and server-side spans on one timeline, with the serve-side
+    spans tagged by the minted trace id and the batch-round span
+    duration pinned to the job's own round telemetry."""
+    import json as _json
+
+    path = str(tmp_path / "merged.json")
+    result, doc = client.submit_traced(*dataset, trace_out=path)
+    on_disk = _json.load(open(path))
+    assert on_disk["traceEvents"] and "displayTimeUnit" in on_disk
+    tid = doc["trace_context"]["trace_id"]
+    assert tid and doc["trace_context"]["job_id"] == result.job_id
+
+    by_pid: dict = {}
+    for ev in doc["traceEvents"]:
+        assert "ph" in ev and "pid" in ev
+        if ev["ph"] != "M":
+            assert ev["ts"] >= 0
+            by_pid.setdefault(ev["pid"], set()).add(ev["name"])
+    assert {"client.connect", "client.submit", "client.wait",
+            "client.receive"} <= by_pid[1]
+    assert {"serve.queue_wait", "serve.job",
+            "polisher.initialize"} <= by_pid[2]
+    # process-name metadata labels both tracks
+    pnames = {ev["pid"]: ev["args"]["name"]
+              for ev in doc["traceEvents"]
+              if ev["ph"] == "M" and ev["name"] == "process_name"}
+    assert "client" in pnames[1] and "server" in pnames[2]
+    # the serve-side spans carry the client's trace context
+    qw = [ev for ev in doc["traceEvents"]
+          if ev.get("name") == "serve.queue_wait"]
+    assert len(qw) == 1 and qw[0]["args"]["trace_id"] == tid
+    # span-duration pin: the batch-round span and the job's round
+    # telemetry are recorded from the same perf_counter endpoints
+    batch = result.serve["batch"]
+    rounds = [ev for ev in doc["traceEvents"]
+              if ev.get("name") == "serve.batch_round"
+              and ev.get("args", {}).get("round") == batch["round"]]
+    assert len(rounds) == 1
+    assert rounds[0]["dur"] / 1e6 == pytest.approx(
+        batch["round_s"], rel=0.05, abs=1e-3)
+    assert tid in rounds[0]["args"]["trace_ids"]
+    # and the ordinary result is untouched
+    assert result.fasta
+
+
+def test_traced_strict_job_span_sums_pin_stage_stats(client, server,
+                                                     dataset):
+    """Server pipeline span sums inside the merged artifact equal the
+    job's own stage stats (a strict job runs an isolation round on its
+    own pipeline, so the returned metrics ARE this job's spans)."""
+    result, doc = client.submit_traced(*dataset, strict=True)
+    stats = result.metrics["pipeline"]
+    sums: dict = {}
+    for ev in doc["traceEvents"]:
+        if (ev.get("ph") == "X" and ev.get("pid") == 2
+                and ev["name"].startswith("pipeline.")):
+            stage = ev["name"].split(".", 1)[1]
+            sums[stage] = sums.get(stage, 0.0) + ev["dur"] / 1e6
+    assert sums, "no pipeline spans in the server trace"
+    for stage in ("pack", "device", "unpack"):
+        assert sums.get(stage, 0.0) == pytest.approx(
+            stats[f"{stage}_s"], rel=0.05, abs=1e-3), \
+            f"{stage}: {sums.get(stage)} vs {stats[f'{stage}_s']}"
+
+
+def test_trace_and_progress_over_tcp(dataset, solo_bytes,
+                                     tmp_path_factory):
+    """Trace-context propagation composes with progress streaming over
+    localhost TCP: progress frames become client.progress instants in
+    the merged artifact."""
+    srv, cl = _serve_pair(tmp_path_factory, "tcp")
+    try:
+        evs: list = []
+        result, doc = cl.submit_traced(*dataset,
+                                       on_progress=evs.append)
+        assert result.fasta == solo_bytes
+        assert evs
+        instants = [ev for ev in doc["traceEvents"]
+                    if ev.get("name") == "client.progress"]
+        assert len(instants) == len(evs)
+        assert all(ev["pid"] == 1 for ev in instants)
+    finally:
+        srv.drain(timeout=10)
+
+
 # ------------------------------------------------- TTY-aware progress bars
 class _FakeTTY(io.StringIO):
     def isatty(self):

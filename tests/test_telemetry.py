@@ -577,6 +577,193 @@ def test_job_latency_namespace_in_polisher_metrics(dataset):
     assert "latency.phase.consensus.p99" in flat
 
 
+# ----------------------------------------------- durable serve journal
+def test_journal_rotation_and_reader(tmp_path):
+    from racon_tpu.obs.journal import Journal, read_journal
+
+    p = str(tmp_path / "j.jsonl")
+    j = Journal(p, max_bytes=600)
+    for i in range(60):
+        j.record("tick", job=f"j{i}", i=i)
+    assert j.events == 60 and j.dropped == 0
+    j.close()
+    assert os.path.exists(p + ".1")  # rotated exactly one generation
+    assert os.path.getsize(p) <= 600
+    entries = read_journal(p)
+    assert entries, "reader lost everything"
+    seq = [e["i"] for e in entries]
+    # both generations read in order: a contiguous most-recent suffix
+    assert seq == list(range(seq[0], 60))
+    assert all(e["event"] == "tick" and "t" in e for e in entries)
+
+
+def test_journal_stage_preserves_order(tmp_path):
+    """stage() (the under-queue-lock path) keeps its relative order
+    against later record() writes, and close() drains the tail."""
+    from racon_tpu.obs.journal import Journal, read_journal
+
+    p = str(tmp_path / "j.jsonl")
+    j = Journal(p)
+    j.record("received", job="a")
+    j.stage("admitted", job="a")       # no disk I/O here
+    j.record("started", job="a")       # drains the staged line first
+    j.stage("admitted", job="b")
+    j.close()                          # drains the tail
+    events = [(e["job"], e["event"]) for e in read_journal(p)]
+    assert events == [("a", "received"), ("a", "admitted"),
+                      ("a", "started"), ("b", "admitted")]
+    assert j.events == 4 and j.dropped == 0
+
+
+def test_journal_consistency_checker():
+    from racon_tpu.obs.journal import check_consistency
+
+    def ev(event, job):
+        return {"t": 0.0, "event": event, "job": job}
+
+    ok = [ev("received", "a"), ev("admitted", "a"), ev("started", "a"),
+          ev("finished", "a"),
+          ev("received", "b"), ev("rejected-full", "b"),
+          ev("received", "c"), ev("admitted", "c"), ev("expired", "c"),
+          {"t": 0.0, "event": "serve-start"}]
+    assert check_consistency(ok) == []
+    # started but no terminal
+    assert check_consistency([ev("received", "x"), ev("started", "x")])
+    # two terminal states
+    assert check_consistency(
+        [ev("started", "x"), ev("finished", "x"), ev("failed", "x")])
+    # finished without started, full lifecycle visible
+    assert check_consistency([ev("received", "x"), ev("finished", "x")])
+    # rotation cut the head: finished-without-started is NOT flagged
+    # when `received` fell outside the window
+    assert check_consistency([ev("finished", "x")]) == []
+
+
+def test_serve_journal_lifecycle(dataset, tmp_path):
+    from racon_tpu.obs.journal import check_consistency, read_journal
+
+    jp = str(tmp_path / "journal.jsonl")
+    srv = PolishServer(socket_path=str(tmp_path / "s.sock"),
+                       warmup=False, workers=1, journal=jp,
+                       flight_dir=str(tmp_path / "fl")).start()
+    try:
+        cl = PolishClient(socket_path=srv.config.socket_path)
+        ok_job = cl.submit(*dataset, trace_id="tid-journal")
+        with pytest.raises(JobFailed):
+            cl.submit(*dataset, fault_plan="unpack:chunk=0:corrupt",
+                      strict=True)
+        late = cl.submit(*dataset, deadline_s=0.3,
+                         fault_plan="device:chunk=0:hang=0.8")
+        assert late.fasta
+    finally:
+        srv.drain(timeout=15)
+    entries = read_journal(jp)
+    assert check_consistency(entries) == []
+    events = [e["event"] for e in entries]
+    assert events[0] == "serve-start" and events[-1] == "serve-stop"
+    assert "drain" in events
+    by_job: dict = {}
+    for e in entries:
+        if e.get("job"):
+            by_job.setdefault(e["job"], []).append(e)
+    assert len(by_job) == 3
+    ok_events = [e["event"] for e in by_job[ok_job.job_id]]
+    assert ok_events == ["received", "admitted", "started", "round",
+                         "finished"]
+    # the trace id rides every line of its job
+    assert all(e.get("trace") == "tid-journal"
+               for e in by_job[ok_job.job_id])
+    failed = next(evs for evs in by_job.values()
+                  if any(e["event"] == "failed" for e in evs))
+    assert next(e for e in failed if e["event"] == "failed")[
+        "error_type"] == "ChunkCorrupt"
+    missed = next(evs for evs in by_job.values()
+                  if any(e["event"] == "deadline-miss" for e in evs))
+    assert [e["event"] for e in missed][-1] == "finished"
+
+
+def test_bad_flight_dir_or_journal_fails_start(tmp_path):
+    from racon_tpu.errors import RaconError
+
+    not_a_dir = tmp_path / "file"
+    not_a_dir.write_text("x")
+    with pytest.raises(RaconError, match="flight"):
+        PolishServer(socket_path=str(tmp_path / "a.sock"),
+                     warmup=False, flight_dir=str(not_a_dir)).start()
+    with pytest.raises(RaconError, match="journal"):
+        PolishServer(socket_path=str(tmp_path / "b.sock"),
+                     warmup=False, flight_dir=str(tmp_path / "fl"),
+                     journal=str(tmp_path / "missing" / "j.jsonl")
+                     ).start()
+
+
+def test_flight_dir_env_resolution(monkeypatch, tmp_path):
+    from racon_tpu.serve import ServeConfig
+
+    monkeypatch.delenv("RACON_TPU_SERVE_FLIGHT_DIR", raising=False)
+    monkeypatch.setenv("RACON_TPU_FLIGHT_DIR", str(tmp_path / "proc"))
+    assert ServeConfig().flight_dir == str(tmp_path / "proc")
+    monkeypatch.setenv("RACON_TPU_SERVE_FLIGHT_DIR",
+                       str(tmp_path / "serve"))
+    assert ServeConfig().flight_dir == str(tmp_path / "serve")
+    assert ServeConfig(flight_dir="").flight_dir == ""  # kwarg wins
+    assert ServeConfig(flight_dir="/x").flight_dir_explicit
+    monkeypatch.delenv("RACON_TPU_SERVE_FLIGHT_DIR")
+    monkeypatch.delenv("RACON_TPU_FLIGHT_DIR")
+    cfg = ServeConfig()
+    assert cfg.flight_dir == "/tmp/racon_tpu_flight"
+    # the built-in default is NOT strict-validated at startup: a plain
+    # `racon_tpu serve` keeps the PR-6 best-effort-per-dump posture
+    assert not cfg.flight_dir_explicit
+
+
+def test_scrape_restart_and_queue_gauges(client, server):
+    """The restart-detection series: uptime + wall-clock start time,
+    plus the live queue-depth gauges."""
+    fams = parse_prom(client.scrape())
+    for name in ("racon_tpu_serve_uptime_seconds",
+                 "racon_tpu_serve_start_time_seconds",
+                 "racon_tpu_serve_queue_depth",
+                 "racon_tpu_serve_queue_oldest_wait_seconds"):
+        assert name in fams, sorted(fams)
+        assert fams[name]["type"] == "gauge"
+    start = fams["racon_tpu_serve_start_time_seconds"]["samples"][0][2]
+    assert abs(start - time.time()) < 3600  # wall clock, recent
+    uptime = fams["racon_tpu_serve_uptime_seconds"]["samples"][0][2]
+    assert 0 < uptime < 3600
+
+
+def test_obsreport_tool(dataset, tmp_path):
+    """tools/obsreport.py renders the journal alongside flight dumps
+    and its --check passes on a consistent journal."""
+    jp = str(tmp_path / "journal.jsonl")
+    fl = str(tmp_path / "flight")
+    srv = PolishServer(socket_path=str(tmp_path / "s.sock"),
+                       warmup=False, workers=1, journal=jp,
+                       flight_dir=fl).start()
+    try:
+        cl = PolishClient(socket_path=srv.config.socket_path)
+        ok_job = cl.submit(*dataset)
+        with pytest.raises(JobFailed):
+            cl.submit(*dataset, fault_plan="unpack:chunk=0:corrupt",
+                      strict=True)
+    finally:
+        srv.drain(timeout=15)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {k: v for k, v in os.environ.items()
+           if "axon" not in k.lower()}
+    env["PYTHONPATH"] = repo
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "obsreport.py"),
+         "--journal", jp, "--flight-dir", fl, "--check"],
+        env=env, capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert f"job {ok_job.job_id}" in proc.stdout
+    assert "finished" in proc.stdout and "failed" in proc.stdout
+    assert "flight dump:" in proc.stdout  # the failed job's artifact
+    assert "consistency: OK" in proc.stdout
+
+
 # --------------------------------------------- progress bars through pipes
 def test_bar_subprocess_pipe_one_line_per_phase():
     """The BENCH_r05 bloat pin: a subprocess whose stderr is a PIPE (the

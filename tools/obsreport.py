@@ -1,0 +1,167 @@
+"""Serve observability report: journal timelines + flight dump index.
+
+The serve layer leaves two kinds of evidence behind: the durable event
+journal (`serve --journal`, obs/journal.py — one JSONL line per job
+lifecycle transition) and the flight-recorder dump artifacts
+(`<flight-dir>/flight_<job>_<reason>.json` — a Chrome trace windowed to
+a failed / deadline-missed job). Each is useful alone; the question an
+operator actually asks — "what happened to job X, and is there a
+post-mortem for it" — needs them TOGETHER. This tool renders that view:
+
+    python tools/obsreport.py --journal /var/log/racon/journal.jsonl \
+        [--flight-dir /tmp/racon_tpu_flight] [--job j42] [--check]
+
+Per job: the transition timeline with +deltas from the first event, the
+terminal state, the trace id (when the client minted one), and the
+flight dump that names the job, if any. The summary counts events by
+type and runs the journal consistency check (`--check` turns problems
+into a nonzero exit — the CI shape; `tools/servebench.py` runs the same
+check inside its gate)."""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def load_flight_dumps(dirname: str) -> list[dict]:
+    """The `flight` header objects of every dump artifact in `dirname`,
+    each annotated with its path. Unreadable artifacts are reported as
+    such, not fatal — this is a post-mortem tool."""
+    out = []
+    for path in sorted(glob.glob(os.path.join(dirname,
+                                              "flight_*.json"))):
+        info = {"path": path}
+        try:
+            with open(path) as fh:
+                doc = json.load(fh)
+            info.update(doc.get("flight") or {})
+            info["events"] = len(doc.get("traceEvents") or [])
+        except (OSError, ValueError) as exc:
+            info["error"] = f"{type(exc).__name__}: {exc}"
+        out.append(info)
+    return out
+
+
+def job_timelines(entries: list[dict]) -> dict[str, list[dict]]:
+    """Journal entries grouped by job, in journal order; entries
+    without a job id (serve-start / drain / serve-stop) are skipped —
+    render_summary reports them."""
+    jobs: dict[str, list[dict]] = {}
+    for e in entries:
+        if e.get("job"):
+            jobs.setdefault(str(e["job"]), []).append(e)
+    return jobs
+
+
+def _fields(e: dict) -> str:
+    skip = {"t", "event", "job", "trace"}
+    parts = [f"{k}={e[k]}" for k in e if k not in skip]
+    return f" ({', '.join(parts)})" if parts else ""
+
+
+def render_job(job: str, events: list[dict], dumps: list[dict],
+               out) -> None:
+    trace = next((e["trace"] for e in events if e.get("trace")), None)
+    t0 = events[0].get("t", 0.0)
+    head = f"job {job}"
+    if trace:
+        head += f"  trace={trace}"
+    print(head, file=out)
+    names = {e.get("event") for e in events}
+    for e in events:
+        dt = e.get("t", t0) - t0
+        print(f"  +{dt:8.3f}s  {e.get('event', '?'):<18}{_fields(e)}",
+              file=out)
+    # dumps exist only for failed / deadline-missed jobs; job ids
+    # restart per server lifetime, so a dump naming a job whose journal
+    # shows a clean finish is a STALE artifact from an earlier server —
+    # don't misattach it to this job's timeline
+    if names & {"failed", "deadline-miss", "expired"}:
+        for d in dumps:
+            if d.get("job_id") == job:
+                print(f"  flight dump: {d['path']} "
+                      f"(reason={d.get('reason')}, "
+                      f"error={d.get('error_type')})", file=out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="render serve journal timelines alongside "
+                    "flight-recorder dumps (see module docstring)")
+    ap.add_argument("--journal",
+                    default=os.environ.get("RACON_TPU_SERVE_JOURNAL"),
+                    help="journal path (default: "
+                         "RACON_TPU_SERVE_JOURNAL)")
+    ap.add_argument("--flight-dir",
+                    default=os.environ.get("RACON_TPU_SERVE_FLIGHT_DIR")
+                    or os.environ.get("RACON_TPU_FLIGHT_DIR")
+                    or "/tmp/racon_tpu_flight",
+                    help="flight dump directory to index alongside "
+                         "(default: the serve layer's resolution "
+                         "chain)")
+    ap.add_argument("--job", default=None,
+                    help="render only this job id")
+    ap.add_argument("--check", action="store_true",
+                    help="exit 1 when the journal fails its "
+                         "consistency check (CI shape)")
+    args = ap.parse_args(argv)
+
+    from racon_tpu.obs.journal import check_consistency, read_journal
+
+    if not args.journal:
+        print("[obsreport] error: no journal path (pass --journal or "
+              "set RACON_TPU_SERVE_JOURNAL)", file=sys.stderr)
+        return 2
+    entries = read_journal(args.journal)
+    if not entries:
+        print(f"[obsreport] error: no journal entries at "
+              f"{args.journal}", file=sys.stderr)
+        return 2
+
+    dumps = (load_flight_dumps(args.flight_dir)
+             if args.flight_dir and os.path.isdir(args.flight_dir)
+             else [])
+    jobs = job_timelines(entries)
+
+    out = sys.stdout
+    shown = 0
+    for job, events in jobs.items():
+        if args.job and job != args.job:
+            continue
+        render_job(job, events, dumps, out)
+        shown += 1
+    if args.job and not shown:
+        print(f"[obsreport] error: job {args.job!r} not in journal "
+              f"({len(jobs)} jobs)", file=sys.stderr)
+        return 2
+
+    counts: dict[str, int] = {}
+    for e in entries:
+        counts[str(e.get("event"))] = counts.get(str(e.get("event")),
+                                                 0) + 1
+    print(f"summary: {len(entries)} events / {len(jobs)} jobs — "
+          + ", ".join(f"{k}={v}" for k, v in sorted(counts.items())),
+          file=out)
+    unmatched = [d for d in dumps
+                 if d.get("job_id") and d["job_id"] not in jobs]
+    print(f"flight dumps: {len(dumps)} in {args.flight_dir}"
+          + (f" ({len(unmatched)} for jobs outside the journal window)"
+             if unmatched else ""), file=out)
+
+    problems = check_consistency(entries)
+    for p in problems:
+        print(f"consistency: {p}", file=out)
+    print(f"consistency: {'OK' if not problems else 'FAIL'} "
+          f"({len(problems)} problems)", file=out)
+    return 1 if (args.check and problems) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -16,7 +16,15 @@ Metric extraction understands both artifact shapes:
     ends in `_failed`, whose value is 0, or whose rc is nonzero are
     SKIPPED (a timed-out round is not a baseline and not a candidate).
   - servebench `--json` artifacts (`"mode": "serve"`): warm sequential
-    p50 seconds, LOWER is better.
+    p50 seconds, LOWER is better — gated against the baseline like the
+    bench quotients — PLUS the artifact's SLO miss rate (`slo.
+    miss_rate`), gated ABSOLUTELY against `--slo-miss-rate` (default
+    0.0: any deadline miss fails the gate) when the artifact carries an
+    slo view or the limit was requested explicitly.
+
+A missing gated metric is a BROKEN GATE, not a traceback: the error
+names the dotted key (`warm.seq_p50_s`, `slo.miss_rate`) and exits 2,
+so CI can tell "the artifact changed shape" from "perf regressed".
 
 Baseline resolution, in order:
 
@@ -66,9 +74,30 @@ def load_artifact(path: str) -> dict:
     return doc
 
 
+def _lookup(inner: dict, dotted: str):
+    """Walk a dotted key; None when any step is missing."""
+    cur = inner
+    for part in dotted.split("."):
+        if not isinstance(cur, dict) or part not in cur:
+            return None
+        cur = cur[part]
+    return cur
+
+
+def _require(inner: dict, dotted: str, path: str):
+    """Fetch a gated metric by dotted key, or raise the NAMED-key
+    GateError (exit 2) — never a KeyError traceback."""
+    val = _lookup(inner, dotted)
+    if val is None:
+        raise GateError(
+            f"{path}: artifact lacks gated metric '{dotted}'")
+    return val
+
+
 def extract(doc: dict, path: str = "<artifact>") -> dict:
     """Normalize an artifact into {name, value, unit, higher_better,
-    vs_baseline?}. Raises GateError for unusable artifacts."""
+    vs_baseline?, slo_miss_rate?}. Raises GateError for unusable
+    artifacts."""
     if doc.get("rc") not in (None, 0):
         raise GateError(f"{path}: recorded rc={doc.get('rc')} "
                         "(failed round — not comparable)")
@@ -80,9 +109,14 @@ def extract(doc: dict, path: str = "<artifact>") -> dict:
         warm = inner.get("warm") or {}
         value = warm.get("seq_p50_s", warm.get("p50_s"))
         if not value:
-            raise GateError(f"{path}: serve artifact without a p50")
-        return {"name": "serve warm seq p50", "value": float(value),
-                "unit": "s", "higher_better": False}
+            raise GateError(
+                f"{path}: artifact lacks gated metric 'warm.seq_p50_s'")
+        out = {"name": "serve warm seq p50", "value": float(value),
+               "unit": "s", "higher_better": False}
+        miss = _lookup(inner, "slo.miss_rate")
+        if miss is not None:
+            out["slo_miss_rate"] = float(miss)
+        return out
     if inner.get("unit") == "windows/sec":
         metric = str(inner.get("metric", ""))
         value = float(inner.get("value") or 0.0)
@@ -159,6 +193,31 @@ def gate(candidate: float, reference: float, tolerance_pct: float,
     return delta >= -abs(tolerance_pct), delta
 
 
+def slo_checks(doc: dict, cand: dict, args,
+               candidate_path: str) -> list[tuple[str, float, float]]:
+    """Absolute SLO gates for serve artifacts: (name, value, limit)
+    triples. Gated when the artifact carries the metric OR the operator
+    requested the limit explicitly — and an explicitly-requested gate
+    over an artifact missing the metric is a named-key broken gate."""
+    explicit = args.slo_miss_rate is not None
+    if cand["higher_better"]:
+        if explicit:
+            # the operator DEMANDED an SLO gate; a bench artifact
+            # cannot satisfy it — broken gate, never a silent pass
+            raise GateError(
+                f"{candidate_path}: artifact lacks gated metric "
+                "'slo.miss_rate' (bench artifacts carry no slo view; "
+                "--slo-miss-rate gates servebench artifacts)")
+        return []
+    inner = doc.get("parsed", doc)
+    if not explicit and "slo_miss_rate" not in cand:
+        return []
+    if explicit and "slo_miss_rate" not in cand:
+        _require(inner, "slo.miss_rate", candidate_path)
+    limit = args.slo_miss_rate if explicit else 0.0
+    return [("slo miss-rate", cand["slo_miss_rate"], limit)]
+
+
 def run(args) -> int:
     if args.artifact:
         candidate_path = args.artifact
@@ -167,17 +226,26 @@ def run(args) -> int:
         if not arts:
             raise GateError(f"no BENCH_r*.json under {args.dir}")
         candidate_path = arts[-1]
-    cand = extract(load_artifact(candidate_path), candidate_path)
+    doc = load_artifact(candidate_path)
+    cand = extract(doc, candidate_path)
     reference, ref_desc = resolve_baseline(cand, args, candidate_path)
     ok, delta = gate(cand["value"], reference, args.tolerance_pct,
                      cand["higher_better"])
+    failures = 0 if ok else 1
     verdict = "PASS" if ok else "FAIL"
     print(f"[perfgate] {verdict}: {os.path.basename(candidate_path)} "
           f"{cand['name']} = {cand['value']:g} {cand['unit']} vs "
           f"{reference:g} ({ref_desc}): {delta:+.1f}% "
           f"(tolerance -{abs(args.tolerance_pct):g}%)",
           file=sys.stderr)
-    return 0 if ok else 1
+    for name, value, limit in slo_checks(doc, cand, args,
+                                         candidate_path):
+        check_ok = value <= limit
+        failures += 0 if check_ok else 1
+        print(f"[perfgate] {'PASS' if check_ok else 'FAIL'}: "
+              f"{os.path.basename(candidate_path)} {name} = {value:g} "
+              f"(limit {limit:g})", file=sys.stderr)
+    return 0 if not failures else 1
 
 
 def main(argv=None) -> int:
@@ -198,6 +266,12 @@ def main(argv=None) -> int:
                          "everything)")
     ap.add_argument("--tolerance-pct", type=float, default=10.0,
                     help="allowed regression in percent (default 10)")
+    ap.add_argument("--slo-miss-rate", type=float, default=None,
+                    help="allowed deadline-miss rate for servebench "
+                         "artifacts (default: gate at 0.0 whenever the "
+                         "artifact carries an slo view; passing a "
+                         "value makes the gate mandatory — an artifact "
+                         "without slo.miss_rate then exits 2)")
     args = ap.parse_args(argv)
     try:
         return run(args)

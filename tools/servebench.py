@@ -19,10 +19,15 @@ Two warm phases measure two different claims:
 
 Exit status is the acceptance check: 0 only when sequential warm p50
 beats cold p50, no warm job compiled anything (sched compile telemetry:
-the warm path recompiles NOTHING), and every warm job's FASTA equals
-the cold CLI bytes. `--json PATH` writes the summary as a bench-style
-artifact with `occupancy` / `metrics` fields alongside the serve
-numbers (the same field names bench.py publishes).
+the warm path recompiles NOTHING), every warm job's FASTA equals the
+cold CLI bytes, every wave job saw at least one live progress frame
+before its result (time-to-first-progress is reported as its own
+column), and the serve event journal — enabled for the measured run —
+passes its consistency check (every job exactly one terminal state,
+started/terminal pairs balanced). `--json PATH` writes the summary as a
+bench-style artifact with `occupancy` / `metrics` / `slo` / `journal`
+fields alongside the serve numbers (the same field names bench.py
+publishes; tools/perfgate.py gates warm p50 and slo.miss_rate from it).
 
     python tools/servebench.py --jobs 4 [--genome-kb 20] [--json out.json]
 """
@@ -239,11 +244,15 @@ def main(argv=None) -> int:
             print(f"[servebench] cold run {i + 1}/{cold_n}: {dt:.2f}s",
                   file=sys.stderr)
 
-        # ---- warm: one server, N concurrent submissions
+        # ---- warm: one server, N concurrent submissions. The event
+        # journal rides the measured run (its <2% overhead is part of
+        # the warm numbers, not hidden from them) and is consistency-
+        # checked after drain as part of the gate
         sock = os.path.join(tmp, "serve.sock")
+        journal_path = os.path.join(tmp, "journal.jsonl")
         server = PolishServer(
             socket_path=sock, workers=args.workers, warmup=False,
-            job_threads=args.threads,
+            job_threads=args.threads, journal=journal_path,
             tpu_poa_batches=args.tpupoa_batches,
             tpu_aligner_batches=args.tpualigner_batches)
         t0 = time.perf_counter()
@@ -266,13 +275,23 @@ def main(argv=None) -> int:
             print(f"[servebench] warm seq run {i + 1}/{cold_n}: "
                   f"{seq_s[-1]:.2f}s", file=sys.stderr)
 
-        # ---- warm concurrent wave: the multiplexing story
+        # ---- warm concurrent wave: the multiplexing story, streamed —
+        # every wave job asks for live progress so time-to-first-
+        # progress (how long a client stares at nothing) is measured
+        # under contention, not just on an idle server
         results: list = [None] * args.jobs
         latencies: list = [0.0] * args.jobs
+        first_progress: list = [None] * args.jobs
 
         def submit(i):
             t = time.perf_counter()
-            results[i] = client.submit(*paths, retries=5)
+
+            def on_progress(ev, _i=i, _t=t):
+                if first_progress[_i] is None:
+                    first_progress[_i] = time.perf_counter() - _t
+
+            results[i] = client.submit(*paths, retries=5,
+                                       on_progress=on_progress)
             latencies[i] = time.perf_counter() - t
 
         threads = [threading.Thread(target=submit, args=(i,))
@@ -286,6 +305,13 @@ def main(argv=None) -> int:
 
         snap = server.stats_snapshot()
         server.drain(timeout=30)
+
+        # ---- journal consistency: every journaled job reaches exactly
+        # one terminal state, started/terminal pairs balance
+        from racon_tpu.obs.journal import check_consistency, read_journal
+
+        journal_entries = read_journal(journal_path)
+        journal_problems = check_consistency(journal_entries)
 
     # ---- analysis
     from racon_tpu.serve.queue import nearest_rank
@@ -311,6 +337,13 @@ def main(argv=None) -> int:
     if seq_p50 >= cold_p50:
         fail.append(f"warm p50 {seq_p50:.2f}s did not beat cold p50 "
                     f"{cold_p50:.2f}s")
+    ttfp = [v for v in first_progress if v is not None]
+    if len(ttfp) < args.jobs:
+        fail.append(f"only {len(ttfp)}/{args.jobs} wave jobs received "
+                    "a progress frame before their result")
+    ttfp_p50 = nearest_rank(sorted(ttfp), 0.50) if ttfp else None
+    for p in journal_problems:
+        fail.append(f"journal inconsistency: {p}")
 
     b = snap["batcher"]
     print(f"[servebench] warm sequential: p50 {seq_p50:.2f}s vs cold "
@@ -329,6 +362,19 @@ def main(argv=None) -> int:
     print(f"[servebench] queue wait mean {statistics.mean(queue_waits):.3f}s "
           f"max {max(queue_waits):.3f}s; exec mean "
           f"{statistics.mean(exec_s):.3f}s", file=sys.stderr)
+    if ttfp:
+        print(f"[servebench] time-to-first-progress: p50 "
+              f"{ttfp_p50:.3f}s max {max(ttfp):.3f}s "
+              f"({len(ttfp)}/{args.jobs} jobs) "
+              f"[{'OK' if len(ttfp) == args.jobs else 'FAIL'}]",
+              file=sys.stderr)
+    n_journal_jobs = len({e.get('job') for e in journal_entries
+                          if e.get('job')})
+    print(f"[servebench] journal: {len(journal_entries)} events / "
+          f"{n_journal_jobs} jobs, "
+          f"{len(journal_problems)} consistency problems "
+          f"[{'OK' if not journal_problems else 'FAIL'}]",
+          file=sys.stderr)
     print(f"[servebench] batch rounds: {b['rounds']} "
           f"({b['multi_job_rounds']} cross-job, max "
           f"{b['max_jobs_in_round']} jobs/round)", file=sys.stderr)
@@ -349,7 +395,17 @@ def main(argv=None) -> int:
                      "warmup_s": round(warm_ready_s, 3),
                      "queue_wait_mean_s": round(
                          statistics.mean(queue_waits), 4),
+                     "ttfp_p50_s": (round(ttfp_p50, 4)
+                                    if ttfp_p50 is not None else None),
+                     "ttfp_max_s": (round(max(ttfp), 4)
+                                    if ttfp else None),
                      "compiles_per_job": compiles_per_job},
+            "slo": {k: (snap.get("slo") or {}).get(k) for k in
+                    ("deadline_hit", "deadline_miss", "expired",
+                     "miss_rate")},
+            "journal": {"events": len(journal_entries),
+                        "jobs": n_journal_jobs,
+                        "consistent": not journal_problems},
             "cold": {"runs": len(cold_s),
                      "p50_s": round(cold_p50, 3),
                      "mean_s": round(statistics.mean(cold_s), 3)},
