@@ -30,8 +30,11 @@ usage: racon_tpu [options ...] <sequences> <overlaps> <target sequences>
         submit  send one polishing job to a running server; polished
                 FASTA on stdout, byte-identical to the one-shot run;
                 `--progress` streams live phase/window progress (incl.
-                queue position) and `--trace-out t.json` writes one
-                merged client+server Chrome trace of the request
+                queue position), `--stream` writes each polished
+                contig the moment it finishes on the server,
+                `--tenant` names the fair-scheduling bucket, and
+                `--trace-out t.json` writes one merged client+server
+                Chrome trace of the request
 
     #default output is stdout
     <sequences>

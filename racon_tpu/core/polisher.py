@@ -267,8 +267,8 @@ class Polisher:
         # the hook is invoked INSIDE the lock: two concurrent bar ticks
         # that computed done=5 and done=6 under the lock could
         # otherwise deliver 6 then 5 and run the client's bar
-        # backwards; hooks only enqueue (Job.notify_progress appends to
-        # a deque), so holding the lock across them is safe and cheap
+        # backwards; hooks only enqueue (Job.notify_progress pushes onto
+        # its DeliveryQueue), so holding the lock across them is safe and cheap
         with self._progress_lock:
             hwm_phase, hwm_done, hwm_total = self._progress_hwm
             if ph != hwm_phase:
@@ -702,7 +702,7 @@ class Polisher:
 
     # ---------------------------------------------------------------- polish
     def polish(self, drop_unpolished_sequences: bool = True,
-               batcher=None) -> list[Sequence]:
+               batcher=None, on_part=None) -> list[Sequence]:
         """Per-window consensus + stitch (reference polisher.cpp:486-548).
 
         Set RACON_TPU_PROFILE=<dir> (CLI: --tpu-jax-profile) to capture a
@@ -712,29 +712,39 @@ class Polisher:
         reported on stderr either way.
 
         `batcher` (serve mode) replaces the in-process consensus pass:
-        this job's windows are handed to the shared cross-job window
-        batcher (serve/batcher.py), which funnels them into device
-        batches alongside concurrent jobs' windows and returns once this
-        job's windows all carry their consensus. Per-window results are
-        independent of batch composition, so the stitched FASTA stays
-        byte-identical to a solo run (test-pinned).
+        this job's windows join the shared continuous window batcher
+        (serve/batcher.py), which merges them into bounded device
+        iterations alongside concurrent jobs' windows and delivers them
+        back incrementally as each iteration lands. Contigs whose
+        windows are all complete are stitched IMMEDIATELY (in contig
+        order) — `on_part` (callable(Sequence)) receives each finished
+        contig before the job as a whole completes, which is what the
+        server streams to clients as `result_part` frames. Per-window
+        results are independent of batch composition, so both the
+        streamed parts and the final list stay byte-identical to a solo
+        run (test-pinned).
         """
         import time as _time
 
         if batcher is not None:
-            batcher.consensus(self)
+            streamer = ContigStreamer(self, drop_unpolished_sequences,
+                                      on_part)
+            batcher.consensus(self, on_windows=streamer.on_windows)
+            dst = streamer.finish()
+            stitch_s = streamer.stitch_s
+            t_stitch = _time.perf_counter() - stitch_s
         else:
             self._consensus_pass()
-
-        t_stitch = _time.perf_counter()
-        dst = self._stitch(drop_unpolished_sequences)
+            t_stitch = _time.perf_counter()
+            dst = self._stitch(drop_unpolished_sequences)
+            stitch_s = _time.perf_counter() - t_stitch
         self.emit_progress(len(self.windows), len(self.windows),
                            phase="stitch", sequences=len(dst))
-        self.hists.observe("phase.stitch", _time.perf_counter() - t_stitch)
+        self.hists.observe("phase.stitch", stitch_s)
         tr = trace.get_tracer()
         if tr is not None:
-            tr.complete("polisher.stitch", t_stitch, _time.perf_counter(),
-                        {"sequences": len(dst)})
+            tr.complete("polisher.stitch", t_stitch,
+                        t_stitch + stitch_s, {"sequences": len(dst)})
         self.logger.log("[racon_tpu::Polisher.polish] generated consensus")
         # cumulative wall-clock, mirroring ~Polisher (polisher.cpp:189)
         self.logger.total("[racon_tpu::Polisher.] total =")
@@ -819,32 +829,50 @@ class Polisher:
                      f"(adaptive={'on' if self.scheduler.adaptive else 'off'})"
                      f": {occ}")
 
-    def _stitch(self, drop_unpolished_sequences: bool) -> list[Sequence]:
-        """Stitch per-window consensus back into whole sequences with
-        the reference's LN/RC/XC tagging (polisher.cpp:506-545)."""
-        dst: list[Sequence] = []
+    def _contig_slices(self) -> list[tuple[int, int]]:
+        """[start, end) window-index ranges, one per target contig, in
+        target order — a contig boundary is the next window's rank 0.
+        The unit the incremental stitcher completes on."""
+        slices: list[tuple[int, int]] = []
+        start = 0
+        for i in range(len(self.windows)):
+            if (i == len(self.windows) - 1
+                    or self.windows[i + 1].rank == 0):
+                slices.append((start, i + 1))
+                start = i + 1
+        return slices
+
+    def _stitch_contig(self, windows: list[Window],
+                       drop_unpolished_sequences: bool) -> Sequence | None:
+        """Stitch ONE contig's windows (rank-ascending) into a polished
+        sequence with the reference's LN/RC/XC tagging
+        (polisher.cpp:506-545); None when the contig is dropped as
+        fully unpolished."""
         polished_data = bytearray()
         num_polished_windows = 0
-
-        for i, window in enumerate(self.windows):
+        for window in windows:
             num_polished_windows += 1 if window.polished else 0
             polished_data += window.consensus
+        last = windows[-1]
+        ratio = num_polished_windows / float(last.rank + 1)
+        if drop_unpolished_sequences and ratio <= 0:
+            return None
+        tags = "r" if self.type == PolisherType.kF else ""
+        tags += f" LN:i:{len(polished_data)}"
+        tags += f" RC:i:{self.targets_coverages[last.id]}"
+        tags += f" XC:f:{ratio:.6f}"
+        return create_sequence(self.sequences[last.id].name + tags,
+                               bytes(polished_data))
 
-            last = (i == len(self.windows) - 1
-                    or self.windows[i + 1].rank == 0)
-            if last:
-                ratio = num_polished_windows / float(window.rank + 1)
-                if not drop_unpolished_sequences or ratio > 0:
-                    tags = "r" if self.type == PolisherType.kF else ""
-                    tags += f" LN:i:{len(polished_data)}"
-                    tags += f" RC:i:{self.targets_coverages[window.id]}"
-                    tags += f" XC:f:{ratio:.6f}"
-                    dst.append(create_sequence(
-                        self.sequences[window.id].name + tags,
-                        bytes(polished_data)))
-                num_polished_windows = 0
-                polished_data = bytearray()
-
+    def _stitch(self, drop_unpolished_sequences: bool) -> list[Sequence]:
+        """Stitch per-window consensus back into whole sequences, one
+        contig at a time."""
+        dst: list[Sequence] = []
+        for start, end in self._contig_slices():
+            seq = self._stitch_contig(self.windows[start:end],
+                                      drop_unpolished_sequences)
+            if seq is not None:
+                dst.append(seq)
         return dst
 
     def emit_observability(self) -> None:
@@ -881,3 +909,61 @@ class Polisher:
         if saved:
             log_info(f"[racon_tpu::obs] trace written to {saved} "
                      "(open in https://ui.perfetto.dev)")
+
+
+class ContigStreamer:
+    """Incremental stitcher over the continuous batcher's iteration
+    stream: feed completed windows in ANY order (`on_windows` is the
+    batcher's per-iteration delivery hook), receive finished contigs in
+    CONTIG order — a contig ships the moment its last window lands AND
+    every earlier contig has shipped, so the concatenation of emitted
+    parts is byte-identical to `Polisher._stitch`'s one-shot output
+    (test-pinned, including with quarantined windows in the mix).
+
+    `on_part` (callable(Sequence) or None) sees each stitched contig as
+    it completes — the serve layer forwards these as `result_part`
+    frames; exceptions from it are swallowed (streaming is decoration
+    on the polish, never a dependency of it)."""
+
+    def __init__(self, polisher: "Polisher", drop_unpolished: bool,
+                 on_part=None):
+        self._polisher = polisher
+        self._drop = drop_unpolished
+        self._on_part = on_part
+        self._slices = polisher._contig_slices()
+        self._remaining = [end - start for start, end in self._slices]
+        self._contig_of: dict[int, int] = {}
+        for ci, (start, end) in enumerate(self._slices):
+            for w in polisher.windows[start:end]:
+                self._contig_of[id(w)] = ci
+        self._next = 0
+        self._out: list[Sequence] = []
+        #: cumulative stitch seconds, scattered across deliveries —
+        #: polish() observes it as the phase.stitch latency
+        self.stitch_s = 0.0
+
+    def on_windows(self, windows: list[Window]) -> None:
+        for w in windows:
+            self._remaining[self._contig_of[id(w)]] -= 1
+        while (self._next < len(self._slices)
+               and self._remaining[self._next] == 0):
+            start, end = self._slices[self._next]
+            t0 = time.perf_counter()
+            seq = self._polisher._stitch_contig(
+                self._polisher.windows[start:end], self._drop)
+            self.stitch_s += time.perf_counter() - t0
+            self._next += 1
+            if seq is None:
+                continue
+            self._out.append(seq)
+            if self._on_part is not None:
+                try:
+                    self._on_part(seq)
+                except Exception:  # noqa: BLE001 — see docstring
+                    pass
+
+    def finish(self) -> list[Sequence]:
+        """The full stitched output, identical to `_stitch`'s list.
+        Valid once the batcher's consensus() returned (every window
+        delivered)."""
+        return self._out

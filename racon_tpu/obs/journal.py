@@ -7,11 +7,15 @@ because the ring forgets and dumps only happen on failure. `Journal`
 closes that gap the way inference servers' request logs do:
 
   - ONE LINE PER LIFECYCLE TRANSITION, as JSON (JSONL): received,
-    admitted / rejected (with retry_after), started, round joined,
-    finished / failed / deadline-miss, expired, drain — each keyed by
-    job id and (when the client minted one) trace id, stamped with wall
-    time. `jq` is a full query engine over it; `tools/obsreport.py`
-    renders per-job timelines from it alongside flight dumps.
+    admitted / rejected (with retry_after), started, one
+    `part-streamed` per stitched contig (keyed by job + contig — the
+    continuous batcher stitches every serve job incrementally), an
+    `iterations` summary, finished / failed / deadline-miss, expired,
+    drain — each keyed by job id and (when the client minted one)
+    trace id, stamped with wall time. `jq` is a full query engine over
+    it; `tools/obsreport.py` renders per-job timelines from it
+    alongside flight dumps, and its `--check` verifies the
+    parts-streamed count equals each successful job's contig count.
   - SIZE-BOUNDED, not append-forever: when the file would exceed
     `max_bytes` (RACON_TPU_JOURNAL_MAX_BYTES, default 8 MiB) it rotates
     to `<path>.1` (one older generation kept, previous `.1` replaced),

@@ -53,7 +53,34 @@ from .ladder import ladder_1d, ladder_2d, padded_cost_1d, round_up
 from .telemetry import OccupancyStats
 
 __all__ = ["BatchScheduler", "OccupancyStats", "enable_compile_cache",
-           "ladder_1d", "ladder_2d", "padded_cost_1d", "round_up"]
+           "ladder_1d", "ladder_2d", "pack_iteration", "padded_cost_1d",
+           "round_up"]
+
+
+def pack_iteration(items: list, cap: int, shape_key, age_key):
+    """Incremental packing entry point for the continuous serve feeder
+    (serve/batcher.py): from a pending pool, pick ONE bounded,
+    shape-homogeneous batch that still guarantees progress for the
+    oldest work.
+
+    The pool is sorted by `shape_key` (the quantities the ladders
+    bucket on — depth, length), then the contiguous slab of at most
+    `cap` items CONTAINING the item with the minimal `age_key` is
+    taken: the batch lands in few ladder buckets (the same
+    minimal-padding win as the per-run sorted packing, applied per
+    iteration) while the oldest item always ships this iteration — no
+    starvation however the shapes interleave.
+
+    Returns `(batch, rest)`; `rest` preserves the sorted order, ready
+    to re-pool."""
+    if not items:
+        return [], []
+    ordered = sorted(items, key=shape_key)
+    cap = max(1, int(cap))
+    oldest = min(range(len(ordered)), key=lambda i: age_key(ordered[i]))
+    start = min(oldest, max(0, len(ordered) - cap))
+    return (ordered[start:start + cap],
+            ordered[:start] + ordered[start + cap:])
 
 
 def enable_compile_cache(path: str) -> None:

@@ -6,14 +6,20 @@ the request-queue + continuous-batching shape of modern inference
 servers, built from the repo's existing layers:
 
     protocol.py   length-prefixed JSON frames (unix socket / localhost
-                  TCP), typed error responses
+                  TCP), typed error responses, streamed `result_part`
+                  frames
     queue.py      bounded JobQueue: admission control with retry-after,
-                  FIFO-within-priority, per-job deadlines
-    batcher.py    cross-job window batching through the sched ladders
-                  (per-job output byte-identical to a solo run)
-    server.py     PolishServer: warm engine set, worker pool, graceful
-                  SIGTERM drain, per-job failure isolation + obs scoping
-    client.py     PolishClient / `racon_tpu submit`
+                  per-tenant weighted fair scheduling within priority,
+                  per-job deadlines
+    batcher.py    CONTINUOUS cross-job window batching: a persistent
+                  device feeder packs bounded shape-homogeneous
+                  iterations through the sched ladders — late jobs join
+                  the next dispatch, no round barrier (per-job output
+                  byte-identical to a solo run)
+    server.py     PolishServer: warm engine set, worker pool, per-contig
+                  result streaming, graceful SIGTERM drain, per-job
+                  failure isolation + obs scoping
+    client.py     PolishClient / `racon_tpu submit [--stream]`
 
 CLI: `python -m racon_tpu.cli serve ...` / `... submit ...`;
 benchmarks: tools/servebench.py; failure matrix: tools/faultcheck.py

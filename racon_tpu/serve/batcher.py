@@ -1,36 +1,54 @@
-"""Cross-job window batching: one warm engine pass over many jobs.
+"""Continuous cross-job window batching: iteration-level dispatch.
 
 A window's consensus depends only on the window itself (backbone +
 layers) and the engine parameters — never on which other windows share
 its device batch. The scheduler's sorted packing already exploits this
 within one run (results restore by index, byte-identical, PR-3 pinned);
-`WindowBatcher` extends the same invariant ACROSS jobs: windows from
-concurrent polish requests are concatenated into one engine pass, so one
-job's stragglers fill the padding lanes another job's batch would have
-burned, and each job's windows come back carrying their consensus exactly
-as a solo run would have produced (test-pinned in tests/test_serve.py).
+`WindowBatcher` extends the same invariant ACROSS jobs, continuously:
+windows from concurrent polish requests pool per engine-parameter key
+and a persistent DEVICE FEEDER drains the pool in bounded, shape-
+homogeneous ITERATIONS — one engine pass each — so a job that arrives
+mid-flight joins the very next dispatch instead of waiting for anyone
+else's round to finish. Each job's windows come back carrying their
+consensus exactly as a solo run would have produced (test-pinned in
+tests/test_serve.py, including under injected faults).
 
-Mechanics — the leader/joiner gather pattern:
+This replaces the PR-5 leader/joiner round barrier (gather window +
+`min_gather`, one `generate_consensus` over every gathered job, the exec
+lock held for the whole round). The round design made a job's latency
+the SLOWEST co-round job's latency and made a late submit wait out the
+entire in-flight round; the feeder holds the exec lock only per
+iteration, so:
 
-  - a job thread calling `consensus(polisher)` files a ticket under the
-    job's engine-parameter key (jobs with different scores / window
-    length / engine must not share a pass);
-  - the first ticket for a key becomes the LEADER: it waits up to
-    `gather_window_s` (or until `min_gather` tickets joined), takes the
-    whole group, and runs ONE `BatchPOA.generate_consensus` over the
-    concatenated windows;
-  - joiners block on their ticket; results demultiplex for free because
-    every window object belongs to exactly one job's polisher.
+  - late-arriving jobs' windows join the next iteration (bounded by
+    `iteration_windows`, not by the largest co-tenant job);
+  - a job's windows COMPLETE INCREMENTALLY — `consensus(on_windows=...)`
+    delivers each iteration's finished windows as they land, which is
+    what lets the polisher stitch and stream finished contigs before
+    the job is done (core/polisher.py, `result_part` frames);
+  - per-iteration telemetry (`serve.iteration` span/histogram, lane
+    accounting via the shared sched occupancy stats) replaces the old
+    round granularity.
 
-Engine passes are serialized on one executor lock — the device is a
-single shared resource, and serialization makes the per-round compile
-telemetry (the "warm submit = 0 compiles" acceptance signal) exact.
+Iteration packing: the feeder always serves the key holding the
+globally oldest pending window (no starvation), sorts that key's pool
+by window shape (depth, backbone length — the quantities the sched
+ladders bucket on) and takes the contiguous shape-sorted slab of at
+most `iteration_windows` windows that CONTAINS the oldest one: the
+batch stays shape-homogeneous for the ladders while the oldest work
+always makes progress. `max_wait_s` (default 0 — dispatch immediately)
+optionally lets a sparse pool coalesce briefly before a short
+iteration; it bounds added latency, unlike the old gather window it
+never waits when a full iteration is already pending.
 
 Isolation: a job carrying its own fault plan or a strict posture never
-shares a batch — it runs its polisher's own `_consensus_pass()` (own
-pipeline, own injected faults), so an injected `DeviceError` storm fails
-exactly one job while the batcher, the warm engines and every concurrent
-job continue untouched.
+shares an iteration — it runs its polisher's own `_consensus_pass()`
+(own pipeline, own injected faults) under the exec lock, so an injected
+`DeviceError` storm fails exactly one job while the feeder, the warm
+engines and every concurrent job continue untouched. An engine-pass
+failure inside a shared iteration fails every job with windows IN that
+iteration (their remaining pooled windows are withdrawn); jobs in other
+iterations and the feeder itself survive.
 """
 
 from __future__ import annotations
@@ -43,31 +61,82 @@ from ..obs import trace
 
 
 class _Ticket:
-    __slots__ = ("polisher", "event", "error", "round_info")
+    """One job's consensus request in the pool. The feeder DELIVERS
+    each iteration's finished windows through a small queue; the job's
+    own blocked thread consumes them (and runs the incremental-stitch
+    callback there) — stitching, journaling and frame encoding never
+    run on the feeder thread, so one job's heavy contig cannot stall
+    device dispatch for everyone else."""
 
-    def __init__(self, polisher):
+    __slots__ = ("polisher", "key", "event", "error",
+                 "total", "remaining", "done", "iterations",
+                 "iteration_ids", "shared_iterations", "compiles",
+                 "compile_s", "device_s", "_delivery")
+
+    def __init__(self, polisher, key):
+        from .queue import DeliveryQueue
+
         self.polisher = polisher
-        self.event = threading.Event()
+        self.key = key
         self.error: BaseException | None = None
-        self.round_info: dict | None = None
+        self.total = len(polisher.windows)
+        self.remaining = self.total
+        self.done = 0
+        self.iterations = 0
+        self.iteration_ids: list[int] = []
+        self.shared_iterations = 0
+        self.compiles = 0
+        self.compile_s = 0.0
+        self.device_s = 0.0
+        #: finished-window handoff feeder -> job thread; the queue owns
+        #: the completion flag and the wakeup discipline (see
+        #: queue.DeliveryQueue — a bare event.set() would leave the
+        #: consumer burning out its take() timeout, a silent latency
+        #: floor on every job's tail)
+        self._delivery = DeliveryQueue()
+        self.event = self._delivery.event
+
+    def deliver(self, windows: list) -> None:
+        """Feeder thread: hand a batch of finished windows to the
+        waiting job thread (cheap — an append and a notify)."""
+        self._delivery.push(windows)
+
+    def finish(self) -> None:
+        """Feeder thread: mark the ticket complete AND wake the
+        consumer."""
+        self._delivery.finish()
+
+    def take(self, timeout: float | None = None) -> list | None:
+        """Job thread: the oldest undelivered batch, or None."""
+        return self._delivery.take(timeout)
+
+    def batch_info(self, solo: bool = False) -> dict:
+        return {"iterations": self.iterations,
+                "iteration_ids": list(self.iteration_ids),
+                "shared_iterations": self.shared_iterations,
+                "windows": self.total, "solo": solo,
+                "compiles": self.compiles,
+                "compile_s": round(self.compile_s, 3),
+                "device_s": round(self.device_s, 4)}
 
 
-class _RoundProgress:
-    """Duck-typed Logger for shared rounds: the engine sees the usual
-    `bar_total`/`bar` surface, but instead of stderr the bin-level ticks
-    fan out to every participating job's live-progress hook, scaled to
-    that job's own window count (a tick in a shared round advances every
-    participant's bar by its share — windows are not attributable to
-    jobs mid-engine, fractions of the round are). Monotonicity across
-    re-armed bars (an engine's fallback pass calls bar_total again) is
-    enforced downstream by Polisher.emit_progress' per-phase
-    high-water mark. Silent by design: shared rounds never print."""
+class _IterProgress:
+    """Duck-typed Logger for one iteration: the engine sees the usual
+    `bar_total`/`bar` surface, but the bin-level ticks fan out to every
+    participating job's live-progress hook, scaled to that job's window
+    share of THIS iteration and offset by the windows it completed in
+    earlier iterations — so a client's consensus bar advances smoothly
+    across iterations. Monotonicity across re-armed bars (an engine's
+    fallback pass calls bar_total again) is enforced downstream by
+    Polisher.emit_progress's per-phase high-water mark. Silent by
+    design: shared iterations never print."""
 
-    def __init__(self, tickets, round_no: int):
-        self._jobs = [(t.polisher, len(t.polisher.windows))
-                      for t in tickets
-                      if t.polisher.progress_hook is not None]
-        self._round = round_no
+    def __init__(self, parts, iteration: int):
+        #: (polisher, done_before, n_in_iteration, job_total)
+        self._parts = [(t.polisher, t.done, n, t.total)
+                       for t, n in parts
+                       if t.polisher.progress_hook is not None]
+        self._iter = iteration
         self._total = 1
         self._count = 0
         self._bins = 0
@@ -75,7 +144,7 @@ class _RoundProgress:
 
     @property
     def active(self) -> bool:
-        return bool(self._jobs)
+        return bool(self._parts)
 
     def bar_total(self, total: int) -> None:
         with self._lock:
@@ -91,9 +160,10 @@ class _RoundProgress:
                 return
             self._bins = bins
             frac = min(1.0, self._count / self._total)
-        for polisher, n in self._jobs:
-            polisher.emit_progress(int(frac * n), n, phase="consensus",
-                                   round=self._round)
+        for polisher, before, n, total in self._parts:
+            polisher.emit_progress(before + int(frac * n), total,
+                                   phase="consensus",
+                                   iteration=self._iter)
 
     # the rest of the Logger surface, defensively no-op
     def log(self, msg=None) -> None:
@@ -104,121 +174,252 @@ class _RoundProgress:
 
 
 def _trace_ids(tickets) -> list[str]:
-    """The client-minted trace ids riding this round's jobs (the server
-    stamps `serve_trace_id` on each job's polisher) — tagged onto the
-    gather/round spans so a merged client+server trace can attribute
-    shared rounds."""
+    """The client-minted trace ids riding this iteration's jobs (the
+    server stamps `serve_trace_id` on each job's polisher) — tagged onto
+    the iteration spans so a merged client+server trace can attribute
+    shared iterations."""
     return [tid for tid in
             (getattr(t.polisher, "serve_trace_id", None) for t in tickets)
             if tid]
 
 
 def _engine_key(p) -> tuple:
-    """Engine-parameter identity: jobs share a pass only when every
-    knob that can influence a window's consensus bytes matches."""
+    """Engine-parameter identity: jobs share an iteration only when
+    every knob that can influence a window's consensus bytes matches."""
     return (p.match, p.mismatch, p.gap, p.window_length, p.trim,
             p.num_threads, p.tpu_poa_batches, p.tpu_banded_alignment,
             p.tpu_aligner_band_width, p.tpu_engine,
             p.tpu_pipeline_depth, p.tpu_device_timeout)
 
 
+def _shape_key(window) -> tuple[int, int]:
+    """The quantities the sched ladders bucket on: layer depth and
+    backbone length. Sorting the pool by this keeps each iteration's
+    batch shape-homogeneous, so the per-iteration engine pass packs
+    into few ladder buckets instead of inheriting arrival order."""
+    return (len(window.sequences), len(window.sequences[0]))
+
+
 class WindowBatcher:
-    def __init__(self, gather_window_s: float = 0.05, min_gather: int = 2,
-                 scheduler=None):
+    """Continuous batching core (see module docstring).
+
+    `iteration_windows` bounds one iteration's batch (the latency
+    quantum under load); `max_wait_s` optionally lets a sparse pool
+    coalesce before a short iteration (0 = dispatch immediately)."""
+
+    def __init__(self, iteration_windows: int = 256,
+                 max_wait_s: float = 0.0, scheduler=None):
         from ..pipeline import PipelineStats
         from ..sched import BatchScheduler
 
-        self.gather_window_s = max(0.0, float(gather_window_s))
-        self.min_gather = max(1, int(min_gather))
-        #: one scheduler + stage-stat sink for every shared round: the
-        #: server-lifetime occupancy/compile telemetry servebench reads
+        self.iteration_windows = max(1, int(iteration_windows))
+        self.max_wait_s = max(0.0, float(max_wait_s))
+        #: one scheduler + stage-stat sink for every shared iteration:
+        #: the server-lifetime occupancy/compile telemetry servebench
+        #: reads
         self.scheduler = (scheduler if scheduler is not None
                           else BatchScheduler.from_env())
         self.pipeline_stats = PipelineStats()
-        self._cond = threading.Condition()
-        self._pending: dict[tuple, list[_Ticket]] = {}
-        self._leading: set[tuple] = set()
-        #: optional callable -> number of jobs currently executing
-        #: (the server wires its in-flight count): a leader whose ticket
-        #: group already holds every executing job skips the gather wait
-        #: — a lone job must not idle out the window for company that
-        #: cannot arrive
-        self.active_hint = None
         #: optional obs.hist.HistogramSet (the server's lifetime set):
-        #: leader gather waits and device round durations observed as
-        #: latency distributions for the scrape view
+        #: device iteration durations observed as latency distributions
+        #: for the scrape view
         self.hists = None
+        self._cond = threading.Condition()
+        #: per-engine-key pending pool: list of
+        #: [arrival_seq, arrival_t, ticket, window]
+        self._pools: dict[tuple, list] = {}
+        self._entry_seq = itertools.count()
         self._exec_lock = threading.Lock()
-        self._round_seq = itertools.count()
-        self.counters = {"rounds": 0, "solo_rounds": 0,
-                         "multi_job_rounds": 0, "jobs": 0, "windows": 0,
-                         "max_jobs_in_round": 0}
+        self._iter_seq = itertools.count()
+        self._feeder: threading.Thread | None = None
+        self._stop = False
+        self._held = False
+        self.counters = {"iterations": 0, "solo_iterations": 0,
+                         "shared_iterations": 0, "jobs": 0, "windows": 0,
+                         "max_jobs_in_iteration": 0,
+                         "max_windows_in_iteration": 0}
 
     # ------------------------------------------------------------ entry
-    def consensus(self, polisher) -> None:
-        """Run the consensus pass for `polisher.windows`, possibly merged
-        with concurrent jobs' windows (see module docstring). On return
-        every window carries consensus/polished; round telemetry is left
-        on `polisher.serve_round` for the server's response."""
+    def consensus(self, polisher, on_windows=None) -> None:
+        """Run the consensus pass for `polisher.windows`, merged into
+        the continuous iteration stream with concurrent jobs' windows
+        (see module docstring). `on_windows`, when given, is invoked
+        with each batch of THIS job's windows as their iteration
+        completes (serialized, in completion order) — the incremental-
+        stitch hook. On return every window carries consensus/polished;
+        iteration telemetry is left on `polisher.serve_batch` for the
+        server's response."""
         from ..resilience import strict_mode
 
         if polisher.faults is not None or strict_mode():
-            # isolation round: injected faults / strict posture stay on
-            # this job's own pipeline and never touch a shared batch
-            rnd = next(self._round_seq)
+            # isolation iteration: injected faults / strict posture stay
+            # on this job's own pipeline and never touch a shared batch
+            it = next(self._iter_seq)
             t0 = time.perf_counter()
             with self._exec_lock:
                 polisher._consensus_pass()
+            t1 = time.perf_counter()
             if self.hists is not None:
-                self.hists.observe("serve.round",
-                                   time.perf_counter() - t0)
+                self.hists.observe("serve.iteration", t1 - t0)
             self._account(1, len(polisher.windows), solo=True)
-            polisher.serve_round = {"round": rnd, "jobs": 1,
-                                    "windows": len(polisher.windows),
-                                    "solo": True}
+            ticket = _Ticket(polisher, None)
+            ticket.iterations = 1
+            ticket.iteration_ids = [it]
+            ticket.device_s = t1 - t0
+            polisher.serve_batch = ticket.batch_info(solo=True)
+            if on_windows is not None:
+                on_windows(list(polisher.windows))
             return
 
-        key = _engine_key(polisher)
-        ticket = _Ticket(polisher)
+        ticket = _Ticket(polisher, _engine_key(polisher))
+        if ticket.total == 0:
+            polisher.serve_batch = ticket.batch_info()
+            return
+        now = time.monotonic()
         with self._cond:
-            self._pending.setdefault(key, []).append(ticket)
-            leader = key not in self._leading
-            if leader:
-                self._leading.add(key)
+            if self._stop:
+                from ..errors import RaconError
+
+                raise RaconError("WindowBatcher",
+                                 "batcher is closed (server draining)")
+            self._ensure_feeder_locked()
+            pool = self._pools.setdefault(ticket.key, [])
+            for w in polisher.windows:
+                pool.append([next(self._entry_seq), now, ticket, w])
             self._cond.notify_all()
-        if not leader:
-            ticket.event.wait()
-        else:
-            t_gather = time.monotonic()
-            t_gather_pc = time.perf_counter()
-            deadline = t_gather + self.gather_window_s
-            hint = self.active_hint
+        # consume deliveries ON THIS THREAD: the incremental-stitch
+        # callback (and whatever it does — journal writes, frame
+        # encodes) bills to this job, never to the feeder; an exception
+        # from it propagates and fails THIS job loudly, exactly like
+        # the isolation path above — a stitch bug must not silently
+        # truncate a "successful" result
+        try:
+            while True:
+                ws = ticket.take(timeout=0.1)
+                if ws is not None:
+                    if on_windows is not None:
+                        on_windows(ws)
+                    continue
+                if ticket.event.is_set():
+                    break
+            while True:  # feeder set the event after its last deliver
+                ws = ticket.take()
+                if ws is None:
+                    break
+                if on_windows is not None:
+                    on_windows(ws)
+        except BaseException as exc:
+            # mark the ticket dead so the feeder WITHDRAWS its
+            # remaining pooled windows instead of burning device
+            # iterations on a job whose client already got an error
             with self._cond:
-                while len(self._pending[key]) < self.min_gather:
-                    if (hint is not None
-                            and hint() <= len(self._pending[key])):
-                        break
-                    left = deadline - time.monotonic()
-                    if left <= 0:
-                        break
-                    self._cond.wait(left)
-                batch = self._pending.pop(key)
-                if self.hists is not None:
-                    self.hists.observe("serve.gather_wait",
-                                       time.monotonic() - t_gather)
-                # release the key BEFORE executing: tickets arriving
-                # mid-round start gathering the next round immediately
-                self._leading.discard(key)
-            tr = trace.get_tracer()
-            if tr is not None:
-                tr.complete("serve.gather_wait", t_gather_pc,
-                            time.perf_counter(),
-                            {"jobs": len(batch),
-                             "trace_ids": _trace_ids(batch)})
-            self._execute(batch)
+                if ticket.error is None:
+                    ticket.error = exc
+            raise
         if ticket.error is not None:
             raise ticket.error
-        polisher.serve_round = ticket.round_info
+        polisher.serve_batch = ticket.batch_info()
+
+    # ----------------------------------------------------------- feeder
+    def _ensure_feeder_locked(self) -> None:
+        """Start the feeder thread lazily (caller holds `_cond` and has
+        already checked `_stop` — a refused submit must not spawn a
+        throwaway thread or clobber the handle close() is joining)."""
+        if self._feeder is not None and self._feeder.is_alive():
+            return
+        t = threading.Thread(target=self._feeder_loop,
+                             name="racon-tpu-serve-feeder",
+                             daemon=True)
+        self._feeder = t
+        t.start()
+
+    def close(self, timeout: float = 5.0) -> None:
+        """Stop the feeder once the pool is empty. Jobs already pooled
+        finish; new consensus() calls are refused."""
+        with self._cond:
+            self._stop = True
+            self._held = False
+            self._cond.notify_all()
+        feeder = self._feeder
+        if feeder is not None and feeder.is_alive() \
+                and feeder is not threading.current_thread():
+            feeder.join(timeout)
+
+    def _feeder_loop(self) -> None:
+        while True:
+            batch = None
+            with self._cond:
+                while True:
+                    if self._held and not self._stop:
+                        self._cond.wait(0.1)
+                        continue
+                    key = self._oldest_key_locked()
+                    if key is None:
+                        if self._stop:
+                            return
+                        self._cond.wait(0.5)
+                        continue
+                    pool = self._pools[key]
+                    if (self.max_wait_s > 0.0 and not self._stop
+                            and len(pool) < self.iteration_windows):
+                        # a FULL iteration pending under any other key
+                        # dispatches right away — the coalescing wait
+                        # must never idle the device past ready work
+                        full = next(
+                            (k for k, p in self._pools.items()
+                             if len(p) >= self.iteration_windows),
+                            None)
+                        if full is not None:
+                            batch = self._extract_locked(full)
+                            break
+                        # brief coalescing wait, bounded by the OLDEST
+                        # entry's age
+                        left = (min(e[1] for e in pool)
+                                + self.max_wait_s - time.monotonic())
+                        if left > 0:
+                            self._cond.wait(min(left, 0.5))
+                            continue
+                    batch = self._extract_locked(key)
+                    break
+            if not batch:
+                continue
+            try:
+                self._run_iteration(batch)
+            except BaseException as exc:  # noqa: BLE001 — the feeder
+                # must outlive any single iteration: fail the
+                # participants, keep draining the pool
+                self._fail_tickets({e[2] for e in batch}, exc)
+
+    def _oldest_key_locked(self) -> tuple | None:
+        """The engine key holding the globally oldest pending window —
+        cross-key FIFO, so one parameter set cannot starve another."""
+        best, best_seq = None, None
+        for key, pool in list(self._pools.items()):
+            pool[:] = [e for e in pool if e[2].error is None]
+            if not pool:
+                del self._pools[key]
+                continue
+            seq = min(e[0] for e in pool)
+            if best_seq is None or seq < best_seq:
+                best, best_seq = key, seq
+        return best
+
+    def _extract_locked(self, key: tuple) -> list:
+        """Take one iteration's entries via the sched layer's
+        incremental packing: a shape-homogeneous slab of at most
+        `iteration_windows` windows that contains (and therefore
+        ships) the oldest pending entry."""
+        from ..sched import pack_iteration
+
+        batch, rest = pack_iteration(
+            self._pools[key], self.iteration_windows,
+            shape_key=lambda e: _shape_key(e[3]),
+            age_key=lambda e: e[0])
+        if rest:
+            self._pools[key] = rest
+        else:
+            del self._pools[key]
+        return batch
 
     # -------------------------------------------------------- execution
     def _compile_totals(self) -> tuple[int, float]:
@@ -226,85 +427,114 @@ class WindowBatcher:
         return (sum(e.get("compiles", 0) for e in snap.values()),
                 sum(e.get("compile_s", 0.0) for e in snap.values()))
 
-    def _execute(self, tickets: list[_Ticket]) -> None:
+    def _run_iteration(self, batch: list) -> None:
         from ..ops.poa import BatchPOA
         from ..pipeline import DispatchPipeline
         from ..resilience import Watchdog
 
+        windows = [e[3] for e in batch]
+        per_ticket: dict = {}
+        for e in batch:
+            per_ticket.setdefault(e[2], []).append(e[3])
+        tickets = list(per_ticket)
         p0 = tickets[0].polisher
-        windows = []
-        for t in tickets:
-            windows.extend(t.polisher.windows)
-        rnd = next(self._round_seq)
-        progress = _RoundProgress(tickets, rnd)
-        try:
-            with self._exec_lock:
-                pre_c, pre_s = self._compile_totals()
-                pipeline = DispatchPipeline(
-                    depth=p0.tpu_pipeline_depth,
-                    stats=self.pipeline_stats,
-                    fallback_workers=max(1, min(4, p0.num_threads)),
-                    watchdog=Watchdog.from_env(
-                        timeout=p0.tpu_device_timeout or None))
-                engine = BatchPOA(p0.match, p0.mismatch, p0.gap,
-                                  p0.window_length,
-                                  num_threads=p0.num_threads,
-                                  device_batches=p0.tpu_poa_batches,
-                                  banded=p0.tpu_banded_alignment,
-                                  band_width=p0.tpu_aligner_band_width,
-                                  logger=(progress if progress.active
-                                          else None),
-                                  engine=p0.tpu_engine,
-                                  pipeline=pipeline,
-                                  scheduler=self.scheduler)
-                t0 = time.perf_counter()
-                with pipeline:
-                    engine.generate_consensus(windows, p0.trim)
-                t1 = time.perf_counter()
-                post_c, post_s = self._compile_totals()
-            tr = trace.get_tracer()
-            if tr is not None:
-                tr.complete("serve.batch_round", t0, t1,
-                            {"round": rnd, "jobs": len(tickets),
-                             "windows": len(windows),
-                             "trace_ids": _trace_ids(tickets)})
-            if self.hists is not None:
-                self.hists.observe("serve.round", t1 - t0)
-        except BaseException as exc:
-            # a shared-round failure fails every participant the same
-            # way a solo run would have (strict-off degradation happens
-            # INSIDE generate_consensus; reaching here means even the
-            # degraded path gave up) — the batcher itself stays alive
+        it = next(self._iter_seq)
+        progress = _IterProgress(
+            [(t, len(ws)) for t, ws in per_ticket.items()], it)
+        with self._exec_lock:
+            pre_c, pre_s = self._compile_totals()
+            pipeline = DispatchPipeline(
+                depth=p0.tpu_pipeline_depth,
+                stats=self.pipeline_stats,
+                fallback_workers=max(1, min(4, p0.num_threads)),
+                watchdog=Watchdog.from_env(
+                    timeout=p0.tpu_device_timeout or None))
+            engine = BatchPOA(p0.match, p0.mismatch, p0.gap,
+                              p0.window_length,
+                              num_threads=p0.num_threads,
+                              device_batches=p0.tpu_poa_batches,
+                              banded=p0.tpu_banded_alignment,
+                              band_width=p0.tpu_aligner_band_width,
+                              logger=(progress if progress.active
+                                      else None),
+                              engine=p0.tpu_engine,
+                              pipeline=pipeline,
+                              scheduler=self.scheduler)
+            t0 = time.perf_counter()
+            with pipeline:
+                engine.generate_consensus(windows, p0.trim)
+            t1 = time.perf_counter()
+            post_c, post_s = self._compile_totals()
+        tr = trace.get_tracer()
+        if tr is not None:
+            tr.complete("serve.iteration", t0, t1,
+                        {"iteration": it, "jobs": len(tickets),
+                         "windows": len(windows),
+                         "trace_ids": _trace_ids(tickets)})
+        if self.hists is not None:
+            self.hists.observe("serve.iteration", t1 - t0)
+        self._account(len(tickets), len(windows), solo=False)
+        shared = len(tickets) > 1
+        for ticket, ws in per_ticket.items():
+            ticket.iterations += 1
+            ticket.iteration_ids.append(it)
+            if shared:
+                ticket.shared_iterations += 1
+            ticket.compiles += post_c - pre_c
+            ticket.compile_s += post_s - pre_s
+            ticket.device_s += t1 - t0
+            ticket.done += len(ws)
+            ticket.remaining -= len(ws)
+            # iteration boundary: every participant's bar reaches its
+            # exact completed-window count even if the engine's tick
+            # quantization stopped short of the last bin
+            ticket.polisher.emit_progress(ticket.done, ticket.total,
+                                          phase="consensus",
+                                          iteration=it)
+            # hand the finished windows to the job's own thread (which
+            # runs the incremental stitch there); event LAST so the
+            # consumer's drain-after-event sees every delivery
+            ticket.deliver(ws)
+            if ticket.remaining <= 0:
+                ticket.finish()
+
+    def _fail_tickets(self, tickets, exc: BaseException) -> None:
+        """An iteration died (strict-off degradation happens INSIDE
+        generate_consensus; reaching here means even the degraded path
+        gave up): fail every participant the same way a solo run would
+        have, withdraw their remaining pooled windows, keep feeding."""
+        with self._cond:
             for t in tickets:
                 t.error = exc
-                t.event.set()
-            return
-        info = {"round": rnd, "jobs": len(tickets),
-                "windows": len(windows), "solo": False,
-                "compiles": post_c - pre_c,
-                "compile_s": round(post_s - pre_s, 3),
-                "round_s": round(t1 - t0, 4)}
-        self._account(len(tickets), len(windows), solo=False)
-        for polisher, n in progress._jobs:
-            # the round is done: every participant's consensus bar
-            # completes even if the engine's tick quantization stopped
-            # short of the last bin
-            polisher.emit_progress(n, n, phase="consensus", round=rnd)
         for t in tickets:
-            t.round_info = dict(info, job_windows=len(t.polisher.windows))
-            t.event.set()
+            t.finish()
 
     def _account(self, jobs: int, windows: int, solo: bool) -> None:
         with self._cond:
-            self.counters["rounds"] += 1
+            self.counters["iterations"] += 1
             self.counters["jobs"] += jobs
             self.counters["windows"] += windows
             if solo:
-                self.counters["solo_rounds"] += 1
+                self.counters["solo_iterations"] += 1
             if jobs > 1:
-                self.counters["multi_job_rounds"] += 1
-            self.counters["max_jobs_in_round"] = max(
-                self.counters["max_jobs_in_round"], jobs)
+                self.counters["shared_iterations"] += 1
+            self.counters["max_jobs_in_iteration"] = max(
+                self.counters["max_jobs_in_iteration"], jobs)
+            self.counters["max_windows_in_iteration"] = max(
+                self.counters["max_windows_in_iteration"], windows)
+
+    # ------------------------------------------------------- test hooks
+    def hold(self) -> None:
+        """Pause the feeder BEFORE it extracts its next iteration
+        (tests: make multi-job iterations deterministic by pooling
+        several jobs before releasing)."""
+        with self._cond:
+            self._held = True
+
+    def release(self) -> None:
+        with self._cond:
+            self._held = False
+            self._cond.notify_all()
 
     def snapshot(self) -> dict:
         with self._cond:

@@ -29,6 +29,12 @@ the same submit (README "End-to-end tracing & progress"):
     submit / wait / receive, progress instants), asks the server for
     the job's server-side trace, and merges both into one Chrome-trace
     JSON — two Perfetto process tracks on a single timeline.
+  - `--stream` / `submit(..., on_part=cb)`: the server streams each
+    polished contig as a `result_part` frame the moment its windows
+    complete (continuous batching stitches per contig); the final
+    result frame carries the stats and the concatenation of the parts
+    is byte-identical to the buffered FASTA. Time-to-first-byte becomes
+    the FIRST contig's finish time, not the job's.
 """
 
 from __future__ import annotations
@@ -75,11 +81,22 @@ _ERROR_TYPES = {"queue-full": QueueFull, "draining": ServerDraining,
 
 class PolishResult:
     __slots__ = ("job_id", "fasta", "metrics", "serve", "trace",
-                 "trace_base_mono")
+                 "trace_base_mono", "streamed", "parts")
 
     def __init__(self, resp: dict):
         self.job_id = resp.get("job_id")
-        self.fasta = resp.get("fasta", "").encode("latin-1")
+        #: whether the FASTA arrived as streamed result_part frames
+        #: (then the final frame carries stats only and `fasta` below
+        #: is the parts' concatenation — byte-identical to the
+        #: non-streamed body, test-pinned)
+        self.streamed = bool(resp.get("streamed"))
+        self.parts = resp.get("parts", 0)
+        if self.streamed:
+            self.fasta = b"".join(
+                p.get("fasta", "").encode("latin-1")
+                for p in resp.get("_parts") or [])
+        else:
+            self.fasta = resp.get("fasta", "").encode("latin-1")
         self.metrics = resp.get("metrics") or {}
         self.serve = resp.get("serve") or {}
         self.trace = resp.get("trace")
@@ -107,23 +124,27 @@ class PolishClient:
             sock.connect(self.socket_path)
         return sock
 
-    def request(self, obj: dict, on_progress=None,
+    def request(self, obj: dict, on_progress=None, on_part=None,
                 recorder=None) -> dict:
         """One round trip; raises the ServeError taxonomy on a typed
         error response. Interleaved `progress` frames (a `submit` with
-        "progress": true) are handed to `on_progress` as they arrive;
-        the method returns on the first non-progress frame. `recorder`
-        (an obs.trace.TraceRecorder) captures client-side spans —
-        connect / submit / wait / receive plus a `client.progress`
-        instant per progress frame — passed PER CALL so one client may
-        serve concurrent threads without a traced request absorbing an
-        unrelated request's spans."""
+        "progress": true) are handed to `on_progress` as they arrive,
+        and streamed `result_part` frames (a `submit` with "stream":
+        true) to `on_part`; the method returns on the first frame that
+        is neither, with the collected parts attached as `_parts` so
+        PolishResult can assemble the full FASTA. `recorder` (an
+        obs.trace.TraceRecorder) captures client-side spans — connect /
+        submit / wait / receive plus `client.progress` /
+        `client.result_part` instants per interleaved frame — passed
+        PER CALL so one client may serve concurrent threads without a
+        traced request absorbing an unrelated request's spans."""
         rec = recorder
         t0 = time.perf_counter()
         sock = self._connect()
         if rec is not None:
             rec.complete("client.connect", t0, time.perf_counter())
         frames = 0
+        parts: list[dict] = []
         try:
             t_send = time.perf_counter()
             send_frame(sock, obj)
@@ -142,7 +163,18 @@ class PolishClient:
                 # before would charge a whole no-progress polish to
                 # client.receive and ~0 to wait
                 t_frame = time.perf_counter()
-                if resp is None or resp.get("type") != "progress":
+                rtype = resp.get("type") if resp is not None else None
+                if rtype == "result_part":
+                    parts.append(resp)
+                    if rec is not None:
+                        rec.instant("client.result_part",
+                                    {k: resp[k] for k in
+                                     ("part", "name", "job_id")
+                                     if k in resp})
+                    if on_part is not None:
+                        on_part(resp)
+                    continue
+                if rtype != "progress":
                     break
                 frames += 1
                 if rec is not None:
@@ -155,7 +187,8 @@ class PolishClient:
             if rec is not None:
                 now = time.perf_counter()
                 rec.complete("client.wait", t_wait, t_frame,
-                             {"progress_frames": frames})
+                             {"progress_frames": frames,
+                              "result_parts": len(parts)})
                 rec.complete("client.receive", t_frame, now)
         finally:
             sock.close()
@@ -166,6 +199,8 @@ class PolishClient:
             code = resp.get("code", "error")
             raise _ERROR_TYPES.get(code, ServeError)(
                 code, resp.get("message", ""), resp)
+        if parts:
+            resp["_parts"] = parts
         return resp
 
     def clock_sync(self, samples: int = 3) -> dict:
@@ -197,16 +232,22 @@ class PolishClient:
                deadline_s: float | None = None,
                fault_plan: str | None = None, strict: bool | None = None,
                trace: bool = False, trace_id: str | None = None,
-               on_progress=None, recorder=None,
+               tenant: str | None = None, on_progress=None,
+               on_part=None, stream: bool = False, recorder=None,
                retries: int = 0) -> PolishResult:
         """Polish one input triple on the server. Paths are resolved to
         absolute before they cross the wire (the server's cwd is not the
         client's). `retries` re-submits after `retry_after` on full-queue
         rejects — simple client-side backoff. `on_progress` (callable
         taking each progress frame dict) turns on the server's live
-        progress stream; `trace_id` stamps the job's server-side spans,
-        journal lines and progress frames with a client-chosen
-        correlation id."""
+        progress stream; `on_part` (callable taking each `result_part`
+        frame dict) or `stream=True` turns on per-contig streamed
+        results — finished contigs arrive BEFORE the final frame, and
+        `PolishResult.fasta` is their byte-identical concatenation.
+        `tenant` names the fair-scheduling bucket this job bills to
+        (queue.py weighted DRR); `trace_id` stamps the job's
+        server-side spans, journal lines and interleaved frames with a
+        client-chosen correlation id."""
         req = {"type": "submit",
                "sequences": os.path.abspath(sequences),
                "overlaps": os.path.abspath(overlaps),
@@ -225,14 +266,18 @@ class PolishClient:
             req["trace"] = True
         if trace_id:
             req["trace_id"] = str(trace_id)
+        if tenant:
+            req["tenant"] = str(tenant)
         if on_progress is not None:
             req["progress"] = True
+        if stream or on_part is not None:
+            req["stream"] = True
         attempt = 0
         while True:
             try:
                 return PolishResult(
                     self.request(req, on_progress=on_progress,
-                                 recorder=recorder))
+                                 on_part=on_part, recorder=recorder))
             except QueueFull as exc:
                 if attempt >= retries:
                     raise
@@ -379,6 +424,22 @@ def submit_main(argv: list[str]) -> int:
                          "phase / windows-done / total as the server "
                          "interleaves progress frames before the "
                          "result")
+    ap.add_argument("--stream", action="store_true",
+                    help="stream polished contigs to stdout AS THEY "
+                         "FINISH (`result_part` frames): each contig's "
+                         "FASTA is written the moment its windows "
+                         "complete on the server, the final frame "
+                         "carries only the stats — the concatenated "
+                         "stream is byte-identical to the buffered "
+                         "output. CAVEAT: a job that fails mid-stream "
+                         "leaves the already-streamed contigs on "
+                         "stdout (well-formed but partial); consumers "
+                         "MUST check the exit status, which is "
+                         "nonzero on any failure")
+    ap.add_argument("--tenant", default=None,
+                    help="fair-scheduling tenant id this job bills to "
+                         "(1-64 chars of [A-Za-z0-9._-]; server "
+                         "weights via RACON_TPU_SERVE_TENANT_WEIGHTS)")
     ap.add_argument("--trace-out", metavar="PATH", default=None,
                     help="end-to-end trace: record client-side spans, "
                          "fetch the job's server-side spans, and write "
@@ -421,9 +482,18 @@ def submit_main(argv: list[str]) -> int:
     client = PolishClient(socket_path=args.socket, port=args.port,
                           timeout=args.timeout)
     on_progress = _ProgressPrinter() if args.progress else None
+    on_part = None
+    if args.stream:
+        # parts hit stdout the moment they arrive — time-to-first-byte
+        # is the first finished contig, not the whole job
+        def on_part(frame):
+            sys.stdout.buffer.write(
+                frame.get("fasta", "").encode("latin-1"))
+            sys.stdout.buffer.flush()
     common = dict(options=options, priority=args.priority,
                   deadline_s=args.deadline, retries=args.retries,
-                  on_progress=on_progress)
+                  tenant=args.tenant, on_progress=on_progress,
+                  on_part=on_part)
     trace_doc = None
     try:
         if args.trace_out:
@@ -444,8 +514,12 @@ def submit_main(argv: list[str]) -> int:
         return 1
     if on_progress is not None:
         on_progress.close()
-    sys.stdout.buffer.write(result.fasta)
-    sys.stdout.buffer.flush()
+    if not result.streamed:
+        # the body was NOT streamed (or the server ignored the stream
+        # request): write it now — `--stream` against a non-streaming
+        # server must still produce the FASTA, never empty stdout
+        sys.stdout.buffer.write(result.fasta)
+        sys.stdout.buffer.flush()
     serve = result.serve
     if serve:
         print(f"[racon_tpu::serve] job {result.job_id}: queue wait "

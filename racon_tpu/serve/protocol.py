@@ -31,18 +31,25 @@ machine-readable ``code``; ``queue-full`` errors carry ``retry_after``
 seconds, ``job-failed`` errors carry ``error_type`` from the errors.py
 taxonomy).
 
-Trace context and live progress (both opt-in per submit, README
-"End-to-end tracing & progress"): a ``submit`` may carry a client-minted
+Trace context, live progress and streamed results (all opt-in per
+submit, README "Serving"): a ``submit`` may carry a client-minted
 ``trace_id`` (1-64 chars of ``[A-Za-z0-9._-]``) that the server stamps
-onto its spans, journal lines and progress frames, plus ``"progress":
-true``, which makes the server INTERLEAVE ``progress`` frames on the
-submitting connection before the final ``result``/``error`` frame —
-``{"type": "progress", "job_id", "seq", "phase", ...}`` with
-monotonically increasing ``seq``, queue ``position``/``depth`` while
-pending, then ``done``/``total`` window counts per phase. ``pong``
-responses carry ``mono_s`` (the server's ``time.perf_counter``), the
-clock-handshake sample clients RTT-bracket to merge client- and
-server-side spans onto one timeline.
+onto its spans, journal lines and interleaved frames; a ``tenant`` id
+(same charset) naming the fair-scheduling bucket the job bills to;
+``"progress": true``, which makes the server INTERLEAVE ``progress``
+frames on the submitting connection before the final
+``result``/``error`` frame — ``{"type": "progress", "job_id", "seq",
+"phase", ...}`` with monotonically increasing ``seq``, queue
+``position``/``depth`` while pending, then ``done``/``total`` window
+counts per phase; and ``"stream": true``, which makes the server send
+each polished contig as a ``{"type": "result_part", "job_id", "part",
+"name", "fasta"}`` frame the moment its windows complete — the final
+``result`` frame then carries ``streamed: true`` + ``parts`` and the
+stats WITHOUT the fasta body (the parts' concatenation IS the body,
+byte-identical to the buffered path). ``pong`` responses carry
+``mono_s`` (the server's ``time.perf_counter``), the clock-handshake
+sample clients RTT-bracket to merge client- and server-side spans onto
+one timeline.
 """
 
 from __future__ import annotations

@@ -1,4 +1,4 @@
-"""Bounded job queue with admission control for the polishing service.
+"""Bounded job queue with admission control and per-tenant fairness.
 
 The admission surface is where a warm server defends itself: a queue
 that grows without bound converts overload into unbounded latency for
@@ -8,9 +8,26 @@ the client backs off instead of camping on a socket. The hint is derived
 from observed service time (EMA) times the work ahead of the would-be
 job, so it tracks the actual drain rate rather than a constant.
 
-Ordering is FIFO within priority: higher `priority` pops first, equal
-priorities pop in submission order (a monotonic sequence number breaks
-heap ties, so starvation within a priority class is impossible).
+Ordering is WEIGHTED FAIR within priority: higher `priority` classes
+pop first; within a class, jobs are grouped by the submit frame's
+`tenant` id and served by weighted deficit round-robin — each active
+tenant accrues `weight` credits per scheduler rotation and spends one
+per popped job, so a tenant with weight 4 gets ~4x the pop rate of a
+weight-1 tenant UNDER CONTENTION while an uncontended queue stays pure
+FIFO (a single tenant's jobs pop in submission order, and an absent
+tenant accrues nothing — credit never banks across idle periods). This
+is what keeps one heavy client from monopolizing the continuous
+batcher's feeder: the light tenant's next job is at most ~weight pops
+away regardless of how deep the heavy tenant's backlog is. Weights come
+from the server config (`RACON_TPU_SERVE_TENANT_WEIGHTS`, e.g.
+"gold=4,free=1,default=1"); unknown tenants get the `default` weight
+(1.0). Jobs without a tenant id share the "" tenant. TRUST BOUNDARY:
+tenant ids are client-asserted and unauthenticated — fairness is
+meaningful among COOPERATING clients (the localhost/unix-socket
+deployment shape this server targets); an adversarial client minting a
+fresh tenant per job gets one DRR slot per job, so binding tenant
+identity to an authenticated transport is a deployment concern, not
+this queue's.
 
 Per-job deadlines are enforced at POP time: a job whose deadline passed
 while queued is never handed to a worker — it is marked expired, its
@@ -33,8 +50,6 @@ queue also observes every popped job's queue wait (`job.queue_wait`).
 
 from __future__ import annotations
 
-import heapq
-import itertools
 import math
 import threading
 import time
@@ -72,16 +87,60 @@ class DeadlineExpired(Exception):
         self.waited = waited
 
 
+class DeliveryQueue:
+    """Single-consumer handoff queue with a completion flag — the one
+    shape both the job outbox (progress/result_part frames -> handler
+    thread) and the batcher's window delivery (finished windows -> job
+    thread) need. The wakeup discipline lives HERE, once:
+
+      - `push` notifies under the cv;
+      - `finish` sets `event` and notifies under the cv — a bare
+        event.set() would strand a consumer mid-timed-wait;
+      - `take` never starts a timed wait once `event` is set (the
+        set happens-before the check, so a consumer that was busy
+        when `finish`'s notify fired — the dropped-notify case —
+        still returns immediately instead of burning its timeout:
+        a silent per-job latency floor otherwise)."""
+
+    __slots__ = ("_items", "_cv", "event")
+
+    def __init__(self):
+        self._items: deque = deque()
+        self._cv = threading.Condition()
+        self.event = threading.Event()
+
+    def push(self, item) -> None:
+        with self._cv:
+            self._items.append(item)
+            self._cv.notify()
+
+    def finish(self) -> None:
+        self.event.set()
+        with self._cv:
+            self._cv.notify()
+
+    def take(self, timeout: float | None = None):
+        """The oldest pending item, or None (immediately when complete
+        or `timeout` is falsy, else after waiting up to `timeout`)."""
+        with self._cv:
+            if not self._items and timeout and not self.event.is_set():
+                self._cv.wait(timeout)
+            return self._items.popleft() if self._items else None
+
+
 class Job:
     """One polish request in flight. The handler thread that admitted it
     blocks on `event`; the worker that executes it fills `response` (a
-    protocol response dict) before setting the event."""
+    protocol response dict) before setting the event. Jobs that asked
+    for live progress and/or streamed results relay frames through the
+    `_outbox` DeliveryQueue, drained by the handler thread while it
+    waits."""
 
     __slots__ = ("id", "sequences", "overlaps", "target", "options",
                  "priority", "deadline", "fault_plan", "strict",
                  "want_trace", "enqueued_t", "started_t", "response",
                  "event", "stats_ref", "trace_id", "want_progress",
-                 "_progress", "_progress_cv")
+                 "want_stream", "tenant", "_outbox")
 
     def __init__(self, id_: str, sequences: str, overlaps: str,
                  target: str, options: dict, priority: int = 0,
@@ -89,7 +148,8 @@ class Job:
                  fault_plan: str | None = None,
                  strict: bool | None = None, want_trace: bool = False,
                  trace_id: str | None = None,
-                 want_progress: bool = False):
+                 want_progress: bool = False,
+                 want_stream: bool = False, tenant: str = ""):
         self.id = id_
         self.sequences = sequences
         self.overlaps = overlaps
@@ -107,11 +167,16 @@ class Job:
         #: artifact and the server's telemetry correlate by construction
         self.trace_id = trace_id
         self.want_progress = bool(want_progress)
-        self._progress: deque = deque()
-        self._progress_cv = threading.Condition()
+        #: stream per-contig `result_part` frames before the result
+        self.want_stream = bool(want_stream)
+        #: fair-scheduling identity ("" = the anonymous shared tenant)
+        self.tenant = tenant or ""
+        self._outbox = DeliveryQueue()
         self.started_t: float | None = None
         self.response: dict | None = None
-        self.event = threading.Event()
+        #: completion flag; set it via finish() — a bare set() would
+        #: leave a handler blocked in next_frame's timed wait
+        self.event = self._outbox.event
         #: live PipelineStats of the polisher executing this job (set by
         #: the worker) — the flight-recorder dump snapshots it so a
         #: failed job's artifact carries the stage stats its spans pin to
@@ -121,45 +186,82 @@ class Job:
     def queue_wait_s(self) -> float:
         return (self.started_t or time.perf_counter()) - self.enqueued_t
 
-    # -------------------------------------------------- progress relay
+    @property
+    def relaying(self) -> bool:
+        """Whether the handler thread must pump the outbox while
+        waiting (progress frames, streamed parts, or both)."""
+        return self.want_progress or self.want_stream
+
+    # -------------------------------------------------- frame relay
     def notify_progress(self, ev: dict) -> None:
         """Queue one progress event for the handler thread streaming
-        this job's connection (server.py). Worker/pipeline threads call
-        it (via the polisher's progress hook); a no-op unless the
-        client asked for progress, so the clean path stays free."""
-        if not self.want_progress:
-            return
-        with self._progress_cv:
-            self._progress.append(ev)
-            self._progress_cv.notify()
+        this job's connection (server.py). Worker/pipeline/feeder
+        threads call it (via the polisher's progress hook); a no-op
+        unless the client asked for progress, so the clean path stays
+        free."""
+        if self.want_progress:
+            self._outbox.push(ev)
 
-    def next_progress(self, timeout: float | None = None) -> dict | None:
-        """Pop the oldest pending progress event, waiting up to
-        `timeout` for one; None when nothing arrived."""
-        with self._progress_cv:
-            if not self._progress and timeout:
-                self._progress_cv.wait(timeout)
-            return self._progress.popleft() if self._progress else None
+    def notify_part(self, frame: dict) -> None:
+        """Queue one ready-to-send `result_part` frame; a no-op unless
+        the client asked for streamed results."""
+        if self.want_stream:
+            self._outbox.push(frame)
+
+    def next_frame(self, timeout: float | None = None) -> dict | None:
+        """Pop the oldest pending outbox entry, waiting up to `timeout`
+        for one; None when nothing arrived."""
+        return self._outbox.take(timeout)
+
+    def finish(self) -> None:
+        """Mark the job complete and wake the handler immediately
+        (see DeliveryQueue: event.set() alone leaves the handler
+        burning out a timed wait before it sends the result frame)."""
+        self._outbox.finish()
+
+
+class _PriorityClass:
+    """One priority level's per-tenant queues + DRR rotation state."""
+
+    __slots__ = ("tenants", "rr", "deficit", "count")
+
+    def __init__(self):
+        self.tenants: dict[str, deque] = {}
+        self.rr: deque = deque()
+        self.deficit: dict[str, float] = {}
+        self.count = 0
 
 
 class JobQueue:
-    """Thread-safe bounded priority queue (see module docstring)."""
+    """Thread-safe bounded weighted-fair queue (see module docstring)."""
 
     #: retry_after clamp (seconds)
     RETRY_MIN, RETRY_MAX = 0.05, 60.0
     #: rolling service-time window size (jobs) behind the SLO view
     ROLLING_JOBS = 64
+    #: floor for configured weights (0/negative would stall the DRR)
+    MIN_WEIGHT = 0.01
+    #: distinct tenants tracked in the lifetime counters (tenant ids
+    #: are client-controlled: without a cap, a client minting a fresh
+    #: id per job would grow server memory and scrape cardinality
+    #: forever); overflow folds into the "~other" bucket. Scheduling
+    #: itself is unaffected — only the per-tenant accounting caps.
+    MAX_TRACKED_TENANTS = 64
 
-    def __init__(self, maxsize: int, workers: int = 1, hists=None):
+    def __init__(self, maxsize: int, workers: int = 1, hists=None,
+                 tenant_weights: dict | None = None):
         self.maxsize = max(1, int(maxsize))
         self.workers = max(1, int(workers))
+        self.tenant_weights = dict(tenant_weights or {})
         self._lock = threading.Lock()
         self._not_empty = threading.Condition(self._lock)
-        self._heap: list = []
-        self._seq = itertools.count()
+        #: priority -> _PriorityClass; scheduling pops the highest
+        #: priority first, weighted-DRR across tenants within it
+        self._classes: dict[int, _PriorityClass] = {}
+        self._count = 0
         #: bumped on every push/pop: progress streamers poll queue
         #: position while their job is pending, and the version lets
-        #: them skip the O(n log n) position() recompute (and its lock
+        #: them skip the O(depth) position() simulation (and its lock
         #: acquisition) when nothing moved
         self._version = 0
         self._draining = False
@@ -186,15 +288,35 @@ class JobQueue:
                          "rejected_draining": 0, "expired": 0,
                          "completed": 0, "failed": 0,
                          "deadline_hit": 0, "deadline_miss": 0}
+        #: per-tenant lifetime counters (admitted/completed/failed) —
+        #: the fairness story's receipt in stats/scrape
+        self.tenant_counters: dict[str, dict] = {}
+
+    def weight(self, tenant: str) -> float:
+        w = self.tenant_weights.get(
+            tenant, self.tenant_weights.get("default", 1.0))
+        try:
+            return max(float(w), self.MIN_WEIGHT)
+        except (TypeError, ValueError):
+            return 1.0
 
     # -------------------------------------------------------- admission
     def _retry_after_locked(self) -> float:
         """Backoff for a rejected submit (caller holds the lock):
         estimated time until a slot frees = work ahead / drain rate,
         from the service-time EMA."""
-        est = (self._ema_service_s * max(1, len(self._heap))
+        est = (self._ema_service_s * max(1, self._count)
                / self.workers)
         return min(max(est, self.RETRY_MIN), self.RETRY_MAX)
+
+    def _tenant_counter_locked(self, tenant: str) -> dict:
+        if (tenant not in self.tenant_counters
+                and len(self.tenant_counters)
+                >= self.MAX_TRACKED_TENANTS):
+            tenant = "~other"
+        return self.tenant_counters.setdefault(
+            tenant, {"admitted": 0, "completed": 0, "failed": 0,
+                     "expired": 0})
 
     def submit(self, job: Job) -> None:
         with self._lock:
@@ -202,22 +324,86 @@ class JobQueue:
             if self._draining:
                 self.counters["rejected_draining"] += 1
                 raise Draining()
-            if len(self._heap) >= self.maxsize:
+            if self._count >= self.maxsize:
                 self.counters["rejected_full"] += 1
                 raise QueueFull(self._retry_after_locked())
             self.counters["admitted"] += 1
-            heapq.heappush(self._heap,
-                           (-job.priority, next(self._seq), job))
+            self._tenant_counter_locked(job.tenant)["admitted"] += 1
+            cls = self._classes.setdefault(job.priority,
+                                           _PriorityClass())
+            q = cls.tenants.get(job.tenant)
+            if q is None:
+                # a (re)joining tenant starts with zero credit: absence
+                # banks nothing
+                q = cls.tenants[job.tenant] = deque()
+                cls.rr.append(job.tenant)
+                cls.deficit[job.tenant] = 0.0
+            q.append(job)
+            cls.count += 1
+            self._count += 1
             self._version += 1
             # fired UNDER the lock deliberately: a worker can pop this
             # job the instant the lock releases, and the journal's
             # `admitted` line must happen-before its `started` line.
             # The on_event contract keeps under-lock callbacks disk-
             # free (the server STAGES this event; see its sink)
-            self._notify("admitted", job, depth=len(self._heap))
+            self._notify("admitted", job, depth=self._count)
             self._not_empty.notify()
 
     # ------------------------------------------------------------- pop
+    @staticmethod
+    def _retire_tenant(tenants: dict, rr: deque, deficit: dict,
+                       tenant: str) -> None:
+        try:
+            rr.remove(tenant)
+        except ValueError:
+            pass
+        tenants.pop(tenant, None)
+        deficit.pop(tenant, None)
+
+    def _drr_select(self, tenants: dict, rr: deque,
+                    deficit: dict) -> str:
+        """ONE weighted-DRR decision over a (tenants, rr, deficit)
+        state triple: retire drained tenants, rotate accruing credit,
+        return the tenant to serve (its deficit already debited). The
+        SINGLE copy of the scheduling algorithm — the live pop path
+        passes the class's state, position()'s simulation passes a
+        copy, so the two can never diverge. Precondition: at least one
+        tenant has a job. Terminates: every full rotation adds at
+        least MIN_WEIGHT to some non-empty tenant's deficit."""
+        while True:
+            tenant = rr[0]
+            q = tenants.get(tenant)
+            if not q:
+                self._retire_tenant(tenants, rr, deficit, tenant)
+                continue
+            if deficit.get(tenant, 0.0) >= 1.0:
+                deficit[tenant] -= 1.0
+                return tenant
+            deficit[tenant] = (deficit.get(tenant, 0.0)
+                               + self.weight(tenant))
+            rr.rotate(-1)
+
+    def _pop_next_locked(self) -> Job | None:
+        """One scheduling decision (caller holds the lock); None when
+        empty: highest non-empty priority class, weighted DRR across
+        its tenants."""
+        if self._count == 0:
+            return None
+        prio = max(p for p, c in self._classes.items() if c.count > 0)
+        cls = self._classes[prio]
+        tenant = self._drr_select(cls.tenants, cls.rr, cls.deficit)
+        q = cls.tenants[tenant]
+        job = q.popleft()
+        cls.count -= 1
+        self._count -= 1
+        if not q:
+            self._retire_tenant(cls.tenants, cls.rr, cls.deficit,
+                                tenant)
+        if cls.count == 0:
+            del self._classes[prio]
+        return job
+
     def pop(self, timeout: float | None = None) -> Job | None:
         """Next runnable job, or None on timeout. Deadline-expired jobs
         are consumed here: their waiters get a typed error and workers
@@ -227,19 +413,25 @@ class JobQueue:
         popped: Job | None = None
         with self._not_empty:
             while popped is None:
-                while self._heap:
-                    _, _, job = heapq.heappop(self._heap)
+                while self._count:
+                    job = self._pop_next_locked()
+                    if job is None:
+                        break
                     self._version += 1
                     now = time.perf_counter()
                     if job.deadline is not None and now > job.deadline:
                         self.counters["expired"] += 1
+                        # the tenant's ledger must balance: admitted ==
+                        # completed + failed + expired + queued
+                        self._tenant_counter_locked(job.tenant)[
+                            "expired"] += 1
                         exc = DeadlineExpired(now - job.enqueued_t)
                         job.response = {
                             "type": "error", "code": "deadline-expired",
                             "message": str(exc), "job_id": job.id}
                         self._notify("expired", job,
                                      waited_s=round(exc.waited, 4))
-                        job.event.set()
+                        job.finish()
                         continue
                     job.started_t = now
                     if self.hists is not None:
@@ -252,7 +444,7 @@ class JobQueue:
                 if deadline is not None:
                     left = deadline - time.monotonic()
                     if left <= 0 or not self._not_empty.wait(left):
-                        if not self._heap:
+                        if not self._count:
                             return None
                 else:
                     self._not_empty.wait()
@@ -273,6 +465,8 @@ class JobQueue:
                   and time.perf_counter() > job.deadline)
         with self._lock:
             self.counters["completed" if ok else "failed"] += 1
+            self._tenant_counter_locked(job.tenant)[
+                "completed" if ok else "failed"] += 1
             if job.deadline is not None:
                 self.counters["deadline_miss" if missed
                               else "deadline_hit"] += 1
@@ -300,15 +494,37 @@ class JobQueue:
         with self._lock:
             return self._version
 
+    def _simulated_order_locked(self) -> list[Job]:
+        """Predicted pop order of every queued job — the SAME
+        `_drr_select` the live pop path runs, over copied state (caller
+        holds the lock; O(depth) with the queue's bounded depth)."""
+        order: list[Job] = []
+        sim = {}
+        for prio, cls in self._classes.items():
+            if cls.count:
+                sim[prio] = (dict((t, deque(q))
+                                  for t, q in cls.tenants.items() if q),
+                             deque(cls.rr), dict(cls.deficit))
+        while sim:
+            prio = max(sim)
+            tenants, rr, deficit = sim[prio]
+            if not tenants:
+                del sim[prio]
+                continue
+            tenant = self._drr_select(tenants, rr, deficit)
+            q = tenants[tenant]
+            order.append(q.popleft())
+            if not q:
+                self._retire_tenant(tenants, rr, deficit, tenant)
+        return order
+
     def position(self, job: Job) -> int | None:
         """0-based count of queued jobs that would pop before `job`, or
         None once the job is no longer queued (started / expired) — the
         live queue-position number the progress stream reports while a
         job is pending."""
         with self._lock:
-            # heap entries sort exactly in pop order: (-priority, seq)
-            # is unique, so the job object itself is never compared
-            for i, (_, _, j) in enumerate(sorted(self._heap)):
+            for i, j in enumerate(self._simulated_order_locked()):
                 if j is job:
                     return i
         return None
@@ -327,20 +543,36 @@ class JobQueue:
 
     def __len__(self) -> int:
         with self._lock:
-            return len(self._heap)
+            return self._count
+
+    def _iter_queued_locked(self):
+        for cls in self._classes.values():
+            for q in cls.tenants.values():
+                yield from q
 
     def snapshot(self) -> dict:
         with self._lock:
             recent = sorted(self._recent)
-            oldest = min((j.enqueued_t for _, _, j in self._heap),
-                         default=None)
-            out = dict(self.counters, depth=len(self._heap),
+            queued = list(self._iter_queued_locked())
+            oldest = min((j.enqueued_t for j in queued), default=None)
+            tenants: dict[str, dict] = {}
+            for t, c in self.tenant_counters.items():
+                tenants[t] = dict(c, weight=self.weight(t), queued=0)
+            for j in queued:
+                tenants.setdefault(
+                    j.tenant, {"admitted": 0, "completed": 0,
+                               "failed": 0, "expired": 0,
+                               "weight": self.weight(j.tenant),
+                               "queued": 0})
+                tenants[j.tenant]["queued"] += 1
+            out = dict(self.counters, depth=self._count,
                        maxsize=self.maxsize,
                        draining=self._draining,
                        oldest_wait_s=(
                            round(time.perf_counter() - oldest, 4)
                            if oldest is not None else 0.0),
-                       ema_service_s=round(self._ema_service_s, 4))
+                       ema_service_s=round(self._ema_service_s, 4),
+                       tenants=tenants)
         if recent:
             n = len(recent)
             out["recent"] = {

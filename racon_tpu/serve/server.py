@@ -14,8 +14,15 @@ process alive and multiplexes many polish requests through it:
   - requests flow through a bounded `JobQueue` (admission control with
     retry-after, FIFO-within-priority, per-job deadlines) to a small
     worker pool;
-  - concurrent jobs' windows merge into shared device batches via the
-    cross-job `WindowBatcher` (byte-identical per-job output);
+  - concurrent jobs' windows pool into the continuous `WindowBatcher`:
+    a persistent device feeder packs bounded shape-homogeneous
+    iterations, so late arrivals join the next dispatch instead of a
+    round barrier (byte-identical per-job output), finished contigs
+    stitch immediately and can stream to the client as `result_part`
+    frames before the job completes;
+  - per-tenant weighted fair scheduling on the queue (submit frames
+    carry a `tenant` id; RACON_TPU_SERVE_TENANT_WEIGHTS) keeps one
+    heavy client from monopolizing the feeder;
   - SIGTERM (or a `shutdown` request) triggers graceful drain: stop
     admitting, finish in-flight jobs, flush metrics/trace, exit;
   - per-job failure isolation: a job's `DeviceError` / quarantine storm
@@ -87,6 +94,56 @@ def _env_float(name: str, default: float) -> float:
         return default
 
 
+def _deprecated_knob(name: str, what: str) -> None:
+    """Round-barrier-era knobs are deprecated loudly, never silently
+    ignored: a Python warning for programmatic users plus a stderr line
+    for operators."""
+    import warnings
+
+    warnings.warn(f"{name} is deprecated since the continuous-batching "
+                  f"rework: {what}", DeprecationWarning, stacklevel=3)
+    log_info(f"[racon_tpu::serve] warning: {name} is deprecated "
+             f"({what})")
+
+
+def _parse_tenant_weights(raw) -> dict:
+    """Tenant weight table from a dict or a "a=4,b=1,default=1" string.
+    Strict: malformed entries fail ServeConfig (startup), mirroring the
+    --metrics-port discipline."""
+    if not raw:
+        return {}
+    if isinstance(raw, dict):
+        items = raw.items()
+    else:
+        items = []
+        for part in str(raw).split(","):
+            part = part.strip()
+            if not part:
+                continue
+            if "=" not in part:
+                raise RaconError(
+                    "ServeConfig",
+                    f"invalid tenant weight entry {part!r} "
+                    "(expected tenant=weight)")
+            items.append(part.split("=", 1))
+    out: dict = {}
+    for tenant, weight in items:
+        try:
+            w = float(weight)
+        except (TypeError, ValueError):
+            raise RaconError(
+                "ServeConfig",
+                f"invalid tenant weight {weight!r} for tenant "
+                f"{tenant!r} (expected a number)") from None
+        if w <= 0:
+            raise RaconError(
+                "ServeConfig",
+                f"tenant weight for {tenant!r} must be positive, "
+                f"got {w}")
+        out[str(tenant)] = w
+    return out
+
+
 class ServeConfig:
     """Server posture: transport, capacity, and the polish defaults jobs
     inherit when their request omits an option. Every field defaults
@@ -108,10 +165,56 @@ class ServeConfig:
             "queue_depth", _env_int("RACON_TPU_SERVE_QUEUE_DEPTH", 16)))
         self.drain_timeout_s = kw.pop(
             "drain_timeout_s", _env_float("RACON_TPU_SERVE_DRAIN_S", 30.0))
-        self.gather_window_s = kw.pop(
-            "gather_window_s",
-            _env_float("RACON_TPU_SERVE_GATHER_MS", 50.0) / 1000.0)
-        self.min_gather = max(1, kw.pop("min_gather", 2))
+        # continuous-batching feeder knobs (serve/batcher.py):
+        # iteration_windows bounds one device iteration's batch,
+        # max_wait_s optionally lets a sparse pool coalesce briefly
+        # before a short iteration (0 = dispatch the moment work is
+        # pending — the default; there is no round gather anymore)
+        self.iteration_windows = max(1, kw.pop(
+            "iteration_windows",
+            _env_int("RACON_TPU_SERVE_ITERATION_WINDOWS", 256)))
+        explicit_max_wait = "max_wait_s" in kw
+        self.max_wait_s = max(0.0, kw.pop(
+            "max_wait_s",
+            _env_float("RACON_TPU_SERVE_MAX_WAIT_MS", 0.0) / 1000.0))
+        # deprecated round-barrier knobs: the gather window aliases to
+        # the feeder's coalescing wait, min_gather has no continuous
+        # equivalent — both warn, neither is silently ignored
+        explicit_gather = "gather_window_s" in kw
+        if explicit_gather:
+            _deprecated_knob(
+                "gather_window_s",
+                "aliased to max_wait_s (the feeder's coalescing wait); "
+                "use max_wait_s / --max-wait-ms")
+            val = float(kw.pop("gather_window_s"))
+            # the deprecated alias must never beat the explicit NEW knob
+            if not explicit_max_wait:
+                self.max_wait_s = max(0.0, val)
+        if "min_gather" in kw:
+            _deprecated_knob(
+                "min_gather",
+                "the continuous feeder has no round to fill — the knob "
+                "is ignored")
+            kw.pop("min_gather")
+        # env fallback only when NO explicit knob (new or deprecated)
+        # was passed — an explicit argument must never lose to the
+        # environment
+        if env("RACON_TPU_SERVE_GATHER_MS") \
+                and not env("RACON_TPU_SERVE_MAX_WAIT_MS") \
+                and not explicit_max_wait and not explicit_gather:
+            _deprecated_knob(
+                "RACON_TPU_SERVE_GATHER_MS",
+                "aliased to the feeder's max wait; set "
+                "RACON_TPU_SERVE_MAX_WAIT_MS")
+            self.max_wait_s = max(
+                0.0, _env_float("RACON_TPU_SERVE_GATHER_MS", 0.0)
+                / 1000.0)
+        # per-tenant fair-scheduling weights: "gold=4,free=1,default=1"
+        # (queue.py weighted deficit round-robin); strict parse — a
+        # typo'd weights string fails the start, not the fairness
+        self.tenant_weights = _parse_tenant_weights(kw.pop(
+            "tenant_weights",
+            env("RACON_TPU_SERVE_TENANT_WEIGHTS") or None))
         self.warmup = kw.pop("warmup", True)
         self.max_frame = kw.pop("max_frame", max_frame_bytes())
         # telemetry exposition: None = no HTTP endpoint (the scrape RPC
@@ -254,15 +357,15 @@ class PolishServer:
 
             enable_compile_cache(cfg.tpu_compile_cache)
         #: server-lifetime latency histograms (obs/hist.py): job
-        #: end-to-end / queue wait / gather wait / batch rounds /
-        #: pipeline stages / compiles — the scrape RPC's distribution view
+        #: end-to-end / queue wait / device iterations / pipeline
+        #: stages / compiles — the scrape RPC's distribution view
         self.hists = HistogramSet()
         self.queue = JobQueue(cfg.queue_depth, workers=cfg.workers,
-                              hists=self.hists)
+                              hists=self.hists,
+                              tenant_weights=cfg.tenant_weights)
         self.batcher = WindowBatcher(
-            gather_window_s=cfg.gather_window_s,
-            min_gather=min(cfg.min_gather, cfg.workers))
-        self.batcher.active_hint = self._inflight_count
+            iteration_windows=cfg.iteration_windows,
+            max_wait_s=cfg.max_wait_s)
         self.batcher.hists = self.hists
         self.batcher.pipeline_stats.hists = self.hists
         self.batcher.scheduler.stats.hists = self.hists
@@ -521,6 +624,9 @@ class PolishServer:
         for t in self._threads:
             if t is not threading.current_thread():
                 t.join(timeout=2.0)
+        # in-flight jobs are done (or over budget): stop the device
+        # feeder so the process can exit without a straggler iteration
+        self.batcher.close()
         # flush observability BEFORE dropping connections: an armed
         # trace/metrics artifact must survive the shutdown
         self._flush_observability()
@@ -562,8 +668,8 @@ class PolishServer:
         q, b = snap["queue"], snap["batcher"]
         log_info(f"[racon_tpu::serve] lifetime: {q['admitted']} admitted "
                  f"({q['rejected_full']} full-queue rejects, "
-                 f"{q['expired']} expired), {b['rounds']} batch rounds "
-                 f"({b['multi_job_rounds']} cross-job), "
+                 f"{q['expired']} expired), {b['iterations']} device "
+                 f"iterations ({b['shared_iterations']} cross-job), "
                  f"{b['compiles']} compiles {b['compile_s']:.2f}s")
         metrics_path = os.environ.get("RACON_TPU_METRICS")
         if metrics_path:
@@ -702,6 +808,16 @@ class PolishServer:
             return error_response(
                 "bad-request",
                 "trace_id must be 1-64 chars of [A-Za-z0-9._-]")
+        # tenant ids ride journal lines and Prometheus-adjacent metric
+        # names — same boring charset as trace ids
+        tenant = req.get("tenant")
+        if tenant is not None and (
+                not isinstance(tenant, str)
+                or not 0 < len(tenant) <= 64
+                or not set(tenant) <= self._TRACE_ID_OK):
+            return error_response(
+                "bad-request",
+                "tenant must be 1-64 chars of [A-Za-z0-9._-]")
         fault_plan = req.get("fault_plan")
         if fault_plan:
             from ..resilience import FaultPlan
@@ -719,10 +835,13 @@ class PolishServer:
                   fault_plan=fault_plan, strict=req.get("strict"),
                   want_trace=bool(req.get("trace")),
                   trace_id=trace_id,
-                  want_progress=bool(req.get("progress")))
+                  want_progress=bool(req.get("progress")),
+                  want_stream=bool(req.get("stream")),
+                  tenant=tenant or "")
         if self.journal is not None:
             self.journal.record("received", job=job.id, trace=trace_id,
                                 priority=job.priority or None,
+                                tenant=job.tenant or None,
                                 deadline_s=req.get("deadline_s"))
         try:
             self.queue.submit(job)
@@ -743,23 +862,25 @@ class PolishServer:
         # submit lock (ordering vs `started` fixed at stage time, no
         # disk I/O behind the queue mutex); flushed below once the job
         # resolves, covering the expired-in-queue path too
-        if not job.want_progress:
+        if not job.relaying:
             job.event.wait()
         else:
-            self._stream_progress(job, conn)
+            self._stream_frames(job, conn)
         if self.journal is not None:
             self.journal.flush_staged()
         return job.response
 
-    def _stream_progress(self, job: Job, conn: socket.socket) -> dict:
-        """Forward the job's progress events as interleaved `progress`
-        frames on the submitting connection while waiting for the
-        result — including queue-position updates while the job is
-        still pending. Returns the final response for the handler to
-        send LAST, so the wire order is progress*, result. A client
-        that stops reading only loses its progress frames (the first
-        send error stops forwarding); the job itself runs to completion
-        and is accounted normally either way."""
+    def _stream_frames(self, job: Job, conn: socket.socket) -> dict:
+        """Forward the job's outbox — `progress` events and streamed
+        `result_part` frames — as interleaved frames on the submitting
+        connection while waiting for the result, including
+        queue-position updates while the job is still pending. Returns
+        the final response for the handler to send LAST, so the wire
+        order is (progress|result_part)*, result. A client that stops
+        reading only loses its interleaved frames (the first send error
+        stops forwarding); the job itself runs to completion and is
+        accounted normally either way — a mid-stream disconnect never
+        touches the feeder or any other job."""
         seq = 0
         last_pos = None
         send_ok = True
@@ -768,11 +889,17 @@ class PolishServer:
             nonlocal seq, send_ok
             if not send_ok:
                 return
-            seq += 1
-            frame = {"type": "progress", "job_id": job.id, "seq": seq}
+            if ev.get("type") == "result_part":
+                # worker-built, ready to send (carries its own `part`
+                # ordinal); only the trace context is stamped here
+                frame = ev
+            else:
+                seq += 1
+                frame = {"type": "progress", "job_id": job.id,
+                         "seq": seq}
+                frame.update(ev)
             if job.trace_id:
-                frame["trace_id"] = job.trace_id
-            frame.update(ev)
+                frame.setdefault("trace_id", job.trace_id)
             try:
                 send_frame(conn, frame)
             except (OSError, ProtocolError):
@@ -780,16 +907,16 @@ class PolishServer:
 
         last_version = None
         while True:
-            ev = job.next_progress(timeout=0.05)
+            ev = job.next_frame(timeout=0.05)
             if ev is not None:
                 push(ev)
                 continue
             if job.event.is_set():
                 break
-            # position recomputes (O(n log n) under the queue mutex)
-            # only when the queue actually moved, and not at all once
-            # the client stopped reading
-            if job.started_t is None and send_ok:
+            # position recomputes (an O(depth) DRR simulation under the
+            # queue mutex) only when the queue actually moved, and not
+            # at all once the client stopped reading
+            if job.started_t is None and send_ok and job.want_progress:
                 version = self.queue.version
                 if version != last_version:
                     last_version = version
@@ -800,7 +927,7 @@ class PolishServer:
                               "depth": len(self.queue)})
         # the worker set the event after its last notify: drain the tail
         while True:
-            ev = job.next_progress()
+            ev = job.next_frame()
             if ev is None:
                 break
             push(ev)
@@ -842,14 +969,15 @@ class PolishServer:
                 service_s = time.perf_counter() - t0
                 missed = self.queue.task_done(job, ok, service_s)
                 if self.journal is not None:
-                    rnd = ((resp.get("serve") or {}).get("batch")
-                           if ok else None) or {}
-                    if rnd:
+                    batch = ((resp.get("serve") or {}).get("batch")
+                             if ok else None) or {}
+                    if batch:
                         self.journal.record(
-                            "round", job=job.id, trace=job.trace_id,
-                            round=rnd.get("round"),
-                            jobs=rnd.get("jobs"),
-                            windows=rnd.get("windows"))
+                            "iterations", job=job.id,
+                            trace=job.trace_id,
+                            iterations=batch.get("iterations"),
+                            shared=batch.get("shared_iterations"),
+                            windows=batch.get("windows"))
                     if missed:
                         self.journal.record("deadline-miss", job=job.id,
                                             trace=job.trace_id)
@@ -876,7 +1004,7 @@ class PolishServer:
                          f"telemetry failed ({type(exc).__name__}: "
                          f"{exc})")
             finally:
-                job.event.set()
+                job.finish()
             with self._idle:
                 self._inflight -= 1
                 self._idle.notify_all()
@@ -941,18 +1069,53 @@ class PolishServer:
             if job.want_progress:
                 polisher.progress_hook = job.notify_progress
             polisher.initialize()
+            # per-contig sink: every serve job stitches incrementally
+            # through the continuous batcher, so each finished contig is
+            # journaled (`part-streamed`, the obsreport --check receipt)
+            # and — when the client asked to stream — shipped as a
+            # `result_part` frame BEFORE the job completes. The
+            # concatenation of parts is the job's full FASTA by
+            # construction (ContigStreamer emits in contig order).
+            parts: list[bytes] = []
+
+            def on_part(seq) -> None:
+                part = (b">" + seq.name.encode() + b"\n" + seq.data
+                        + b"\n")
+                parts.append(part)
+                if self.journal is not None:
+                    self.journal.record(
+                        "part-streamed", job=job.id, trace=job.trace_id,
+                        contig=seq.name.split(" ", 1)[0],
+                        part=len(parts), bytes=len(part))
+                job.notify_part({"type": "result_part",
+                                 "job_id": job.id, "part": len(parts),
+                                 "name": seq.name,
+                                 "fasta": part.decode("latin-1")})
+
             polished = polisher.polish(
                 not opts.get("include_unpolished", False),
-                batcher=self.batcher)
-        fasta = b"".join(b">" + s.name.encode() + b"\n" + s.data + b"\n"
-                         for s in polished)
+                batcher=self.batcher, on_part=on_part)
+        # the response body comes from `polished`, NOT from the parts
+        # collected in the callback: ContigStreamer swallows on_part
+        # exceptions (streaming is decoration), so a callback bug may
+        # lose a part — it must never truncate the authoritative body
+        fasta = b"".join(b">" + s.name.encode() + b"\n" + s.data
+                         + b"\n" for s in polished)
         resp = {"type": "result", "job_id": job.id,
                 "sequences": len(polished),
-                "fasta": fasta.decode("latin-1"),
                 "metrics": polisher.metrics.snapshot(),
                 "serve": {"queue_wait_s": round(job.queue_wait_s, 4),
                           "exec_s": round(time.perf_counter() - t0, 4),
-                          "batch": getattr(polisher, "serve_round", None)}}
+                          "batch": getattr(polisher, "serve_batch",
+                                           None)}}
+        if job.want_stream:
+            # the bytes already streamed as result_part frames; the
+            # final frame carries the stats, not a second copy of the
+            # assembly
+            resp["streamed"] = True
+            resp["parts"] = len(parts)
+        else:
+            resp["fasta"] = fasta.decode("latin-1")
         if job.want_trace:
             rec.complete("serve.job", t0, time.perf_counter(),
                          {"job": job.id, "trace_id": job.trace_id})
@@ -1021,10 +1184,24 @@ class PolishServer:
             "submitted", "admitted", "rejected_full",
             "rejected_draining", "expired", "completed", "failed",
             "deadline_hit", "deadline_miss")}
-        counters["serve.batch.rounds"] = b["rounds"]
-        counters["serve.batch.multi_job_rounds"] = b["multi_job_rounds"]
+        counters["serve.batch.iterations"] = b["iterations"]
+        counters["serve.batch.shared_iterations"] = \
+            b["shared_iterations"]
         counters["serve.batch.windows"] = b["windows"]
         counters["serve.compiles"] = b["compiles"]
+        # per-tenant fairness receipts. Tenant ids embed in the metric
+        # NAME, so only ids that survive Prometheus sanitization
+        # unchanged ([A-Za-z0-9_]) are exported — 'team.a' and
+        # 'team-a' would otherwise collide into one duplicated series
+        # and invalidate the whole scrape. Skipped tenants (and the
+        # anonymous "" tenant) remain fully visible in the `stats`
+        # response's tenants view.
+        for tenant, tc in (q.get("tenants") or {}).items():
+            if tenant and all(c.isalnum() or c == "_" for c in tenant):
+                counters[f"serve.tenant.{tenant}.admitted"] = \
+                    tc["admitted"]
+                counters[f"serve.tenant.{tenant}.completed"] = \
+                    tc["completed"]
         if self.journal is not None:
             counters["serve.journal.events"] = self.journal.events
             counters["serve.journal.dropped"] = self.journal.dropped
@@ -1118,9 +1295,23 @@ def serve_main(argv: list[str]) -> int:
     ap.add_argument("--drain-timeout", type=float, default=None,
                     help="graceful-drain budget in seconds "
                          "(RACON_TPU_SERVE_DRAIN_S, default 30)")
+    ap.add_argument("--max-wait-ms", type=float, default=None,
+                    help="continuous feeder: let a sparse window pool "
+                         "coalesce up to this long before a short "
+                         "device iteration (RACON_TPU_SERVE_MAX_WAIT_MS"
+                         ", default 0 — dispatch immediately)")
+    ap.add_argument("--iteration-windows", type=int, default=None,
+                    help="continuous feeder: max windows per device "
+                         "iteration — the latency quantum under load "
+                         "(RACON_TPU_SERVE_ITERATION_WINDOWS, default "
+                         "256)")
+    ap.add_argument("--tenant-weights", default=None,
+                    help="per-tenant fair-scheduling weights, e.g. "
+                         "'gold=4,free=1,default=1' "
+                         "(RACON_TPU_SERVE_TENANT_WEIGHTS)")
     ap.add_argument("--gather-ms", type=float, default=None,
-                    help="cross-job batch gather window in ms "
-                         "(RACON_TPU_SERVE_GATHER_MS, default 50)")
+                    help="DEPRECATED (round-barrier era): aliased to "
+                         "--max-wait-ms with a deprecation warning")
     ap.add_argument("--no-warmup", action="store_true",
                     help="skip the synthetic warmup job (first real "
                          "request pays the compiles)")
@@ -1192,7 +1383,14 @@ def serve_main(argv: list[str]) -> int:
         kw["queue_depth"] = args.queue_depth
     if args.drain_timeout is not None:
         kw["drain_timeout_s"] = args.drain_timeout
+    if args.max_wait_ms is not None:
+        kw["max_wait_s"] = args.max_wait_ms / 1000.0
+    if args.iteration_windows is not None:
+        kw["iteration_windows"] = args.iteration_windows
+    if args.tenant_weights is not None:
+        kw["tenant_weights"] = args.tenant_weights
     if args.gather_ms is not None:
+        # deprecated alias: ServeConfig warns and maps it to max_wait_s
         kw["gather_window_s"] = args.gather_ms / 1000.0
 
     try:

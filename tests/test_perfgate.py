@@ -56,12 +56,16 @@ def test_extract_rejects_failed_artifacts():
         perfgate.extract({"totally": "unrelated"})
 
 
-def serve_artifact(p50=0.30, miss_rate=None):
+def serve_artifact(p50=0.30, miss_rate=None, p99=None, ttfb=None):
     doc = {"mode": "serve", "warm": {"seq_p50_s": p50},
            "cold": {"p50_s": 0.41}}
     if miss_rate is not None:
         doc["slo"] = {"deadline_hit": 4, "deadline_miss": 0,
                       "expired": 0, "miss_rate": miss_rate}
+    if p99 is not None:
+        doc["warm"]["p99_s"] = p99
+    if ttfb is not None:
+        doc["warm"]["ttfb_p50_s"] = ttfb
     return doc
 
 
@@ -173,6 +177,54 @@ def test_missing_gated_slo_metric_rc2(tmp_path, capsys):
     assert perfgate.main(["--dir", str(tmp_path),
                           "--slo-miss-rate", "0.0"]) == 2
     assert "slo.miss_rate" in capsys.readouterr().err
+
+
+def test_serve_latency_tail_gated(tmp_path, capsys):
+    """p99 / ttfb_p50 gate absolutely via --p99-max / --ttfb-p50-max
+    and relatively against the prior round."""
+    write(tmp_path / "BENCH_r01.json",
+          serve_artifact(p99=2.0, ttfb=0.5))
+    # absolute bounds: pass then fail
+    assert perfgate.main(["--dir", str(tmp_path),
+                          "--ref-value", "0.30",
+                          "--p99-max", "3.0",
+                          "--ttfb-p50-max", "1.0"]) == 0
+    assert perfgate.main(["--dir", str(tmp_path),
+                          "--ref-value", "0.30",
+                          "--p99-max", "1.5"]) == 1
+    assert "warm.p99_s" in capsys.readouterr().err
+    # relative vs prior round: a 50% worse p99 fails at 10% tolerance
+    write(tmp_path / "BENCH_r02.json",
+          serve_artifact(p99=3.0, ttfb=0.5))
+    assert perfgate.main(["--dir", str(tmp_path),
+                          "--against", "auto"]) == 1
+    err = capsys.readouterr().err
+    assert "warm.p99_s" in err and "vs prior" in err
+    # within tolerance passes both tail metrics
+    write(tmp_path / "BENCH_r03.json",
+          serve_artifact(p99=3.1, ttfb=0.52))
+    assert perfgate.main(["--dir", str(tmp_path),
+                          "--against", "auto"]) == 0
+
+
+def test_missing_latency_tail_metric_rc2(tmp_path, capsys):
+    """The slo.miss_rate convention extends to the new keys: an
+    explicitly requested gate over an artifact missing the metric is a
+    broken gate naming the dotted key."""
+    write(tmp_path / "BENCH_r01.json", serve_artifact())
+    assert perfgate.main(["--dir", str(tmp_path),
+                          "--ref-value", "0.30",
+                          "--p99-max", "3.0"]) == 2
+    assert "warm.p99_s" in capsys.readouterr().err
+    assert perfgate.main(["--dir", str(tmp_path),
+                          "--ref-value", "0.30",
+                          "--ttfb-p50-max", "1.0"]) == 2
+    assert "warm.ttfb_p50_s" in capsys.readouterr().err
+    # and a bench artifact cannot satisfy a serve latency gate at all
+    write(tmp_path / "BENCH_r02.json", bench_artifact(100.0, 2.0))
+    assert perfgate.main(["--dir", str(tmp_path),
+                          "--p99-max", "3.0"]) == 2
+    assert "warm.p99_s" in capsys.readouterr().err
 
 
 def test_repo_current_artifacts_pass():

@@ -460,3 +460,29 @@ def test_sample_adaptive_vs_static_all_engines(monkeypatch):
                 continue
             assert c0 == c1
             np.testing.assert_array_equal(v0, v1)
+
+
+def test_pack_iteration_slab_contains_oldest():
+    """The continuous feeder's incremental packing: shape-sorted slab,
+    bounded by cap, always containing the oldest item."""
+    from racon_tpu.sched import pack_iteration
+
+    # (age, shape): oldest item has an extreme shape, so a naive
+    # head-of-sorted slab would miss it
+    items = [(age, shape) for age, shape in
+             [(5, 10), (6, 11), (7, 12), (0, 99), (8, 13), (9, 98)]]
+    batch, rest = pack_iteration(items, 2,
+                                 shape_key=lambda e: e[1],
+                                 age_key=lambda e: e[0])
+    assert len(batch) == 2
+    assert (0, 99) in batch  # the oldest always ships
+    # the slab is contiguous in shape order: 99's neighbour is 98
+    assert batch == [(9, 98), (0, 99)]
+    assert sorted(batch + rest) == sorted(items)
+    # cap larger than the pool: everything in one batch
+    batch, rest = pack_iteration(items, 100,
+                                 shape_key=lambda e: e[1],
+                                 age_key=lambda e: e[0])
+    assert len(batch) == 6 and not rest
+    assert pack_iteration([], 4, shape_key=lambda e: e,
+                          age_key=lambda e: e) == ([], [])

@@ -29,6 +29,10 @@ import time
 
 import pytest
 
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "tools"))
+
 from racon_tpu.core.polisher import PolisherType, create_polisher
 from racon_tpu.serve import (PolishClient, PolishServer, WindowBatcher,
                              make_synth_dataset)
@@ -61,8 +65,7 @@ def solo_bytes(dataset):
 @pytest.fixture(scope="module")
 def server(dataset, tmp_path_factory):
     sock = str(tmp_path_factory.mktemp("serve_sock") / "s.sock")
-    srv = PolishServer(socket_path=sock, workers=2,
-                       gather_window_s=0.2).start()
+    srv = PolishServer(socket_path=sock, workers=2).start()
     yield srv
     srv.drain(timeout=10)
 
@@ -218,44 +221,82 @@ def test_queue_drain_stops_admission():
     assert q.counters["rejected_draining"] == 1
 
 
-# --------------------------------------------------- cross-job batching
-def test_cross_job_batch_byte_identical(dataset, solo_bytes,
-                                        tmp_path_factory):
-    """Two concurrent jobs merged into ONE engine pass produce exactly
-    the solo-run bytes each. min_gather=2 with no concurrency hint makes
-    the merge deterministic: the leader waits until the second job
-    joins."""
-    sock = str(tmp_path_factory.mktemp("merge") / "s.sock")
-    srv = PolishServer(socket_path=sock, workers=2, min_gather=2,
-                       gather_window_s=10.0, warmup=False).start()
-    srv.batcher.active_hint = None  # always wait for the joiner
+# ----------------------------------------------- continuous batching
+def _pool_jobs(srv, cl, dataset, n, admitted_before=0, **submit_kw):
+    """Submit `n` jobs with the feeder HELD so all their windows pool,
+    then release — every job's windows share the next iteration(s).
+    Returns the joined results."""
+    srv.batcher.hold()
     try:
-        cl = PolishClient(socket_path=sock)
-        results = [None, None]
+        results = [None] * n
 
         def go(i):
-            results[i] = cl.submit(*dataset)
+            results[i] = cl.submit(*dataset, **submit_kw)
 
         threads = [threading.Thread(target=go, args=(i,))
-                   for i in range(2)]
+                   for i in range(n)]
         for t in threads:
             t.start()
-        for t in threads:
-            t.join(timeout=60)
+        deadline = time.monotonic() + 30
+        while (srv.queue.counters["admitted"] < admitted_before + n
+               and time.monotonic() < deadline):
+            time.sleep(0.005)
+        # admitted != pooled: give the workers a beat to run initialize
+        # and enqueue their windows behind the held feeder
+        time.sleep(0.5)
+    finally:
+        srv.batcher.release()
+    for t in threads:
+        t.join(timeout=60)
+    return results
+
+
+def test_cross_job_iteration_byte_identical(dataset, solo_bytes,
+                                            tmp_path_factory):
+    """Two concurrent jobs' windows merged into SHARED device
+    iterations produce exactly the solo-run bytes each (the feeder is
+    held until both jobs pooled, making the merge deterministic)."""
+    sock = str(tmp_path_factory.mktemp("merge") / "s.sock")
+    srv = PolishServer(socket_path=sock, workers=2,
+                       warmup=False).start()
+    try:
+        cl = PolishClient(socket_path=sock)
+        results = _pool_jobs(srv, cl, dataset, 2)
         for r in results:
             assert r is not None
             assert r.fasta == solo_bytes
-            assert r.serve["batch"]["jobs"] == 2
+            assert r.serve["batch"]["shared_iterations"] >= 1
             assert not r.serve["batch"]["solo"]
-        assert srv.batcher.counters["multi_job_rounds"] == 1
+        assert srv.batcher.counters["shared_iterations"] >= 1
+        assert srv.batcher.counters["max_jobs_in_iteration"] == 2
+    finally:
+        srv.drain(timeout=10)
+
+
+def test_late_job_joins_next_iteration_not_a_round(dataset, solo_bytes,
+                                                   tmp_path_factory):
+    """The round barrier is gone: with a small iteration bound, one
+    job's windows spread over SEVERAL iterations — the continuous
+    feeder dispatches bounded batches instead of one all-or-nothing
+    round, which is exactly what lets a late job join mid-flight."""
+    sock = str(tmp_path_factory.mktemp("iter") / "s.sock")
+    srv = PolishServer(socket_path=sock, workers=2, warmup=False,
+                       iteration_windows=2).start()
+    try:
+        cl = PolishClient(socket_path=sock)
+        r = cl.submit(*dataset)
+        assert r.fasta == solo_bytes
+        assert r.serve["batch"]["iterations"] >= 2
+        assert len(r.serve["batch"]["iteration_ids"]) == \
+            r.serve["batch"]["iterations"]
     finally:
         srv.drain(timeout=10)
 
 
 def test_batcher_mixed_params_do_not_merge(dataset):
-    """Jobs whose engine parameters differ must not share a pass — and
-    both must still match their own solo bytes."""
-    batcher = WindowBatcher(gather_window_s=0.3, min_gather=2)
+    """Jobs whose engine parameters differ must not share an iteration
+    — and both must still match their own solo bytes."""
+    batcher = WindowBatcher()
 
     def build(match):
         p = create_polisher(*dataset, PolisherType.kC, 500, 10.0, 0.3,
@@ -264,15 +305,19 @@ def test_batcher_mixed_params_do_not_merge(dataset):
         return p
 
     pa, pb = build(3), build(5)
+    batcher.hold()
     ta = threading.Thread(target=batcher.consensus, args=(pa,))
     tb = threading.Thread(target=batcher.consensus, args=(pb,))
     ta.start()
     tb.start()
+    time.sleep(0.3)  # both jobs' windows pooled under different keys
+    batcher.release()
     ta.join(60)
     tb.join(60)
-    assert pa.serve_round["jobs"] == 1
-    assert pb.serve_round["jobs"] == 1
-    assert batcher.counters["rounds"] == 2
+    assert pa.serve_batch["shared_iterations"] == 0
+    assert pb.serve_batch["shared_iterations"] == 0
+    assert batcher.counters["iterations"] == 2
+    assert batcher.counters["max_jobs_in_iteration"] == 1
     out_a = b"".join(b">" + s.name.encode() + b"\n" + s.data + b"\n"
                      for s in pa._stitch(True))
     out_b = b"".join(b">" + s.name.encode() + b"\n" + s.data + b"\n"
@@ -280,6 +325,19 @@ def test_batcher_mixed_params_do_not_merge(dataset):
     assert out_a == polish_solo(dataset)
     assert out_b == polish_solo(dataset, match=5)
     assert out_a != out_b  # the scores genuinely differ on this input
+    batcher.close()
+
+
+def test_deprecated_round_knobs_warn_and_alias():
+    """gather_window_s aliases to max_wait_s, min_gather is refused
+    loudly — neither is a silent ignore."""
+    from racon_tpu.serve import ServeConfig
+
+    with pytest.warns(DeprecationWarning, match="gather_window_s"):
+        cfg = ServeConfig(gather_window_s=0.25)
+    assert cfg.max_wait_s == 0.25
+    with pytest.warns(DeprecationWarning, match="min_gather"):
+        ServeConfig(min_gather=4)
 
 
 # ------------------------------------------------------------ end to end
@@ -313,8 +371,8 @@ def test_poisoned_job_fails_typed_server_survives(client, dataset,
         client.submit(*dataset, fault_plan="device:chunk=0:raise",
                       strict=True, options={"tpu_aligner_batches": 1})
     assert exc_info.value.error_type == "DeviceError"
-    # consensus-phase poison (host loop pack stage; solo round)
-    solo_before = server.batcher.counters["solo_rounds"]
+    # consensus-phase poison (host loop pack stage; isolation iteration)
+    solo_before = server.batcher.counters["solo_iterations"]
     with pytest.raises(JobFailed) as exc_info:
         client.submit(*dataset, fault_plan="pack:chunk=0:raise",
                       strict=True)
@@ -322,7 +380,7 @@ def test_poisoned_job_fails_typed_server_survives(client, dataset,
     # the server survives and the next clean job is byte-identical
     assert client.submit(*dataset).fasta == solo_bytes
     assert client.ping()["type"] == "pong"
-    assert server.batcher.counters["solo_rounds"] >= solo_before
+    assert server.batcher.counters["solo_iterations"] >= solo_before
 
 
 def test_unpoisoned_fault_plan_degrades_within_job(client, dataset,
@@ -371,8 +429,7 @@ def test_concurrent_traced_jobs_restore_tracer(client, dataset):
 def test_tcp_ephemeral_port(dataset, solo_bytes):
     """--port 0 means ephemeral localhost TCP (not the unix socket);
     the bound port is published and serves byte-identical results."""
-    srv = PolishServer(port=0, warmup=False,
-                       gather_window_s=0.0).start()
+    srv = PolishServer(port=0, warmup=False).start()
     try:
         assert srv.config.port > 0
         cl = PolishClient(port=srv.config.port)
@@ -446,8 +503,8 @@ def test_oversized_frame_typed_error(dataset, tmp_path_factory):
 def test_drain_finishes_inflight_then_rejects(dataset, solo_bytes,
                                               tmp_path_factory):
     sock = str(tmp_path_factory.mktemp("drain") / "s.sock")
-    srv = PolishServer(socket_path=sock, workers=1, warmup=False,
-                       gather_window_s=0.0).start()
+    srv = PolishServer(socket_path=sock, workers=1,
+                       warmup=False).start()
     cl = PolishClient(socket_path=sock)
     result: list = [None]
 
@@ -578,7 +635,6 @@ def test_polisher_run_counters_reset_between_jobs(dataset):
 def _serve_pair(tmp_path_factory, transport, **kw):
     """A (server, client) pair on the requested transport."""
     kw.setdefault("warmup", False)
-    kw.setdefault("gather_window_s", 0.0)
     if transport == "tcp":
         srv = PolishServer(port=0, **kw).start()
         return srv, PolishClient(port=srv.config.port)
@@ -659,12 +715,11 @@ def test_progress_queue_position_while_pending(dataset,
 
 def test_concurrent_jobs_no_progress_bleed(dataset, solo_bytes,
                                            tmp_path_factory):
-    """Two concurrent progress-streaming jobs merged into ONE shared
-    device round: each stream carries only its own job id and trace id,
+    """Two concurrent progress-streaming jobs merged into SHARED device
+    iterations: each stream carries only its own job id and trace id,
     both outputs stay byte-identical."""
-    srv, cl = _serve_pair(tmp_path_factory, "unix", workers=2,
-                          min_gather=2, gather_window_s=10.0)
-    srv.batcher.active_hint = None  # always wait for the joiner
+    srv, cl = _serve_pair(tmp_path_factory, "unix", workers=2)
+    srv.batcher.hold()
     try:
         evs: list = [[], []]
         results: list = [None, None]
@@ -677,11 +732,18 @@ def test_concurrent_jobs_no_progress_bleed(dataset, solo_bytes,
                    for i in range(2)]
         for t in threads:
             t.start()
+        deadline = time.monotonic() + 30
+        while (srv.queue.counters["admitted"] < 2
+               and time.monotonic() < deadline):
+            time.sleep(0.005)
+        time.sleep(0.5)  # both jobs' windows pooled behind the hold
+        srv.batcher.release()
         for t in threads:
             t.join(timeout=60)
         assert results[0] is not None and results[1] is not None
         assert results[0].job_id != results[1].job_id
-        assert results[0].serve["batch"]["jobs"] == 2  # truly shared
+        # truly shared iterations
+        assert results[0].serve["batch"]["shared_iterations"] >= 1
         for i in (0, 1):
             assert results[i].fasta == solo_bytes
             assert evs[i], f"job {i} saw no progress"
@@ -738,16 +800,18 @@ def test_trace_out_merged_artifact(client, server, dataset, tmp_path):
     qw = [ev for ev in doc["traceEvents"]
           if ev.get("name") == "serve.queue_wait"]
     assert len(qw) == 1 and qw[0]["args"]["trace_id"] == tid
-    # span-duration pin: the batch-round span and the job's round
-    # telemetry are recorded from the same perf_counter endpoints
+    # span-duration pin: the job's iteration spans and its batch
+    # telemetry are recorded from the same perf_counter endpoints —
+    # the spans for the iterations this job rode sum to its device_s
     batch = result.serve["batch"]
-    rounds = [ev for ev in doc["traceEvents"]
-              if ev.get("name") == "serve.batch_round"
-              and ev.get("args", {}).get("round") == batch["round"]]
-    assert len(rounds) == 1
-    assert rounds[0]["dur"] / 1e6 == pytest.approx(
-        batch["round_s"], rel=0.05, abs=1e-3)
-    assert tid in rounds[0]["args"]["trace_ids"]
+    iters = [ev for ev in doc["traceEvents"]
+             if ev.get("name") == "serve.iteration"
+             and ev.get("args", {}).get("iteration")
+             in batch["iteration_ids"]]
+    assert len(iters) == batch["iterations"] >= 1
+    assert sum(ev["dur"] for ev in iters) / 1e6 == pytest.approx(
+        batch["device_s"], rel=0.05, abs=1e-3)
+    assert all(tid in ev["args"]["trace_ids"] for ev in iters)
     # and the ordinary result is untouched
     assert result.fasta
 
@@ -790,6 +854,277 @@ def test_trace_and_progress_over_tcp(dataset, solo_bytes,
         assert all(ev["pid"] == 1 for ev in instants)
     finally:
         srv.drain(timeout=10)
+
+
+# --------------------------------------------- per-tenant fair scheduling
+def _tjob(i, tenant, priority=0):
+    return Job(f"{tenant}{i}", "s", "o", "t", {}, priority=priority,
+               tenant=tenant)
+
+
+def test_queue_drr_equal_weights_interleave():
+    """A flooding tenant and a late light tenant with equal weights pop
+    round-robin: the light tenant's first job is at most a couple of
+    pops away, not behind the whole flood."""
+    q = JobQueue(maxsize=32)
+    for i in range(6):
+        q.submit(_tjob(i, "heavy"))
+    for i in range(2):
+        q.submit(_tjob(i, "light"))
+    assert q.position(q._classes[0].tenants["light"][0]) <= 3
+    order = [q.pop(timeout=0.1).id for _ in range(8)]
+    assert order.index("light0") <= 3
+    assert order.index("light1") <= 5
+    # FIFO within each tenant
+    heavy_order = [j for j in order if j.startswith("heavy")]
+    assert heavy_order == sorted(heavy_order)
+
+
+def test_queue_drr_weighted_ratio():
+    """A weight-3 tenant gets ~3 pops per rotation against a weight-1
+    flood."""
+    q = JobQueue(maxsize=32,
+                 tenant_weights={"heavy": 1, "gold": 3})
+    for i in range(6):
+        q.submit(_tjob(i, "heavy"))
+    for i in range(3):
+        q.submit(_tjob(i, "gold"))
+    order = [q.pop(timeout=0.1).id for _ in range(9)]
+    # all three gold jobs pop within the first four slots
+    assert {j for j in order[:4] if j.startswith("gold")} == \
+        {"gold0", "gold1", "gold2"}
+
+
+def test_queue_drr_priority_beats_weight():
+    """Priority classes stay absolute: a higher-priority job pops
+    before any lower-priority tenant regardless of weights."""
+    q = JobQueue(maxsize=32, tenant_weights={"vip": 100})
+    q.submit(_tjob(0, "vip", priority=0))
+    q.submit(_tjob(0, "urgent", priority=5))
+    assert q.pop(timeout=0.1).id == "urgent0"
+    assert q.pop(timeout=0.1).id == "vip0"
+
+
+def test_queue_single_tenant_stays_fifo():
+    q = JobQueue(maxsize=8)
+    for i in range(4):
+        q.submit(_tjob(i, ""))
+    assert [q.pop(timeout=0.1).id for _ in range(4)] == \
+        ["0", "1", "2", "3"]
+
+
+def test_tenant_fairness_light_tenant_bounded(dataset,
+                                              tmp_path_factory):
+    """The saturation-wave gate: one worker, a heavy tenant floods the
+    queue, a light (weighted) tenant submits after — the light job must
+    complete ahead of most of the heavy backlog, i.e. its latency is
+    bounded by ~one job, not by the flood."""
+    srv, cl = _serve_pair(tmp_path_factory, "unix", workers=1,
+                          queue_depth=16,
+                          tenant_weights={"light": 4, "heavy": 1})
+    try:
+        done_order: list = []
+        threads = []
+
+        def go(tenant, i, **kw):
+            cl.submit(*dataset, tenant=tenant, **kw)
+            done_order.append(tenant)
+
+        # first heavy job hangs briefly so the rest of the flood is
+        # queued when the light tenant arrives
+        t = threading.Thread(target=go, args=("heavy", 0),
+                             kwargs={"fault_plan":
+                                     "device:chunk=0:hang=0.8"})
+        t.start()
+        threads.append(t)
+        deadline = time.monotonic() + 10
+        while (srv.queue.counters["admitted"] < 1
+               and time.monotonic() < deadline):
+            time.sleep(0.005)
+        time.sleep(0.1)  # worker popped the hanging job
+        for i in range(1, 5):
+            th = threading.Thread(target=go, args=("heavy", i))
+            th.start()
+            threads.append(th)
+        deadline = time.monotonic() + 10
+        while (srv.queue.counters["admitted"] < 5
+               and time.monotonic() < deadline):
+            time.sleep(0.005)
+        th = threading.Thread(target=go, args=("light", 0))
+        th.start()
+        threads.append(th)
+        for th in threads:
+            th.join(timeout=60)
+        assert len(done_order) == 6
+        # the light job finished ahead of most of the heavy backlog:
+        # at most the in-flight job plus one racing pop precede it
+        assert done_order.index("light") <= 2, done_order
+        snap = srv.queue.snapshot()
+        assert snap["tenants"]["light"]["completed"] == 1
+        assert snap["tenants"]["light"]["weight"] == 4.0
+    finally:
+        srv.drain(timeout=10)
+
+
+# --------------------------------------------------- streamed result parts
+def test_stream_parts_byte_identical(dataset, solo_bytes, client):
+    """`result_part` frames arrive before the result, in contig order,
+    and their concatenation is byte-identical to the buffered FASTA —
+    while the final frame carries stats but no second copy."""
+    parts: list = []
+    r = client.submit(*dataset, on_part=parts.append)
+    assert r.streamed and r.parts == len(parts) > 0
+    assert all(p["type"] == "result_part" for p in parts)
+    assert [p["part"] for p in parts] == \
+        list(range(1, len(parts) + 1))
+    concat = b"".join(p["fasta"].encode("latin-1") for p in parts)
+    assert concat == solo_bytes
+    assert r.fasta == solo_bytes  # assembled from the parts
+    # a buffered submit on the same server still carries the body
+    assert client.submit(*dataset).fasta == solo_bytes
+
+
+def test_stream_with_progress_interleaved(dataset, solo_bytes,
+                                          tmp_path_factory):
+    """Streaming composes with live progress on one connection: the
+    client sees progress frames, then each part, then the result — and
+    time-to-first-byte (first part) precedes job completion."""
+    srv, cl = _serve_pair(tmp_path_factory, "tcp")
+    try:
+        events: list = []
+        r = cl.submit(*dataset,
+                      on_progress=lambda ev: events.append(("p", ev)),
+                      on_part=lambda fr: events.append(("part", fr)))
+        assert r.fasta == solo_bytes
+        kinds = [k for k, _ in events]
+        assert "p" in kinds and "part" in kinds
+        # every part precedes the end of the stream and parts are in
+        # order
+        part_ids = [fr["part"] for k, fr in events if k == "part"]
+        assert part_ids == sorted(part_ids)
+    finally:
+        srv.drain(timeout=10)
+
+
+def test_stream_identity_under_quarantine(dataset, solo_bytes,
+                                          tmp_path_factory,
+                                          monkeypatch):
+    """Injected per-window faults (one window quarantined onto its
+    draft backbone) must not break streaming: parts still arrive in
+    order and their concatenation equals the buffered submit under the
+    SAME injection — which genuinely differs from the clean bytes."""
+    import racon_tpu.ops.poa as poa_mod
+
+    real = poa_mod.poa_batch
+    state = {"singles": 0}
+
+    def flaky(packed, *a, **kw):
+        if len(packed) > 1:
+            raise RuntimeError("chunk poisoned")  # force singles
+        state["singles"] += 1
+        if state["singles"] == 2:
+            raise RuntimeError("window poisoned")  # quarantine one
+        return real(packed, *a, **kw)
+
+    srv, cl = _serve_pair(tmp_path_factory, "unix", workers=1)
+    try:
+        monkeypatch.setattr(poa_mod, "poa_batch", flaky)
+        state["singles"] = 0
+        buffered = cl.submit(*dataset).fasta
+        state["singles"] = 0
+        parts: list = []
+        streamed = cl.submit(*dataset, on_part=parts.append)
+        assert [p["part"] for p in parts] == \
+            list(range(1, len(parts) + 1))
+        assert streamed.fasta == buffered
+        assert buffered != solo_bytes  # the quarantine really landed
+        b = srv.batcher.snapshot()
+        assert b["pipeline"]["quarantined"] >= 2
+    finally:
+        srv.drain(timeout=10)
+
+
+def test_midstream_disconnect_kills_nothing(dataset, solo_bytes,
+                                            tmp_path_factory):
+    """A streaming client that vanishes mid-job costs only its own
+    connection: the job still completes and is accounted, the feeder
+    and the next client are untouched."""
+    srv, cl = _serve_pair(tmp_path_factory, "unix", workers=2)
+    try:
+        sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        sock.settimeout(15.0)
+        sock.connect(srv.config.socket_path)
+        send_frame(sock, {"type": "submit",
+                          "sequences": dataset[0],
+                          "overlaps": dataset[1],
+                          "target": dataset[2],
+                          "progress": True, "stream": True})
+        # read ONE interleaved frame to prove the stream started, then
+        # vanish
+        first = recv_frame(sock)
+        assert first["type"] in ("progress", "result_part")
+        sock.close()
+        deadline = time.monotonic() + 30
+        while (srv.queue.counters["completed"] < 1
+               and time.monotonic() < deadline):
+            time.sleep(0.01)
+        assert srv.queue.counters["completed"] == 1
+        assert srv.queue.counters["failed"] == 0
+        # the feeder and a fresh client both still work
+        assert cl.submit(*dataset).fasta == solo_bytes
+    finally:
+        srv.drain(timeout=10)
+
+
+def test_bad_tenant_rejected(client, dataset):
+    with pytest.raises(ServeError) as exc_info:
+        client.submit(*dataset, tenant="no spaces")
+    assert exc_info.value.code == "bad-request"
+    assert "tenant" in str(exc_info.value)
+
+
+# ------------------------------------------- journal part-streamed events
+def test_journal_part_streamed_and_obsreport_check(dataset, tmp_path):
+    """Every successful serve job journals one `part-streamed` event
+    per output contig; `obsreport --check` verifies the count equals
+    the job's contig count and fails when a part line is missing."""
+    import obsreport
+    from racon_tpu.obs.journal import read_journal
+
+    journal = str(tmp_path / "journal.jsonl")
+    srv = PolishServer(socket_path=str(tmp_path / "s.sock"),
+                       warmup=False, journal=journal).start()
+    try:
+        cl = PolishClient(socket_path=srv.config.socket_path)
+        r1 = cl.submit(*dataset)
+        parts: list = []
+        r2 = cl.submit(*dataset, on_part=parts.append)
+    finally:
+        srv.drain(timeout=10)
+    entries = read_journal(journal)
+    by_job: dict = {}
+    for e in entries:
+        if e.get("event") == "part-streamed":
+            by_job.setdefault(e["job"], []).append(e)
+    assert len(by_job[r1.job_id]) == 1  # one contig in the synth set
+    assert len(by_job[r2.job_id]) == len(parts) == 1
+    assert by_job[r2.job_id][0]["contig"] == "draft"
+    rc = obsreport.main(["--journal", journal,
+                         "--flight-dir", str(tmp_path / "none"),
+                         "--check"])
+    assert rc == 0
+    # drop one part-streamed line: the check must go red
+    with open(journal) as fh:
+        lines = [ln for ln in fh]
+    kept = [ln for ln in lines
+            if not ('"part-streamed"' in ln
+                    and f'"{r2.job_id}"' in ln)]
+    assert len(kept) < len(lines)
+    with open(journal, "w") as fh:
+        fh.writelines(kept)
+    assert obsreport.main(["--journal", journal,
+                           "--flight-dir", str(tmp_path / "none"),
+                           "--check"]) == 1
 
 
 # ------------------------------------------------- TTY-aware progress bars

@@ -54,7 +54,6 @@ def dataset(tmp_path_factory):
 def server(dataset, tmp_path_factory):
     d = tmp_path_factory.mktemp("telem_srv")
     srv = PolishServer(socket_path=str(d / "s.sock"), workers=2,
-                       gather_window_s=0.0,
                        flight_dir=str(d / "flight")).start()
     yield srv
     srv.drain(timeout=10)
@@ -373,10 +372,10 @@ def test_scrape_during_running_job_nonzero_latency(client, dataset,
     # the load-bearing families are present by name
     for want in ("racon_tpu_pipeline_pack_seconds",
                  "racon_tpu_job_queue_wait_seconds",
-                 "racon_tpu_serve_round_seconds"):
+                 "racon_tpu_serve_iteration_seconds"):
         assert want in fams, sorted(hist_fams)
     assert check_histogram_family(
-        fams["racon_tpu_serve_round_seconds"]) > 0
+        fams["racon_tpu_serve_iteration_seconds"]) > 0
 
 
 def test_scrape_rpc_matches_http(dataset, tmp_path):
@@ -668,8 +667,8 @@ def test_serve_journal_lifecycle(dataset, tmp_path):
             by_job.setdefault(e["job"], []).append(e)
     assert len(by_job) == 3
     ok_events = [e["event"] for e in by_job[ok_job.job_id]]
-    assert ok_events == ["received", "admitted", "started", "round",
-                         "finished"]
+    assert ok_events == ["received", "admitted", "started",
+                         "part-streamed", "iterations", "finished"]
     # the trace id rides every line of its job
     assert all(e.get("trace") == "tid-journal"
                for e in by_job[ok_job.job_id])

@@ -16,7 +16,12 @@ terminal state, the trace id (when the client minted one), and the
 flight dump that names the job, if any. The summary counts events by
 type and runs the journal consistency check (`--check` turns problems
 into a nonzero exit — the CI shape; `tools/servebench.py` runs the same
-check inside its gate)."""
+check inside its gate). `--check` additionally verifies the streamed-
+results lifecycle: every successfully `finished` job must carry exactly
+one `part-streamed` event per output contig (the server journals one
+per stitched part — continuous batching stitches EVERY serve job
+incrementally), so a lost or duplicated part shows up as a red check,
+not a silent hole in the stream."""
 
 from __future__ import annotations
 
@@ -156,11 +161,49 @@ def main(argv=None) -> int:
              if unmatched else ""), file=out)
 
     problems = check_consistency(entries)
+    problems += check_parts_streamed(entries)
     for p in problems:
         print(f"consistency: {p}", file=out)
     print(f"consistency: {'OK' if not problems else 'FAIL'} "
           f"({len(problems)} problems)", file=out)
     return 1 if (args.check and problems) else 0
+
+
+def check_parts_streamed(entries: list[dict]) -> list[str]:
+    """Streamed-results invariant: a job that `finished` successfully
+    with N output sequences must have journaled exactly N
+    `part-streamed` events (one per stitched contig). Jobs whose
+    `finished` line predates the part-streamed era (no `sequences`
+    field) or that never finished are skipped — this is a per-job
+    receipt, not a schema migration."""
+    parts: dict[str, int] = {}
+    finished: dict[str, int] = {}
+    received: set[str] = set()
+    for e in entries:
+        job = e.get("job")
+        if not job:
+            continue
+        if e.get("event") == "received":
+            received.add(str(job))
+        elif e.get("event") == "part-streamed":
+            parts[str(job)] = parts.get(str(job), 0) + 1
+        elif e.get("event") == "finished" \
+                and isinstance(e.get("sequences"), int):
+            finished[str(job)] = e["sequences"]
+    problems: list[str] = []
+    for job, n_seqs in sorted(finished.items()):
+        if job not in received:
+            # the journal's rotation window cut this job's early
+            # events (check_consistency applies the same tolerance):
+            # its part-streamed lines may be in the discarded
+            # generation, which is history loss, not a stream bug
+            continue
+        n_parts = parts.get(job, 0)
+        if n_parts != n_seqs:
+            problems.append(
+                f"job {job}: {n_parts} part-streamed events for "
+                f"{n_seqs} output sequences")
+    return problems
 
 
 if __name__ == "__main__":

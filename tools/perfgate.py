@@ -20,11 +20,17 @@ Metric extraction understands both artifact shapes:
     bench quotients — PLUS the artifact's SLO miss rate (`slo.
     miss_rate`), gated ABSOLUTELY against `--slo-miss-rate` (default
     0.0: any deadline miss fails the gate) when the artifact carries an
-    slo view or the limit was requested explicitly.
+    slo view or the limit was requested explicitly, PLUS the
+    continuous-batching tail metrics `warm.p99_s` (wave p99) and
+    `warm.ttfb_p50_s` (time-to-first-byte p50): each gates ABSOLUTELY
+    against `--p99-max` / `--ttfb-p50-max` when requested, and
+    RELATIVELY (tolerance-pct) against the `--against` reference
+    whenever both artifacts carry the key.
 
 A missing gated metric is a BROKEN GATE, not a traceback: the error
-names the dotted key (`warm.seq_p50_s`, `slo.miss_rate`) and exits 2,
-so CI can tell "the artifact changed shape" from "perf regressed".
+names the dotted key (`warm.seq_p50_s`, `slo.miss_rate`,
+`warm.p99_s`, `warm.ttfb_p50_s`) and exits 2, so CI can tell "the
+artifact changed shape" from "perf regressed".
 
 Baseline resolution, in order:
 
@@ -116,6 +122,14 @@ def extract(doc: dict, path: str = "<artifact>") -> dict:
         miss = _lookup(inner, "slo.miss_rate")
         if miss is not None:
             out["slo_miss_rate"] = float(miss)
+        # latency-tail metrics (continuous-batching era): gated
+        # absolutely via --p99-max / --ttfb-p50-max and relatively
+        # against the --against reference when both artifacts carry them
+        for key, dotted in (("p99_s", "warm.p99_s"),
+                            ("ttfb_p50_s", "warm.ttfb_p50_s")):
+            val = _lookup(inner, dotted)
+            if val is not None:
+                out[key] = float(val)
         return out
     if inner.get("unit") == "windows/sec":
         metric = str(inner.get("metric", ""))
@@ -143,9 +157,12 @@ def find_artifacts(dirname: str) -> list[str]:
 
 
 def resolve_baseline(cand: dict, args, candidate_path: str) -> tuple:
-    """-> (reference_value, description). See module docstring."""
+    """-> (reference_value, description, reference_extract_or_None).
+    The third element is the full extract() of the reference artifact
+    when one exists (the --against paths) — the latency-tail metrics
+    gate round-over-round against it. See module docstring."""
     if args.ref_value is not None:
-        return float(args.ref_value), "explicit --ref-value"
+        return float(args.ref_value), "explicit --ref-value", None
     if args.against:
         if args.against == "auto":
             prior = [p for p in find_artifacts(args.dir)
@@ -156,25 +173,25 @@ def resolve_baseline(cand: dict, args, candidate_path: str) -> tuple:
                 except GateError:
                     continue
                 if ref["higher_better"] == cand["higher_better"]:
-                    return ref["value"], os.path.basename(path)
+                    return ref["value"], os.path.basename(path), ref
             raise GateError("--against auto: no usable prior artifact")
         ref = extract(load_artifact(args.against), args.against)
         if ref["higher_better"] != cand["higher_better"]:
             raise GateError("--against artifact measures a different "
                             "direction than the candidate")
-        return ref["value"], os.path.basename(args.against)
+        return ref["value"], os.path.basename(args.against), ref
     baseline_path = os.path.join(args.dir, "BASELINE.json")
     if os.path.isfile(baseline_path):
         published = (load_artifact(baseline_path).get("published")
                      or {})
         if published.get("windows_per_sec") and cand["higher_better"]:
             return (float(published["windows_per_sec"]),
-                    "BASELINE.json published")
+                    "BASELINE.json published", None)
     if cand.get("vs_baseline"):
         # bench.py's own comparison point: value / vs_baseline is the
         # reference-CPU windows/s every artifact is ratioed against
         return (cand["value"] / cand["vs_baseline"],
-                "reference-CPU baseline (value/vs_baseline)")
+                "reference-CPU baseline (value/vs_baseline)", None)
     raise GateError("no baseline: BASELINE.json publishes no "
                     "windows_per_sec and the artifact carries no "
                     "vs_baseline (use --ref-value or --against)")
@@ -218,6 +235,37 @@ def slo_checks(doc: dict, cand: dict, args,
     return [("slo miss-rate", cand["slo_miss_rate"], limit)]
 
 
+def latency_checks(cand: dict, ref: dict | None, args,
+                   candidate_path: str) -> list[tuple]:
+    """p99 / ttfb gates for serve artifacts: (name, value, limit,
+    kind) quadruples. Each metric gates ABSOLUTELY when its --*-max
+    limit was requested (a requested limit over an artifact missing the
+    metric is a named-key broken gate, exit 2 — the slo.miss_rate
+    convention) and RELATIVELY against the --against reference when
+    both artifacts carry it (prior-round tail-latency regression)."""
+    checks: list[tuple] = []
+    for key, dotted, limit in (
+            ("p99_s", "warm.p99_s", args.p99_max),
+            ("ttfb_p50_s", "warm.ttfb_p50_s", args.ttfb_p50_max)):
+        if limit is not None:
+            if cand["higher_better"]:
+                raise GateError(
+                    f"{candidate_path}: artifact lacks gated metric "
+                    f"'{dotted}' (bench artifacts carry no serve "
+                    "latency view)")
+            if key not in cand:
+                raise GateError(
+                    f"{candidate_path}: artifact lacks gated metric "
+                    f"'{dotted}'")
+            checks.append((dotted, cand[key], limit, "absolute"))
+        if (ref is not None and key in cand and key in ref
+                and ref[key] > 0):
+            allowed = ref[key] * (1.0 + abs(args.tolerance_pct) / 100.0)
+            checks.append((dotted, cand[key], allowed,
+                           f"vs prior {ref[key]:g}s"))
+    return checks
+
+
 def run(args) -> int:
     if args.artifact:
         candidate_path = args.artifact
@@ -228,7 +276,8 @@ def run(args) -> int:
         candidate_path = arts[-1]
     doc = load_artifact(candidate_path)
     cand = extract(doc, candidate_path)
-    reference, ref_desc = resolve_baseline(cand, args, candidate_path)
+    reference, ref_desc, ref = resolve_baseline(cand, args,
+                                                candidate_path)
     ok, delta = gate(cand["value"], reference, args.tolerance_pct,
                      cand["higher_better"])
     failures = 0 if ok else 1
@@ -245,6 +294,13 @@ def run(args) -> int:
         print(f"[perfgate] {'PASS' if check_ok else 'FAIL'}: "
               f"{os.path.basename(candidate_path)} {name} = {value:g} "
               f"(limit {limit:g})", file=sys.stderr)
+    for name, value, limit, kind in latency_checks(cand, ref, args,
+                                                   candidate_path):
+        check_ok = value <= limit
+        failures += 0 if check_ok else 1
+        print(f"[perfgate] {'PASS' if check_ok else 'FAIL'}: "
+              f"{os.path.basename(candidate_path)} {name} = {value:g}s "
+              f"(limit {limit:g}s, {kind})", file=sys.stderr)
     return 0 if not failures else 1
 
 
@@ -272,6 +328,18 @@ def main(argv=None) -> int:
                          "artifact carries an slo view; passing a "
                          "value makes the gate mandatory — an artifact "
                          "without slo.miss_rate then exits 2)")
+    ap.add_argument("--p99-max", type=float, default=None,
+                    help="absolute bound in seconds on the servebench "
+                         "wave p99 (warm.p99_s); mandatory once "
+                         "passed — a candidate without the key exits "
+                         "2. Also gated RELATIVELY (tolerance-pct) "
+                         "against the --against reference whenever "
+                         "both artifacts carry it")
+    ap.add_argument("--ttfb-p50-max", type=float, default=None,
+                    help="absolute bound in seconds on the servebench "
+                         "time-to-first-byte p50 (warm.ttfb_p50_s); "
+                         "same mandatory/relative semantics as "
+                         "--p99-max")
     args = ap.parse_args(argv)
     try:
         return run(args)

@@ -20,16 +20,31 @@ Two warm phases measure two different claims:
 Exit status is the acceptance check: 0 only when sequential warm p50
 beats cold p50, no warm job compiled anything (sched compile telemetry:
 the warm path recompiles NOTHING), every warm job's FASTA equals the
-cold CLI bytes, every wave job saw at least one live progress frame
-before its result (time-to-first-progress is reported as its own
-column), and the serve event journal — enabled for the measured run —
-passes its consistency check (every job exactly one terminal state,
+cold CLI bytes, every wave job saw at least one live progress frame AND
+one streamed `result_part` frame before its result (time-to-first-
+progress and time-to-first-BYTE are reported as their own columns), and
+the serve event journal — enabled for the measured run — passes its
+consistency check (every job exactly one terminal state,
 started/terminal pairs balanced). `--json PATH` writes the summary as a
 bench-style artifact with `occupancy` / `metrics` / `slo` / `journal`
 fields alongside the serve numbers (the same field names bench.py
-publishes; tools/perfgate.py gates warm p50 and slo.miss_rate from it).
+publishes; tools/perfgate.py gates warm p50, p99, ttfb_p50 and
+slo.miss_rate from it).
+
+OPEN-LOOP ARRIVAL MODE (`--qps`, optionally a `--qps-curve` sweep):
+instead of firing the whole wave at once (closed-loop, back-pressure
+hides the queueing), jobs arrive by a Poisson process at the target
+rate and the bench reports p50/p95/p99 end-to-end latency,
+time-to-first-byte (the first streamed `result_part`), achieved vs
+offered throughput per rate, and the SATURATION KNEE — the highest
+swept rate the server still absorbs (achieved >= 90% of offered). The
+curve rides the `--json` artifact under `openloop` so perfgate can gate
+the latency tail round over round. `--baseline PATH` embeds a prior
+measurement (e.g. the round-barrier design's curve) and prints the
+comparison.
 
     python tools/servebench.py --jobs 4 [--genome-kb 20] [--json out.json]
+    python tools/servebench.py --qps 2 --qps-jobs 8 --qps-curve 0.5,1,2,4
 """
 
 from __future__ import annotations
@@ -55,27 +70,48 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 
 def build_dataset(tmpdir: str, genome_kb: int, coverage: int,
-                  read_len: int, seed: int):
+                  read_len: int, seed: int, contigs: int = 1):
     """Synthetic ONT-style workload via synthbench's simulator (same
-    error model as the scale bench, so serve numbers are comparable)."""
+    error model as the scale bench, so serve numbers are comparable).
+    `contigs` > 1 splits the genome budget across independent contigs
+    — the shape that exercises per-contig result streaming: the first
+    contig's bytes hit the wire while later contigs still polish."""
     import random
 
-    from synthbench import simulate
-
-    rng = random.Random(seed)
-    _, draft, reads, paf = simulate(rng, genome_kb * 1000, coverage,
-                                    read_len, 0.12, 0.10)
+    all_reads, all_paf, drafts = [], [], []
+    per_contig = max(1, genome_kb // max(1, contigs))
+    for c in range(max(1, contigs)):
+        rng = random.Random(seed + 1000 * c)
+        _, draft, reads, paf = simulate_contig(
+            rng, per_contig * 1000, coverage, read_len)
+        tag = f"c{c}_" if contigs > 1 else ""
+        cname = f"draft{c}" if contigs > 1 else "draft"
+        drafts.append((cname, draft))
+        for name, read in reads:
+            all_reads.append((tag + name, read))
+        for line in paf:
+            fields = line.split("\t")
+            fields[0] = tag + fields[0]
+            fields[5] = cname
+            all_paf.append("\t".join(fields))
     paths = (os.path.join(tmpdir, "reads.fasta.gz"),
              os.path.join(tmpdir, "ovl.paf.gz"),
              os.path.join(tmpdir, "draft.fasta.gz"))
     with gzip.open(paths[0], "wb", compresslevel=1) as f:
-        for name, read in reads:
+        for name, read in all_reads:
             f.write(b">" + name.encode() + b"\n" + read + b"\n")
     with gzip.open(paths[1], "wb", compresslevel=1) as f:
-        f.write(("\n".join(paf) + "\n").encode())
+        f.write(("\n".join(all_paf) + "\n").encode())
     with gzip.open(paths[2], "wb", compresslevel=1) as f:
-        f.write(b">draft\n" + draft + b"\n")
+        for cname, draft in drafts:
+            f.write(b">" + cname.encode() + b"\n" + draft + b"\n")
     return paths
+
+
+def simulate_contig(rng, genome_len, coverage, read_len):
+    from synthbench import simulate
+
+    return simulate(rng, genome_len, coverage, read_len, 0.12, 0.10)
 
 
 def cold_cli_run(paths, args) -> tuple[float, bytes]:
@@ -114,7 +150,8 @@ def check_slo(args, PolishClient, PolishServer) -> int:
               f"{args.deadline:.0f}s, p99<= {args.slo_p99:.1f}s, "
               f"miss-rate<= {args.slo_miss_rate:.2f}", file=sys.stderr)
         paths = build_dataset(tmp, args.genome_kb, args.coverage,
-                              args.read_len, args.seed)
+                              args.read_len, args.seed,
+                              contigs=args.contigs)
         sock = os.path.join(tmp, "serve.sock")
         server = PolishServer(
             socket_path=sock, workers=args.workers, warmup=False,
@@ -186,6 +223,95 @@ def check_slo(args, PolishClient, PolishServer) -> int:
     return 1 if failures else 0
 
 
+def run_openloop(client, paths, qps: float, n_jobs: int,
+                 seed: int) -> dict:
+    """One open-loop wave: Poisson arrivals at `qps`, every job
+    streaming (progress + result parts), latency percentiles +
+    time-to-first-byte + achieved throughput."""
+    import random
+
+    from racon_tpu.serve.queue import nearest_rank
+
+    rng = random.Random(seed)
+    lat: list = [None] * n_jobs
+    ttfb: list = [None] * n_jobs
+    threads = []
+
+    def submit(i):
+        t0 = time.perf_counter()
+
+        def on_part(frame, _i=i, _t=t0):
+            if ttfb[_i] is None:
+                ttfb[_i] = time.perf_counter() - _t
+
+        try:
+            client.submit(*paths, retries=8, on_part=on_part)
+        except Exception as exc:
+            print(f"[servebench] openloop job {i} failed: {exc}",
+                  file=sys.stderr)
+            # keep lat and ttfb over the SAME population: a job that
+            # streamed a part but then failed must not skew ttfb low
+            ttfb[i] = None
+            return
+        lat[i] = time.perf_counter() - t0
+
+    t_start = time.perf_counter()
+    for i in range(n_jobs):
+        time.sleep(rng.expovariate(qps))
+        t = threading.Thread(target=submit, args=(i,))
+        t.start()
+        threads.append(t)
+    for t in threads:
+        t.join()
+    duration = time.perf_counter() - t_start
+    done = sorted(v for v in lat if v is not None)
+    tb = sorted(v for v in ttfb if v is not None)
+    out = {"qps": qps, "jobs": n_jobs, "completed": len(done),
+           "duration_s": round(duration, 3),
+           "achieved_qps": round(len(done) / max(duration, 1e-9), 3)}
+    if done:
+        out.update(p50_s=round(nearest_rank(done, 0.50), 4),
+                   p95_s=round(nearest_rank(done, 0.95), 4),
+                   p99_s=round(nearest_rank(done, 0.99), 4))
+    if tb:
+        out["ttfb_p50_s"] = round(nearest_rank(tb, 0.50), 4)
+    return out
+
+
+def saturation_knee(curve: list[dict]) -> float | None:
+    """The highest swept rate the server still absorbs: achieved
+    throughput >= 90% of offered, STOPPING at the first rate that
+    fails — a noisy high-rate point that spuriously passes must not
+    report capacity above a rate the server demonstrably dropped.
+    None when even the lowest rate saturates the server."""
+    knee = None
+    for pt in sorted(curve, key=lambda p: p["qps"]):
+        if pt["achieved_qps"] < 0.9 * pt["qps"]:
+            break
+        knee = pt["qps"]
+    return knee
+
+
+def _baseline_view(doc: dict) -> dict:
+    """Comparable numbers out of a --baseline artifact: either another
+    servebench artifact (openloop.curve / warm keys) or a raw curve
+    dump ({"curve": [...]})."""
+    curve = (doc.get("openloop") or {}).get("curve") or \
+        doc.get("curve") or []
+    out = {"design": doc.get("design") or doc.get("mode"),
+           "curve": curve}
+    if curve:
+        worst = max((p for p in curve if p.get("p99_s")),
+                    key=lambda p: p["qps"], default=None)
+        if worst:
+            out["p99_s"] = worst.get("p99_s")
+            out["ttfb_p50_s"] = worst.get("ttfb_p50_s")
+    warm = doc.get("warm") or {}
+    out.setdefault("p99_s", warm.get("p99_s"))
+    out.setdefault("ttfb_p50_s", warm.get("ttfb_p50_s"))
+    return out
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--jobs", type=int, default=4,
@@ -194,6 +320,12 @@ def main(argv=None) -> int:
                     help="sequential cold CLI runs to time "
                          "(default min(jobs, 3))")
     ap.add_argument("--genome-kb", type=int, default=20)
+    ap.add_argument("--contigs", type=int, default=4,
+                    help="split the genome budget across this many "
+                         "independent contigs (default 4) — "
+                         "time-to-first-byte then measures the FIRST "
+                         "contig streaming out, the shape the "
+                         "continuous batcher optimizes")
     ap.add_argument("--coverage", type=int, default=20)
     ap.add_argument("--read-len", type=int, default=4000)
     ap.add_argument("--seed", type=int, default=42)
@@ -201,8 +333,27 @@ def main(argv=None) -> int:
     ap.add_argument("-c", "--tpupoa-batches", type=int, default=0)
     ap.add_argument("--tpualigner-batches", type=int, default=0)
     ap.add_argument("--workers", type=int, default=2)
+    ap.add_argument("--iteration-windows", type=int, default=None,
+                    help="continuous feeder iteration bound passed to "
+                         "the server (smaller = finer streaming "
+                         "granularity and faster late-join turnaround)")
     ap.add_argument("--json", default=None,
                     help="write the bench-style JSON artifact here")
+    ap.add_argument("--qps", type=float, default=None,
+                    help="open-loop arrival mode: Poisson arrivals at "
+                         "this rate (jobs/s) instead of an all-at-once "
+                         "wave; reports latency percentiles, "
+                         "time-to-first-byte and achieved throughput")
+    ap.add_argument("--qps-jobs", type=int, default=8,
+                    help="jobs per open-loop wave (default 8)")
+    ap.add_argument("--qps-curve", default=None,
+                    help="comma-separated extra rates to sweep (e.g. "
+                         "'0.5,1,2,4') — the saturation-knee curve in "
+                         "the artifact")
+    ap.add_argument("--baseline", default=None,
+                    help="embed a prior measurement (servebench "
+                         "artifact or raw curve JSON) in the artifact "
+                         "and print the p99/ttfb comparison")
     ap.add_argument("--check-slo", action="store_true",
                     help="SLO gate mode: run a small concurrent wave "
                          "with per-job deadlines and assert p99 latency "
@@ -232,7 +383,8 @@ def main(argv=None) -> int:
         print(f"[servebench] simulating {args.genome_kb} kb at "
               f"{args.coverage}x ...", file=sys.stderr)
         paths = build_dataset(tmp, args.genome_kb, args.coverage,
-                              args.read_len, args.seed)
+                              args.read_len, args.seed,
+                              contigs=args.contigs)
 
         # ---- cold: N sequential fresh-process CLI runs
         cold_s: list[float] = []
@@ -250,11 +402,14 @@ def main(argv=None) -> int:
         # checked after drain as part of the gate
         sock = os.path.join(tmp, "serve.sock")
         journal_path = os.path.join(tmp, "journal.jsonl")
+        server_kw = {}
+        if args.iteration_windows is not None:
+            server_kw["iteration_windows"] = args.iteration_windows
         server = PolishServer(
             socket_path=sock, workers=args.workers, warmup=False,
             job_threads=args.threads, journal=journal_path,
             tpu_poa_batches=args.tpupoa_batches,
-            tpu_aligner_batches=args.tpualigner_batches)
+            tpu_aligner_batches=args.tpualigner_batches, **server_kw)
         t0 = time.perf_counter()
         server.warmup(paths=paths)  # warm on the SAME shapes jobs use
         server.start()
@@ -275,13 +430,15 @@ def main(argv=None) -> int:
             print(f"[servebench] warm seq run {i + 1}/{cold_n}: "
                   f"{seq_s[-1]:.2f}s", file=sys.stderr)
 
-        # ---- warm concurrent wave: the multiplexing story, streamed —
-        # every wave job asks for live progress so time-to-first-
-        # progress (how long a client stares at nothing) is measured
-        # under contention, not just on an idle server
+        # ---- warm concurrent wave: the multiplexing story, fully
+        # streamed — every wave job asks for live progress AND streamed
+        # result parts, so both time-to-first-progress and
+        # time-to-first-BYTE (first polished contig on the wire) are
+        # measured under contention, not just on an idle server
         results: list = [None] * args.jobs
         latencies: list = [0.0] * args.jobs
         first_progress: list = [None] * args.jobs
+        first_byte: list = [None] * args.jobs
 
         def submit(i):
             t = time.perf_counter()
@@ -290,8 +447,13 @@ def main(argv=None) -> int:
                 if first_progress[_i] is None:
                     first_progress[_i] = time.perf_counter() - _t
 
+            def on_part(frame, _i=i, _t=t):
+                if first_byte[_i] is None:
+                    first_byte[_i] = time.perf_counter() - _t
+
             results[i] = client.submit(*paths, retries=5,
-                                       on_progress=on_progress)
+                                       on_progress=on_progress,
+                                       on_part=on_part)
             latencies[i] = time.perf_counter() - t
 
         threads = [threading.Thread(target=submit, args=(i,))
@@ -303,15 +465,41 @@ def main(argv=None) -> int:
             t.join()
         wave_s = time.perf_counter() - t_wave
 
+        # ---- open-loop arrival sweep (--qps): Poisson arrivals on the
+        # SAME warm server — the saturation-knee curve
+        openloop: list[dict] = []
+        if args.qps is not None or args.qps_curve:
+            rates = []
+            if args.qps_curve:
+                rates += [float(r) for r in args.qps_curve.split(",")
+                          if r.strip()]
+            if args.qps is not None and args.qps not in rates:
+                rates.append(args.qps)
+            for k, rate in enumerate(sorted(set(rates))):
+                pt = run_openloop(client, paths, rate, args.qps_jobs,
+                                  seed=args.seed + k)
+                openloop.append(pt)
+                print(f"[servebench] openloop qps={rate:g}: "
+                      f"p50 {pt.get('p50_s', float('nan')):.2f}s "
+                      f"p99 {pt.get('p99_s', float('nan')):.2f}s "
+                      f"ttfb_p50 {pt.get('ttfb_p50_s', float('nan')):.2f}s "
+                      f"achieved {pt['achieved_qps']:g}/{rate:g}",
+                      file=sys.stderr)
+
         snap = server.stats_snapshot()
         server.drain(timeout=30)
 
         # ---- journal consistency: every journaled job reaches exactly
         # one terminal state, started/terminal pairs balance
+        from obsreport import check_parts_streamed
         from racon_tpu.obs.journal import check_consistency, read_journal
 
         journal_entries = read_journal(journal_path)
-        journal_problems = check_consistency(journal_entries)
+        # lifecycle invariants PLUS the streamed-results receipt (one
+        # part-streamed line per output contig) — the same pair
+        # obsreport --check enforces
+        journal_problems = (check_consistency(journal_entries)
+                            + check_parts_streamed(journal_entries))
 
     # ---- analysis
     from racon_tpu.serve.queue import nearest_rank
@@ -321,6 +509,7 @@ def main(argv=None) -> int:
     warm_sorted = sorted(latencies)
     p50 = nearest_rank(warm_sorted, 0.50)
     p95 = nearest_rank(warm_sorted, 0.95)
+    p99 = nearest_rank(warm_sorted, 0.99)
     seq_p50 = nearest_rank(sorted(seq_s), 0.50)
     cold_p50 = nearest_rank(sorted(cold_s), 0.50)
     compiles_per_job = [
@@ -342,8 +531,20 @@ def main(argv=None) -> int:
         fail.append(f"only {len(ttfp)}/{args.jobs} wave jobs received "
                     "a progress frame before their result")
     ttfp_p50 = nearest_rank(sorted(ttfp), 0.50) if ttfp else None
+    ttfb = [v for v in first_byte if v is not None]
+    if len(ttfb) < args.jobs:
+        fail.append(f"only {len(ttfb)}/{args.jobs} wave jobs received "
+                    "a result_part frame before their result")
+    ttfb_p50 = nearest_rank(sorted(ttfb), 0.50) if ttfb else None
     for p in journal_problems:
         fail.append(f"journal inconsistency: {p}")
+    baseline = None
+    if args.baseline:
+        try:
+            with open(args.baseline) as fh:
+                baseline = _baseline_view(json.load(fh))
+        except (OSError, ValueError) as exc:
+            fail.append(f"unreadable --baseline {args.baseline}: {exc}")
 
     b = snap["batcher"]
     print(f"[servebench] warm sequential: p50 {seq_p50:.2f}s vs cold "
@@ -352,7 +553,7 @@ def main(argv=None) -> int:
           f"[{'OK' if seq_p50 < cold_p50 else 'FAIL'}]", file=sys.stderr)
     print(f"[servebench] warm concurrent: {args.jobs} jobs in "
           f"{wave_s:.2f}s ({wave_s / args.jobs:.2f}s/job) — latency "
-          f"p50 {p50:.2f}s p95 {p95:.2f}s mean "
+          f"p50 {p50:.2f}s p95 {p95:.2f}s p99 {p99:.2f}s mean "
           f"{statistics.mean(latencies):.2f}s", file=sys.stderr)
     print(f"[servebench] cold: {len(cold_s)} runs — p50 {cold_p50:.2f}s "
           f"mean {statistics.mean(cold_s):.2f}s", file=sys.stderr)
@@ -368,6 +569,28 @@ def main(argv=None) -> int:
               f"({len(ttfp)}/{args.jobs} jobs) "
               f"[{'OK' if len(ttfp) == args.jobs else 'FAIL'}]",
               file=sys.stderr)
+    if ttfb:
+        print(f"[servebench] time-to-first-byte (streamed part): p50 "
+              f"{ttfb_p50:.3f}s max {max(ttfb):.3f}s vs job p50 "
+              f"{p50:.3f}s ({len(ttfb)}/{args.jobs} jobs) "
+              f"[{'OK' if len(ttfb) == args.jobs else 'FAIL'}]",
+              file=sys.stderr)
+    if baseline and baseline.get("p99_s"):
+        worst = (max((pt for pt in openloop if pt.get("p99_s")),
+                     key=lambda pt: pt["qps"], default=None)
+                 if openloop else None)
+        cand_p99 = worst["p99_s"] if worst else p99
+        cand_ttfb = (worst.get("ttfb_p50_s")
+                     if worst else ttfb_p50)
+        delta = (1 - cand_p99 / baseline["p99_s"]) * 100
+        print(f"[servebench] vs baseline "
+              f"({baseline.get('design') or 'prior'}): p99 "
+              f"{cand_p99:.2f}s vs {baseline['p99_s']:.2f}s "
+              f"({abs(delta):.0f}% {'better' if delta >= 0 else 'WORSE'})"
+              + (f", ttfb_p50 {cand_ttfb:.2f}s vs "
+                 f"{baseline['ttfb_p50_s']:.2f}s"
+                 if cand_ttfb and baseline.get("ttfb_p50_s")
+                 else ""), file=sys.stderr)
     n_journal_jobs = len({e.get('job') for e in journal_entries
                           if e.get('job')})
     print(f"[servebench] journal: {len(journal_entries)} events / "
@@ -375,9 +598,11 @@ def main(argv=None) -> int:
           f"{len(journal_problems)} consistency problems "
           f"[{'OK' if not journal_problems else 'FAIL'}]",
           file=sys.stderr)
-    print(f"[servebench] batch rounds: {b['rounds']} "
-          f"({b['multi_job_rounds']} cross-job, max "
-          f"{b['max_jobs_in_round']} jobs/round)", file=sys.stderr)
+    print(f"[servebench] device iterations: {b['iterations']} "
+          f"({b['shared_iterations']} cross-job, max "
+          f"{b['max_jobs_in_iteration']} jobs / "
+          f"{b['max_windows_in_iteration']} windows per iteration)",
+          file=sys.stderr)
     for engine, e in (b.get("occupancy") or {}).items():
         if e.get("buckets"):
             print(f"[servebench] {engine} occupancy "
@@ -390,6 +615,7 @@ def main(argv=None) -> int:
             "jobs": args.jobs,
             "warm": {"seq_p50_s": round(seq_p50, 3),
                      "p50_s": round(p50, 3), "p95_s": round(p95, 3),
+                     "p99_s": round(p99, 3),
                      "mean_s": round(statistics.mean(latencies), 3),
                      "wave_s": round(wave_s, 3),
                      "warmup_s": round(warm_ready_s, 3),
@@ -399,6 +625,10 @@ def main(argv=None) -> int:
                                     if ttfp_p50 is not None else None),
                      "ttfp_max_s": (round(max(ttfp), 4)
                                     if ttfp else None),
+                     "ttfb_p50_s": (round(ttfb_p50, 4)
+                                    if ttfb_p50 is not None else None),
+                     "ttfb_max_s": (round(max(ttfb), 4)
+                                    if ttfb else None),
                      "compiles_per_job": compiles_per_job},
             "slo": {k: (snap.get("slo") or {}).get(k) for k in
                     ("deadline_hit", "deadline_miss", "expired",
@@ -410,15 +640,23 @@ def main(argv=None) -> int:
                      "p50_s": round(cold_p50, 3),
                      "mean_s": round(statistics.mean(cold_s), 3)},
             "speedup_p50": round(cold_p50 / max(seq_p50, 1e-9), 2),
-            "batch_rounds": {k: b[k] for k in
-                             ("rounds", "multi_job_rounds", "jobs",
-                              "windows", "max_jobs_in_round")},
+            "iterations": {k: b[k] for k in
+                           ("iterations", "shared_iterations", "jobs",
+                            "windows", "max_jobs_in_iteration",
+                            "max_windows_in_iteration")},
             "occupancy": b.get("occupancy", {}),
             "metrics": {"queue": snap["queue"],
                         "batcher": {k: v for k, v in b.items()
                                     if k != "occupancy"}},
             "pass": not fail,
         }
+        if openloop:
+            artifact["openloop"] = {"curve": openloop,
+                                    "jobs_per_rate": args.qps_jobs,
+                                    "knee_qps": saturation_knee(
+                                        openloop)}
+        if baseline is not None:
+            artifact["baseline"] = baseline
         with open(args.json, "w") as fh:
             json.dump(artifact, fh, indent=2, sort_keys=True)
         print(f"[servebench] wrote {args.json}", file=sys.stderr)
