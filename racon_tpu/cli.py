@@ -117,6 +117,20 @@ usage: racon_tpu [options ...] <sequences> <overlaps> <target sequences>
             skip recompiles, including adaptive-bucket runs whose shapes
             are data-derived (mirrors RACON_TPU_COMPILE_CACHE /
             JAX_COMPILATION_CACHE_DIR)
+        --tpu-pallas <0|1|auto>
+            default: 0
+            hand-tiled Pallas device kernels for the banded aligner and
+            the session POA sweep: 1 = whenever the VMEM envelope fits,
+            auto = per-bucket from the persisted autotuner winner table
+            (profile with tools/tpu_smoke.py; buckets without an entry
+            dispatch XLA), 0 = XLA programs only. Output is
+            byte-identical in every mode (mirrors RACON_TPU_PALLAS)
+        --tpu-dtype <auto|int32|int16>
+            default: auto
+            DP score dtype policy: auto shrinks each bucket to int16
+            when its overflow envelope proof holds (half the DP bytes,
+            bit-identical results), int32 forces the wide oracle
+            everywhere (mirrors RACON_TPU_DTYPE)
         --tpu-strict
             re-raise device failures instead of degrading to the host
             fallback / per-window quarantine (mirrors RACON_TPU_STRICT;
@@ -189,6 +203,8 @@ def parse_args(argv: list[str]) -> dict | None:
         "tpu_fault_plan": None,
         "tpu_adaptive_buckets": None,
         "tpu_compile_cache": None,
+        "tpu_pallas": None,
+        "tpu_dtype": None,
         "tpu_trace": None,
         "tpu_metrics": None,
         "tpu_log_level": None,
@@ -200,6 +216,20 @@ def parse_args(argv: list[str]) -> dict | None:
         if v not in ("session", "fused"):
             print("racon_tpu: --tpu-engine must be 'session' or 'fused'",
                   file=sys.stderr)
+            sys.exit(1)
+        return v
+
+    def _pallas_choice(v: str) -> str:
+        if v not in ("0", "1", "auto"):
+            print("racon_tpu: --tpu-pallas must be '0', '1' or 'auto'",
+                  file=sys.stderr)
+            sys.exit(1)
+        return v
+
+    def _dtype_choice(v: str) -> str:
+        if v not in ("auto", "int32", "int16"):
+            print("racon_tpu: --tpu-dtype must be 'auto', 'int32' or "
+                  "'int16'", file=sys.stderr)
             sys.exit(1)
         return v
 
@@ -234,6 +264,8 @@ def parse_args(argv: list[str]) -> dict | None:
                   "tpu-device-timeout": ("tpu_device_timeout", float),
                   "tpu-fault-plan": ("tpu_fault_plan", str),
                   "tpu-compile-cache": ("tpu_compile_cache", str),
+                  "tpu-pallas": ("tpu_pallas", _pallas_choice),
+                  "tpu-dtype": ("tpu_dtype", _dtype_choice),
                   "tpu-trace": ("tpu_trace", str),
                   "tpu-metrics": ("tpu_metrics", str),
                   "tpu-log-level": ("tpu_log_level", _level_choice),
@@ -376,6 +408,13 @@ def main(argv: list[str] | None = None) -> int:
         # constructed anywhere, strict checks in the ops — sees them
         if opts["tpu_strict"]:
             os.environ["RACON_TPU_STRICT"] = "1"
+        # kernel-plane posture: the engines resolve these env knobs at
+        # construction, so setting them here threads the CLI choice
+        # through every dispatcher (aligner, session, fused)
+        if opts["tpu_pallas"] is not None:
+            os.environ["RACON_TPU_PALLAS"] = opts["tpu_pallas"]
+        if opts["tpu_dtype"] is not None:
+            os.environ["RACON_TPU_DTYPE"] = opts["tpu_dtype"]
         if opts["tpu_fault_plan"]:
             from .resilience import FaultPlan
 

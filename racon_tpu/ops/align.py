@@ -61,31 +61,52 @@ def band_offsets(q_len: int, t_len: int, band: int, n_waves: int) -> np.ndarray:
 
 
 @functools.lru_cache(maxsize=None)
-def _kernel_for(band: int, n_waves: int):
+def _kernel_for(band: int, n_waves: int, score_dtype: str = "int32",
+                packed: bool = False):
     """jitted banded DP for one static (band, n_waves) shape; jax is
-    imported lazily so the module loads without a device runtime."""
+    imported lazily so the module loads without a device runtime.
+    `score_dtype` narrows the wavefront state (legal only under
+    ops/dtypes.aligner_int16_ok); `packed` takes 2-bit packed operands
+    (encode.pack_2bit) and unpacks them on device — both variants are
+    byte-identical to the int32/int8 program by construction."""
     import jax
 
     return jax.jit(functools.partial(_banded_nw_kernel, band=band,
-                                     n_waves=n_waves))
+                                     n_waves=n_waves,
+                                     score_dtype=score_dtype,
+                                     packed=packed))
 
 
-def _banded_nw_kernel(q, t, q_len, t_len, offsets, band: int, n_waves: int):
+def _banded_nw_kernel(q, t, q_len, t_len, offsets, band: int, n_waves: int,
+                      score_dtype: str = "int32", packed: bool = False):
     """Batched banded edit-distance DP.
 
     Args:
-      q, t: [B, Lq], [B, Lt] int8 codes (PAD beyond length).
+      q, t: [B, Lq], [B, Lt] int8 codes (PAD beyond length), or 2-bit
+        packed [B, Lq // 4] uint8 when `packed` (ACGT-only operands;
+        PAD is restored from the lengths on device).
       q_len, t_len: [B] int32.
       offsets: [B, n_waves] int32 band starts.
       band: static band width (multiple of 4).
       n_waves: static number of wavefronts (>= max(q_len+t_len) + 1).
+      score_dtype: 'int32' (sentinel 1<<28) or 'int16' (sentinel 1<<14,
+        legal iff 2*edge+1 < 1<<14 — every cell is min-clamped at the
+        sentinel per wavefront, so values never exceed sentinel + 1).
 
     Returns:
       bp_packed: [n_waves, B, band // 4] uint8 — 2-bit backpointers.
-      distance: [B] int32 edit distance at (M, N).
+      distance: [B] score_dtype edit distance at (M, N).
     """
     import jax
     import jax.numpy as jnp
+
+    DT = jnp.int16 if score_dtype == "int16" else jnp.int32
+    INFD = jnp.asarray((1 << 14) if score_dtype == "int16" else INF, DT)
+    if packed:
+        from .encode import unpack_2bit_jax
+
+        q = unpack_2bit_jax(q, q.shape[1] * 4, q_len)
+        t = unpack_2bit_jax(t, t.shape[1] * 4, t_len)
 
     batch = q.shape[0]
     ks = jnp.arange(band, dtype=jnp.int32)
@@ -106,19 +127,19 @@ def _banded_nw_kernel(q, t, q_len, t_len, offsets, band: int, n_waves: int):
         def gather(s, idx):
             ok = (idx >= 0) & (idx < band)
             return jnp.where(ok, jnp.take_along_axis(s, jnp.clip(idx, 0, band - 1),
-                                                     axis=1), INF)
+                                                     axis=1), INFD)
 
-        up = jnp.where(i >= 1, gather(s1, k1m), INF)        # consume q[i-1]
-        left = jnp.where(j >= 1, gather(s1, k1), INF)       # consume t[j-1]
-        diag = jnp.where((i >= 1) & (j >= 1), gather(s2, k2m), INF)
+        up = jnp.where(i >= 1, gather(s1, k1m), INFD)        # consume q[i-1]
+        left = jnp.where(j >= 1, gather(s1, k1), INFD)       # consume t[j-1]
+        diag = jnp.where((i >= 1) & (j >= 1), gather(s2, k2m), INFD)
 
         qi = jnp.take_along_axis(q, jnp.clip(i - 1, 0, q.shape[1] - 1), axis=1)
         tj = jnp.take_along_axis(t, jnp.clip(j - 1, 0, t.shape[1] - 1), axis=1)
-        sub = jnp.where(qi == tj, 0, 1).astype(jnp.int32)
+        sub = jnp.where(qi == tj, 0, 1).astype(DT)
 
         cd = diag + sub
-        cu = up + 1
-        cl = left + 1
+        cu = up + jnp.asarray(1, DT)
+        cl = left + jnp.asarray(1, DT)
 
         # fixed tie order: diag, up, left
         score = cd
@@ -130,24 +151,24 @@ def _banded_nw_kernel(q, t, q_len, t_len, offsets, band: int, n_waves: int):
 
         # seed origin
         origin = (i == 0) & (j == 0)
-        score = jnp.where(origin, 0, score)
-        score = jnp.where(valid, jnp.minimum(score, INF), INF)
+        score = jnp.where(origin, jnp.asarray(0, DT), score)
+        score = jnp.where(valid, jnp.minimum(score, INFD), INFD)
 
         # record final distance when this wavefront crosses (M, N)
         at_end = (i == q_len[:, None]) & (j == t_len[:, None])
         dist = jnp.where(at_end.any(axis=1),
-                         jnp.where(at_end, score, INF).min(axis=1), dist)
+                         jnp.where(at_end, score, INFD).min(axis=1), dist)
 
         # pack 2-bit backpointers 4 per byte
         b4 = bp.reshape(batch, band // 4, 4).astype(jnp.uint8)
-        packed = (b4[..., 0] | (b4[..., 1] << 2) | (b4[..., 2] << 4)
-                  | (b4[..., 3] << 6))
+        packed_bp = (b4[..., 0] | (b4[..., 1] << 2) | (b4[..., 2] << 4)
+                     | (b4[..., 3] << 6))
 
-        return (score, s1, a0, a1, dist), packed
+        return (score, s1, a0, a1, dist), packed_bp
 
-    s_init = jnp.full((batch, band), INF, dtype=jnp.int32)
+    s_init = jnp.full((batch, band), INFD, dtype=DT)
     a_init = jnp.zeros((batch,), dtype=jnp.int32)
-    dist_init = jnp.full((batch,), INF, dtype=jnp.int32)
+    dist_init = jnp.full((batch,), INFD, dtype=DT)
 
     (_, _, _, _, dist), bp_packed = jax.lax.scan(
         step, (s_init, s_init, a_init, a_init, dist_init),
@@ -218,18 +239,26 @@ def _traceback(bp: np.ndarray, offsets: np.ndarray, q_lens: np.ndarray,
         active = (i > 0) | (j > 0)
         step += 1
 
-    out = []
-    code_to_op = {BP_DIAG: "M", BP_UP: "I", BP_LEFT: "D"}
-    for lane in range(n_lanes):
-        seq = ops[lane, :counts[lane]][::-1]  # forward order
-        runs: list[tuple[int, str]] = []
-        if len(seq):
-            change = np.nonzero(np.diff(seq))[0]
-            starts = np.concatenate(([0], change + 1))
-            ends = np.concatenate((change + 1, [len(seq)]))
-            runs = [(int(e - s), code_to_op[int(seq[s])]) for s, e in zip(starts, ends)]
-        out.append(runs)
+    out = [_runs_of(ops[lane, :counts[lane]][::-1])
+           for lane in range(n_lanes)]
     return out, touched
+
+
+_CODE_TO_OP = {BP_DIAG: "M", BP_UP: "I", BP_LEFT: "D"}
+
+
+def _runs_of(seq: np.ndarray) -> list[tuple[int, str]]:
+    """Forward-order op codes -> CIGAR-style run list — the ONE decoding
+    shared by the host traceback and the Pallas kernel's in-kernel path,
+    so both kernels' outputs compare (and render) identically."""
+    runs: list[tuple[int, str]] = []
+    if len(seq):
+        change = np.nonzero(np.diff(seq))[0]
+        starts = np.concatenate(([0], change + 1))
+        ends = np.concatenate((change + 1, [len(seq)]))
+        runs = [(int(e - s), _CODE_TO_OP[int(seq[s])])
+                for s, e in zip(starts, ends)]
+    return runs
 
 
 class BatchAligner:
@@ -255,12 +284,18 @@ class BatchAligner:
     MAX_BP_BYTES = 192 * 1024 * 1024
 
     def __init__(self, band_width: int = 0, max_length: int | None = None,
-                 runner=None, scheduler=None):
+                 runner=None, scheduler=None,
+                 use_pallas: bool | None = None):
         import os
 
         from ..sched import BatchScheduler
 
         self.band_width = band_width
+        #: Pallas wavefront-kernel posture: True/False force it on/off
+        #: (tests), None defers to RACON_TPU_PALLAS (`1` = always when
+        #: the VMEM envelope fits, `auto` = per-bucket winner table,
+        #: unset/0 = XLA programs only — today's behavior)
+        self.use_pallas = use_pallas
         # the cudaaligner max-length envelope (exceeded_max_length ->
         # CPU, cudaaligner.cpp:63-68); RACON_TPU_ALIGNER_MAXLEN trims it
         # e.g. for time-capped smoke runs on slow links
@@ -323,7 +358,10 @@ class BatchAligner:
         """
         import jax
 
-        from .encode import encode_padded
+        from . import align_pallas
+        from .dtypes import aligner_int16_ok, kernel_plan
+        from .encode import (encode_padded, pack_2bit, pack_bases_enabled,
+                             packable)
         from ..parallel.mesh import BatchRunner
         from ..pipeline import DispatchPipeline
         from ..resilience import strict_mode
@@ -407,8 +445,35 @@ class BatchAligner:
             for s in range(0, len(idxs), max_lanes):
                 chunks.append((edge, band, n_waves, idxs[s:s + max_lanes]))
 
+        # per-bucket kernel/dtype plan, resolved once: the Pallas posture
+        # (constructor override, else RACON_TPU_PALLAS incl. the `auto`
+        # winner-table consult), the score dtype (int16 iff the bucket's
+        # overflow proof holds — ops/dtypes), and the VMEM envelope gate
+        # with fallback to the XLA program
+        if self.use_pallas is True:
+            mode = "on"
+        elif self.use_pallas is False:
+            mode = "off"
+        else:
+            from .poa_pallas import pallas_mode
+
+            mode = pallas_mode()
+        plans: dict[tuple[int, int], tuple[str, str]] = {}
+
+        def plan_for(edge: int, band: int) -> tuple[str, str]:
+            plan = plans.get((edge, band))
+            if plan is None:
+                use, dtype = kernel_plan(
+                    mode, "aligner", (edge, band), (),
+                    aligner_int16_ok(edge),
+                    lambda dt: align_pallas.fits_vmem(edge, band, dt))
+                plan = plans[(edge, band)] = (
+                    "pallas" if use else "xla", dtype)
+            return plan
+
         def pack(chunk):
             edge, band, n_waves, idx = chunk
+            kern, dtype = plan_for(edge, band)
             qs = [pairs[i][0] for i in idx]
             ts = [pairs[i][1] for i in idx]
             lanes = runner.round_batch(len(idx))
@@ -418,26 +483,48 @@ class BatchAligner:
                                           edge)
             offs = np.stack([band_offsets(int(ql), int(tl), band, n_waves)
                              for ql, tl in zip(q_lens, t_lens)])
-            return q_arr, t_arr, q_lens, t_lens, offs
+            # 2-bit base packing: ACGT-only chunks ship a quarter of the
+            # sequence bytes and unpack on device (byte-identical; any N
+            # in the chunk keeps the int8 operands)
+            do_pack = (pack_bases_enabled() and packable(q_arr, q_lens)
+                       and packable(t_arr, t_lens))
+            if kern == "pallas":
+                q_op, t_op = align_pallas.build_ext(q_arr, t_arr, band)
+                if do_pack:
+                    q_op, t_op = pack_2bit(q_op), pack_2bit(t_op)
+            elif do_pack:
+                q_op, t_op = pack_2bit(q_arr), pack_2bit(t_arr)
+            else:
+                q_op, t_op = q_arr, t_arr
+            return kern, dtype, do_pack, q_op, t_op, q_lens, t_lens, offs
 
         def dispatch(chunk, ops):
             import time
 
             edge, band, n_waves, idx = chunk
-            q_arr, t_arr, q_lens, t_lens, offs = ops
+            kern, dtype, do_pack, q_op, t_op, q_lens, t_lens, offs = ops
             # compile telemetry: the first dispatch of a new shape blocks
             # through trace + XLA build (near-zero when the persistent
             # compile cache is warm) — charge that wall to the shape.
             # The lane count is part of the program identity: a tail
             # chunk narrower than its siblings compiles separately.
             t0 = time.perf_counter()
-            kernel = _kernel_for(band, n_waves)
-            bp_packed, dist = runner.run(
-                kernel, q_arr, t_arr, q_lens.astype(np.int32),
-                t_lens.astype(np.int32), offs,
-                out_batch_axes=(1, 0))  # bp is [n_waves, B, band//4]
+            if kern == "pallas":
+                fn = align_pallas.wavefront_align(
+                    edge, band, dtype, do_pack,
+                    interpret=jax.default_backend() == "cpu")
+                out = runner.run_split(fn, q_op, t_op,
+                                       q_lens.astype(np.int32),
+                                       t_lens.astype(np.int32), offs)
+            else:
+                kernel = _kernel_for(band, n_waves, dtype, do_pack)
+                out = runner.run(
+                    kernel, q_op, t_op, q_lens.astype(np.int32),
+                    t_lens.astype(np.int32), offs,
+                    out_batch_axes=(1, 0))  # bp is [n_waves, B, band//4]
             self.sched.stats.record_compile_once(
-                "aligner", (band, n_waves, q_arr.shape[0]),
+                "aligner",
+                (band, n_waves, offs.shape[0], kern, dtype, do_pack),
                 time.perf_counter() - t0)
             # occupancy telemetry, recorded at dispatch (a chunk killed
             # by a fault or the circuit breaker must not be accounted as
@@ -445,26 +532,46 @@ class BatchAligner:
             # vs the batch's full n_waves x band x lanes
             self.sched.stats.record(
                 "aligner", (edge, band), jobs=len(idx),
-                lanes=q_arr.shape[0],
+                lanes=offs.shape[0],
                 useful_cells=sum(
                     (len(pairs[i][0]) + len(pairs[i][1]) + 1) * band
                     for i in idx),
-                total_cells=q_arr.shape[0] * n_waves * band)
+                total_cells=offs.shape[0] * n_waves * band,
+                kernel=kern, dtype=dtype)
             pl.stats.bump("launches")
-            return bp_packed, dist, q_lens, t_lens, offs
+            return kern, out, q_lens, t_lens, offs
 
         def wait(handle):
-            bp_packed, dist, q_lens, t_lens, offs = handle
+            kern, out, q_lens, t_lens, offs = handle
+            if kern == "pallas":
+                shards = out if isinstance(out, list) else [out]
+                op_arr = np.concatenate(
+                    [np.asarray(jax.device_get(s[0])) for s in shards])
+                meta = np.concatenate(
+                    [np.asarray(jax.device_get(s[1])) for s in shards])
+                return kern, (op_arr, meta), q_lens, t_lens, offs
+            bp_packed, dist = out
             dist = np.asarray(dist).astype(np.int64)
             bp = np.asarray(jax.device_get(bp_packed))
-            return bp, dist, q_lens, t_lens, offs
+            return kern, (bp, dist), q_lens, t_lens, offs
 
         def unpack(chunk, res):
             streak["n"] = 0  # a chunk came all the way back: device alive
             edge, band, n_waves, idx = chunk
-            bp_packed, dist, q_lens, t_lens, offs = res
-            bp = _unpack_bp(bp_packed)
-            runs, touched = _traceback(bp, offs, q_lens, t_lens)
+            kern, out, q_lens, t_lens, offs = res
+            if kern == "pallas":
+                # in-kernel traceback: decode each lane's op path with
+                # the same RLE the host traceback uses
+                op_arr, meta = out
+                counts = meta[:, 0]
+                dist = meta[:, 1].astype(np.int64)
+                touched = meta[:, 2] > 0
+                runs = [_runs_of(op_arr[lane, :counts[lane]][::-1])
+                        for lane in range(len(idx))]
+            else:
+                bp_packed, dist = out
+                bp = _unpack_bp(bp_packed)
+                runs, touched = _traceback(bp, offs, q_lens, t_lens)
             # second clipping signal: an in-band cost far above what a
             # <=30%-error overlap can produce means the true (off-band)
             # path was clipped — e.g. a large balanced indel whose

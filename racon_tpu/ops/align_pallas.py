@@ -1,0 +1,280 @@
+"""Pallas TPU kernel for banded global alignment with in-kernel traceback.
+
+The alignment-side half of the device-kernel plane (the POA side is
+ops/poa_pallas.window_sweep): the anti-diagonal wavefront of
+ops/align._banded_nw_kernel as a hand-tiled kernel, one pair per
+sequential grid step with the WHOLE job resident in VMEM:
+
+  - the two rolling wavefronts live in VMEM scratch as `score_dtype`
+    rows ([1, band]); the XLA program instead carries them through a
+    `lax.scan` whose state round-trips HBM every anti-diagonal;
+  - the backpointer plane ([n_waves, band] int8 — codes are 2-bit
+    values, stored one per byte because byte rows keep every store a
+    plain vector op; the XLA program's packed uint8 plane must leave
+    the chip, ~n_waves*band/4 bytes per lane, while this one never
+    does) lives in VMEM scratch;
+  - the traceback runs in-kernel (scalar pointer chase over the VMEM
+    backpointers, mirroring window_sweep), so the kernel's outputs are
+    only the op-code path (<= m+n entries), its length, the final
+    distance and the band-edge flag — a ~band/4-fold cut in
+    device->host traffic.
+
+DP values, band tracking and tie order replicate _banded_nw_kernel
+EXACTLY (same formulas, same INF clamp, same diag < up < left order),
+and the band-shifted neighbour reads are plain dynamic slices because
+the host pre-extends the operands (`build_ext`): q_ext[p] =
+q[clip(p-1, 0, edge-1)] and t_ext[p] = t[clip(2*edge-1-p, 0, edge-1)],
+so wavefront d of lane offset a0 reads q at slice start a0 and t at
+slice start 2*edge + a0 - d — including the exact clip values the XLA
+program's `take_along_axis(clip(...))` produces, cell for cell.
+tests/test_pallas_align.py fuzzes the kernel against the XLA program in
+interpret mode; `BatchAligner` dispatches it per bucket under
+RACON_TPU_PALLAS=1 (always, when the envelope fits) or =auto (when the
+persisted autotuner table says it measured faster), with the XLA
+program as the fallback for shapes the VMEM budget cannot hold.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+#: VMEM the resident job may use — shared budget with the POA kernel
+from .poa_pallas import VMEM_BUDGET
+
+BP_DIAG, BP_UP, BP_LEFT = 0, 1, 2  # ops/align.py's codes
+
+
+def _round128(n: int) -> int:
+    return (n + 127) // 128 * 128
+
+
+def ext_widths(edge: int, band: int) -> tuple[int, int]:
+    """(q_ext, t_ext) operand widths for one bucket (128-padded)."""
+    return _round128(1 + edge + band), _round128(2 * edge + band)
+
+
+def fits_vmem(edge: int, band: int, dtype: str = "int32") -> bool:
+    """True when one lane of bucket (edge, band) is resident-VMEM
+    feasible: the backpointer plane, the rolling wavefronts, AND the
+    per-grid-step operand blocks (offsets, extended q/t, outputs — all
+    int32 in VMEM; the original fits_vmem bug of budgeting only the
+    scratch is not repeated here) fit the shared budget with slack."""
+    n_waves = 2 * edge + 1
+    lq, lt = ext_widths(edge, band)
+    nw_pad = _round128(n_waves)
+    # int8 bp rows are tiled to >= 128 lanes on chip
+    bp = _round128(n_waves + 32) * max(_round128(band), 128)
+    dbytes = 2 if dtype == "int16" else 4
+    waves = 2 * max(_round128(band), 128) * dbytes * 8  # 8-sublane tiles
+    operands = (nw_pad + lq + lt + nw_pad + 128) * 4
+    return bp + waves + operands + (1 << 20) <= VMEM_BUDGET
+
+
+def build_ext(q_arr: np.ndarray, t_arr: np.ndarray,
+              band: int) -> tuple[np.ndarray, np.ndarray]:
+    """Host-side operand extension (see module docstring): [B, edge]
+    int8 code arrays (PAD beyond length, from encode_padded) ->
+    (q_ext [B, Lq], t_ext [B, Lt]) int8 such that every wavefront's
+    neighbour reads become contiguous dynamic slices that reproduce the
+    XLA program's clipped gathers exactly."""
+    edge = q_arr.shape[1]
+    lq, lt = ext_widths(edge, band)
+    qi = np.clip(np.arange(lq) - 1, 0, edge - 1)
+    ti = np.clip(2 * edge - 1 - np.arange(lt), 0, edge - 1)
+    return np.ascontiguousarray(q_arr[:, qi]), \
+        np.ascontiguousarray(t_arr[:, ti])
+
+
+@functools.lru_cache(maxsize=None)
+def wavefront_align(edge: int, band: int, score_dtype: str = "int32",
+                    packed: bool = False, interpret: bool = False):
+    """Jitted fn(q_ext, t_ext, q_lens, t_lens, offsets) ->
+    (ops [B, nw_pad] i32, meta [B, 128] i32), one pair per grid step.
+
+    `ops[k, :meta[k, 0]]` is lane k's backpointer path in traceback
+    order (reverse it for the forward CIGAR); meta[k] = (count, dist,
+    touched_edge, 0...). `packed` takes 2-bit packed q_ext/t_ext
+    ([B, Lx//4] uint8, from encode.pack_2bit over build_ext's output)
+    and unpacks + PAD-restores them with XLA ops before the kernel —
+    a 4x cut in host->device sequence traffic, byte-identical by
+    construction. `score_dtype` picks the wavefront dtype; int16 is
+    only legal under ops/dtypes.aligner_int16_ok's envelope proof.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n_waves = 2 * edge + 1
+    nw_pad = _round128(n_waves)
+    lq, lt = ext_widths(edge, band)
+    DT = jnp.int16 if score_dtype == "int16" else jnp.int32
+    INF = (1 << 14) if score_dtype == "int16" else (1 << 28)
+
+    def kernel(scal_ref, offs_ref, qx_ref, tx_ref, ops_ref, meta_ref,
+               s1, s2, bps):
+        m = scal_ref[0, 0]
+        n = scal_ref[0, 1]
+        INFD = jnp.asarray(INF, DT)
+        ks = jax.lax.broadcasted_iota(jnp.int32, (1, band), 1)
+        s1[0:1, :] = jnp.full((1, band), INF, DT)
+        s2[0:1, :] = jnp.full((1, band), INF, DT)
+        ops_ref[0:1, :] = jnp.zeros((1, nw_pad), jnp.int32)
+        pad = jnp.full((1, 1), INF, DT)
+
+        def wave(d, carry):
+            # the loop index arrives as int64 when another kernel build
+            # (poa_fused) has flipped jax_enable_x64 for the process;
+            # every index expression below must stay int32
+            d = jnp.asarray(d, jnp.int32)
+            z = jnp.int32(0)
+            a1, a2, dist = carry
+            a0 = offs_ref[0, d]
+            ext1 = jnp.concatenate([pad, s1[0:1, :], pad], axis=1)
+            ext2 = jnp.concatenate([pad, s2[0:1, :], pad], axis=1)
+            da = a0 - a1
+            db = a0 - a2
+            i = a0 + ks
+            j = d - i
+            # neighbour reads as shifted slices of the rolling rows:
+            # up (d-1, i-1) = s1[k + da - 1], left (d-1, i) = s1[k + da],
+            # diag (d-2, i-1) = s2[k + db - 1]; the INF border of ext*
+            # reproduces the XLA gather's out-of-band INF exactly
+            up = jnp.where(i >= 1,
+                           jax.lax.dynamic_slice(ext1, (z, da), (1, band)),
+                           INFD)
+            left = jnp.where(j >= 1,
+                             jax.lax.dynamic_slice(ext1, (z, da + 1),
+                                                   (1, band)), INFD)
+            diag = jnp.where((i >= 1) & (j >= 1),
+                             jax.lax.dynamic_slice(ext2, (z, db),
+                                                   (1, band)), INFD)
+            qi = jax.lax.dynamic_slice(qx_ref[0:1, :], (z, a0), (1, band))
+            tj = jax.lax.dynamic_slice(tx_ref[0:1, :],
+                                       (z, 2 * edge + a0 - d), (1, band))
+            sub = jnp.where(qi == tj, 0, 1).astype(DT)
+
+            cd = diag + sub
+            cu = up + jnp.asarray(1, DT)
+            cl = left + jnp.asarray(1, DT)
+            # fixed tie order: diag, up, left (ops/align.py)
+            score = cd
+            bp = jnp.zeros((1, band), jnp.int32) + BP_DIAG
+            bp = jnp.where(cu < score, BP_UP, bp)
+            score = jnp.minimum(score, cu)
+            bp = jnp.where(cl < score, BP_LEFT, bp)
+            score = jnp.minimum(score, cl)
+            origin = (i == 0) & (j == 0)
+            score = jnp.where(origin, jnp.asarray(0, DT), score)
+            valid = (i >= 0) & (i <= m) & (j >= 0) & (j <= n)
+            score = jnp.where(valid, jnp.minimum(score, INFD), INFD)
+
+            at_end = (i == m) & (j == n)
+            dist = jnp.where(
+                jnp.any(at_end),
+                jnp.min(jnp.where(at_end, score, INFD)).astype(jnp.int32),
+                dist)
+
+            bps[pl.ds(d, 1), :] = bp.astype(jnp.int8)
+            s2[0:1, :] = s1[0:1, :]
+            s1[0:1, :] = score
+            return a0, a1, dist
+
+        _, _, dist = jax.lax.fori_loop(
+            0, n_waves, wave,
+            (jnp.int32(0), jnp.int32(0), jnp.int32(INF)))
+
+        # in-kernel traceback: the host _traceback's walk, one lane
+        def tb_cond(st):
+            i, j, cnt, touched = st
+            return (i > 0) | (j > 0)
+
+        def tb_body(st):
+            i, j, cnt, touched = st
+            d = i + j
+            off = offs_ref[0, d]
+            k = i - off
+            row_lo = jnp.maximum(0, d - n)
+            row_hi = jnp.minimum(d, m)
+            # band-boundary marks (possible clipping) only when the
+            # matrix continues past the boundary on that side
+            touched = jnp.where((k <= 0) & (off > row_lo), 1, touched)
+            touched = jnp.where((k >= band - 1)
+                                & (off + band - 1 < row_hi), 1, touched)
+            kc = jnp.clip(k, 0, band - 1)
+            code = bps[d, kc].astype(jnp.int32)
+            # boundary overrides: on i==0 only D possible; on j==0 only I
+            code = jnp.where(i == 0, BP_LEFT, code)
+            code = jnp.where(j == 0, BP_UP, code)
+            di = jnp.where(code != BP_LEFT, 1, 0)
+            dj = jnp.where(code != BP_UP, 1, 0)
+            ops_ref[0, cnt] = code
+            return i - di, j - dj, cnt + 1, touched
+
+        i, j, cnt, touched = jax.lax.while_loop(
+            tb_cond, tb_body, (m, n, jnp.int32(0), jnp.int32(0)))
+        meta_ref[0:1, :] = jnp.zeros((1, 128), jnp.int32)
+        meta_ref[0, 0] = cnt
+        meta_ref[0, 1] = dist
+        meta_ref[0, 2] = touched
+
+    def call(q_ext, t_ext, q_lens, t_lens, offsets):
+        B = offsets.shape[0]
+        if packed:
+            from .encode import PAD, unpack_2bit_jax
+
+            pos_q = jnp.arange(lq, dtype=jnp.int32)[None, :]
+            pos_t = jnp.arange(lt, dtype=jnp.int32)[None, :]
+            ql = q_lens.astype(jnp.int32)[:, None]
+            tl = t_lens.astype(jnp.int32)[:, None]
+            qx = unpack_2bit_jax(q_ext, lq)
+            tx = unpack_2bit_jax(t_ext, lt)
+            # PAD restore along the clip maps build_ext baked in:
+            # q_ext[p] = q[clip(p-1, 0, edge-1)] is PAD iff that clipped
+            # index lands at or past q_len (only possible when the pair
+            # does not fill its bucket), and symmetrically for t_ext
+            qx = jnp.where((pos_q >= 1 + ql) & (ql < edge),
+                           jnp.int8(PAD), qx)
+            tx = jnp.where((pos_t <= 2 * edge - 1 - tl) & (tl < edge),
+                           jnp.int8(PAD), tx)
+        else:
+            qx, tx = q_ext, t_ext
+        scal = jnp.stack([q_lens.astype(jnp.int32),
+                          t_lens.astype(jnp.int32)], axis=1)      # [B, 2]
+        offs = jnp.pad(offsets.astype(jnp.int32),
+                       ((0, 0), (0, nw_pad - offsets.shape[1])))
+        vmem = pltpu.VMEM
+        return pl.pallas_call(
+            kernel,
+            grid=(B,),
+            in_specs=[
+                pl.BlockSpec((1, 2), lambda b: (b, 0),
+                             memory_space=pltpu.SMEM),
+                pl.BlockSpec((1, nw_pad), lambda b: (b, 0),
+                             memory_space=vmem),
+                pl.BlockSpec((1, lq), lambda b: (b, 0),
+                             memory_space=vmem),
+                pl.BlockSpec((1, lt), lambda b: (b, 0),
+                             memory_space=vmem),
+            ],
+            out_specs=(
+                pl.BlockSpec((1, nw_pad), lambda b: (b, 0),
+                             memory_space=vmem),
+                pl.BlockSpec((1, 128), lambda b: (b, 0),
+                             memory_space=vmem),
+            ),
+            out_shape=(
+                jax.ShapeDtypeStruct((B, nw_pad), jnp.int32),
+                jax.ShapeDtypeStruct((B, 128), jnp.int32),
+            ),
+            scratch_shapes=[
+                pltpu.VMEM((1, band), DT),          # wavefront d-1
+                pltpu.VMEM((1, band), DT),          # wavefront d-2
+                pltpu.VMEM((n_waves, band), jnp.int8),  # backpointers
+            ],
+            interpret=interpret,
+        )(scal, offs, qx.astype(jnp.int32), tx.astype(jnp.int32))
+
+    return jax.jit(call)

@@ -1,0 +1,111 @@
+"""Score-dtype shrinking: per-bucket int16 eligibility proofs.
+
+Every DP kernel in ops/ historically carried scores as int32. For most
+buckets that is 2x the bytes the arithmetic needs: the score magnitude a
+bucket can produce is bounded by its shape and the scoring params, and
+when that envelope provably fits int16 the whole DP state (the H carry,
+the wavefronts, the sentinel comparisons) can run narrow — half the VMEM
+footprint for the resident Pallas kernels, half the HBM traffic for the
+XLA programs. int32 stays the fallback and the identity oracle: the
+narrow program is only ever selected when overflow is IMPOSSIBLE, so its
+results are bit-identical by construction (and fuzzed at the envelope
+boundary in tests/test_pallas_align.py / test_pallas_poa.py).
+
+The proofs the predicates encode:
+
+- aligner (unit-cost edit distance, minimize, sentinel INF): every
+  stored cell is min-clamped at INF each wavefront, so values live in
+  [0, INF + 1]. Real path costs are bounded by the anti-diagonal index
+  d <= 2*edge. With INF16 = 1 << 14, int16 is safe iff 2*edge + 1 < INF16
+  (INF must exceed every real score; INF + 1 = 16385 <= 32767 always).
+
+- POA graph-NW (maximize, sentinel NEG): real scores are bounded by
+  (N + L + 1) * mp with mp = max(|match|, |mismatch|, |gap|). Unlike the
+  aligner there is no per-row clamp, so unreachable in-band cells can
+  drift below NEG by at most mp per node row (stored row k values are
+  >= NEG - k * mp by induction); intermediates add at most one more op
+  plus the Hillis/cummax offset of |L * gap|. With NEG16 = -(1 << 14),
+  every value and intermediate fits int16 iff
+  (N + L + 2) * mp <= (1 << 15) - 1 - (1 << 14) = 16383
+  (which also implies the real-score bound (N+L+1)*mp < 1 << 14).
+
+RACON_TPU_DTYPE selects the posture: `auto` (default — shrink whenever
+the proof holds, except where a persisted autotuner winner measured the
+wide program faster), `int32` (force the oracle everywhere; the
+bisection / identity-pin knob), `int16` (shrink wherever provable,
+ignoring the winner table). A bucket whose envelope fails the proof
+ALWAYS runs int32, whatever the knob says.
+"""
+
+from __future__ import annotations
+
+import os
+
+#: int16 sentinel magnitudes (the int32 kernels keep their historical
+#: 1 << 28 / -(1 << 29) sentinels)
+INF16 = 1 << 14
+NEG16 = -(1 << 14)
+
+_I16_MAX = (1 << 15) - 1
+
+
+def dtype_mode() -> str:
+    """RACON_TPU_DTYPE posture: 'auto' | 'int32' | 'int16'. Invalid
+    values fall back to auto (never crash a run over a typo'd knob)."""
+    raw = (os.environ.get("RACON_TPU_DTYPE") or "auto").strip().lower()
+    return raw if raw in ("auto", "int32", "int16") else "auto"
+
+
+def aligner_int16_ok(edge: int) -> bool:
+    """True when the banded edit-distance DP at bucket `edge` provably
+    fits int16 (see module docstring)."""
+    return 2 * edge + 1 < INF16
+
+
+def poa_int16_ok(n_nodes: int, seq_len: int, match: int, mismatch: int,
+                 gap: int) -> bool:
+    """True when the graph-NW DP at bucket (n_nodes, seq_len) with these
+    scoring params provably fits int16 (see module docstring)."""
+    mp = max(abs(match), abs(mismatch), abs(gap))
+    return (n_nodes + seq_len + 2) * mp <= _I16_MAX - INF16
+
+
+def kernel_plan(posture: str, engine: str, bucket, params,
+                envelope_ok: bool, fits) -> tuple[bool, str]:
+    """The ONE kernel-plane dispatch decision, shared by all three
+    engine dispatchers (align.BatchAligner, poa_graph.DeviceGraphPOA,
+    poa_fused.FusedPOA): consult the persisted autotuner winner table
+    under the `auto` posture, resolve the score dtype against the
+    bucket's overflow proof, and gate the Pallas choice on the VMEM
+    envelope. Returns (use_pallas, score_dtype).
+
+    `posture` is pallas_mode()'s 'off'|'on'|'auto' (or a constructor
+    override already folded to on/off); `fits` is the engine's VMEM
+    predicate `fits(dtype) -> bool` (pass `lambda dt: False` for an
+    engine with no Pallas variant — the dtype half still applies)."""
+    ent = None
+    if posture == "auto":
+        from ..sched.autotune import get_autotuner
+
+        ent = get_autotuner().winner(engine, bucket, params)
+    dtype = resolve_dtype(envelope_ok, ent)
+    wants = posture == "on" or (ent or {}).get("kernel") == "pallas"
+    return bool(wants and fits(dtype)), dtype
+
+
+def resolve_dtype(envelope_ok: bool, winner: dict | None = None) -> str:
+    """The per-bucket score dtype: 'int16' or 'int32'.
+
+    `envelope_ok` is the bucket's overflow proof — False always means
+    int32. `winner` is an optional autotuner table entry whose measured
+    `dtype` wins under the auto posture (a bucket where narrow measured
+    slower stays wide)."""
+    if not envelope_ok:
+        return "int32"
+    mode = dtype_mode()
+    if mode == "int32":
+        return "int32"
+    if mode == "auto" and winner and winner.get("dtype") in ("int16",
+                                                            "int32"):
+        return winner["dtype"]
+    return "int16"

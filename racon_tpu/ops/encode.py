@@ -41,6 +41,58 @@ def encode_padded(seqs: list[bytes], length: int) -> tuple[np.ndarray, np.ndarra
     return out, lens
 
 
+def packable(codes: np.ndarray, lens: np.ndarray) -> bool:
+    """True when a [B, L] code batch is exactly reconstructible from its
+    2-bit packing: every in-length code is ACGT (< 4) and every
+    beyond-length position is PAD. N/IUPAC operands (code 4) stay int8 —
+    2 bits cannot carry them."""
+    pos = np.arange(codes.shape[1])[None, :]
+    valid = pos < np.asarray(lens).reshape(-1, 1)
+    return bool(np.all(np.where(valid, codes < 4, codes == PAD)))
+
+
+def pack_2bit(codes: np.ndarray) -> np.ndarray:
+    """[B, L] int8 codes -> [B, ceil(L/4)] uint8, 4 bases per byte
+    (base i in bits 2i..2i+1). Codes >= 4 pack as their low 2 bits —
+    callers gate with `packable` (PAD positions are restored from
+    lengths on unpack, so their packed value is immaterial)."""
+    b, l = codes.shape
+    l4 = (l + 3) // 4 * 4
+    arr = np.zeros((b, l4), dtype=np.uint8)
+    arr[:, :l] = codes.astype(np.uint8) & 3
+    arr = arr.reshape(b, l4 // 4, 4)
+    return (arr[..., 0] | (arr[..., 1] << 2) | (arr[..., 2] << 4)
+            | (arr[..., 3] << 6))
+
+
+def unpack_2bit_jax(packed, length: int, lens=None, pad: int = PAD):
+    """Device-side inverse of `pack_2bit` (jax ops, runs inside the
+    jitted program before the DP kernel): [B, W] uint8 -> [B, length]
+    int8 codes, with positions >= lens restored to `pad` when `lens`
+    is given — byte-identical to the int8 operand the kernel would
+    otherwise have received. The unpack is a handful of vector shifts,
+    while the host->device transfer it replaces shrinks 4x."""
+    import jax.numpy as jnp
+
+    shifts = jnp.arange(4, dtype=jnp.uint8) * 2
+    v = (packed[:, :, None] >> shifts[None, None, :]) & 3     # [B, W, 4]
+    v = v.reshape(packed.shape[0], -1)[:, :length].astype(jnp.int8)
+    if lens is not None:
+        pos = jnp.arange(length, dtype=jnp.int32)[None, :]
+        v = jnp.where(pos < lens.astype(jnp.int32)[:, None], v,
+                      jnp.int8(pad))
+    return v
+
+
+def pack_bases_enabled() -> bool:
+    """2-bit operand packing posture: on unless RACON_TPU_PACK_BASES=0
+    (the bisection knob — packing is byte-identical by construction,
+    this exists to A/B the transfer win and to pin identity in tests)."""
+    import os
+
+    return os.environ.get("RACON_TPU_PACK_BASES", "auto") not in ("0",)
+
+
 def phred_weights(quality: bytes | None, length: int, pad_to: int) -> np.ndarray:
     """Phred+33 quality -> int32 weights (char - 33), like the reference GPU
     path (src/cuda/cudabatch.cpp:182-191). None -> weight 1 per base (spoa's

@@ -92,7 +92,7 @@ _NEG = -(1 << 29)
 @functools.lru_cache(maxsize=None)
 def fused_raw(n_nodes: int, seq_len: int, depth: int, max_pred: int,
               match: int, mismatch: int, gap: int,
-              banded_only: bool = False):
+              banded_only: bool = False, score_dtype: str = "int32"):
     """Raw (traceable, un-jitted) whole-window POA builder for one
     (N, L, D, P) shape — `fused_builder` jits it for single-device
     dispatch; FusedPOA's BatchRunner shard_maps it for multi-chip
@@ -117,7 +117,11 @@ def fused_raw(n_nodes: int, seq_len: int, depth: int, max_pred: int,
 
     N, L, D, P = n_nodes, seq_len, depth, max_pred
     C = N  # column capacity
-    NEG = jnp.int32(_NEG)
+    #: DP score dtype — int16 halves the per-layer DP carry when the
+    #: envelope proof holds (ops/dtypes.poa_int16_ok; the graph/ingest
+    #: arrays keep their own dtypes — only the alignment DP narrows)
+    DT = jnp.int16 if score_dtype == "int16" else jnp.int32
+    NEG = jnp.asarray(-(1 << 14) if score_dtype == "int16" else _NEG, DT)
     MAXKEY = jnp.int64(1) << 44  # composite (key << 11 | id) must fit i64
 
     def dp_align(codes_r, preds_r, sinks_r, centers_r, band, seq, slen, B,
@@ -130,11 +134,11 @@ def fused_raw(n_nodes: int, seq_len: int, depth: int, max_pred: int,
         # into a side carry as rows retire
         W = RING
         jidx = jnp.arange(L + 1, dtype=jnp.int32)
-        h0 = jnp.where(jidx[None, :] <= slen[:, None], jidx[None, :] * gap,
-                       NEG).astype(jnp.int32)
-        H = jnp.full((B, W + 1, L + 1), NEG, dtype=jnp.int32)
+        jg = (jidx * gap).astype(DT)
+        h0 = jnp.where(jidx[None, :] <= slen[:, None], jg[None, :], NEG)
+        H = jnp.full((B, W + 1, L + 1), NEG, dtype=DT)
         H = H.at[:, 0, :].set(h0)
-        scores0 = jnp.full((B, N), NEG, dtype=jnp.int32)
+        scores0 = jnp.full((B, N), NEG, dtype=DT)
         band2 = (band // 2).astype(jnp.int32)
 
         def step(carry, xs):
@@ -146,7 +150,7 @@ def fused_raw(n_nodes: int, seq_len: int, depth: int, max_pred: int,
             rows = jnp.take_along_axis(H, pk[:, :, None], axis=1)
             rows = jnp.where((preds_k >= 0)[:, :, None], rows, NEG)
             sub = jnp.where(seq == code_k[:, None], match,
-                            mismatch).astype(jnp.int32)
+                            mismatch).astype(DT)
             diag = rows[:, :, :-1] + sub[:, None, :]
             vert = rows[:, :, 1:] + gap
             best = jnp.max(jnp.maximum(diag, vert), axis=1)
@@ -162,7 +166,7 @@ def fused_raw(n_nodes: int, seq_len: int, depth: int, max_pred: int,
             pre = jnp.where(inb, best, NEG)
             seed0 = jnp.where(jlo == 1, row0, NEG)
             cat = jnp.concatenate([seed0[:, None], pre], axis=1)
-            run = jax.lax.cummax(cat - jidx * gap, axis=1) + jidx * gap
+            run = jax.lax.cummax(cat - jg, axis=1) + jg
             hrow = jnp.where(inb, run[:, 1:], pre)
             new_row = jnp.concatenate([row0[:, None], hrow], axis=1)
 
@@ -522,13 +526,13 @@ def fused_raw(n_nodes: int, seq_len: int, depth: int, max_pred: int,
 @functools.lru_cache(maxsize=None)
 def fused_builder(n_nodes: int, seq_len: int, depth: int, max_pred: int,
                   match: int, mismatch: int, gap: int,
-                  banded_only: bool = False):
+                  banded_only: bool = False, score_dtype: str = "int32"):
     """Single-device jitted variant of `fused_raw` (multi-chip dispatch
     goes through BatchRunner.run on the raw function instead)."""
     import jax
 
     run = fused_raw(n_nodes, seq_len, depth, max_pred, match, mismatch,
-                    gap, banded_only=banded_only)
+                    gap, banded_only=banded_only, score_dtype=score_dtype)
     # donate the state buffers on accelerators so chained calls mutate in
     # place instead of allocating a second copy of the graph arrays (the
     # CPU test backend can't donate and would warn on every call)
@@ -617,6 +621,19 @@ class FusedPOA:
         # -b / banded-only: trust banded DP results (skip the clipped ->
         # full-DP retry), the reference's GPU-only speed/accuracy trade
         self.banded_only = banded_only
+        # score-dtype plan for this engine's single (N, L) envelope:
+        # int16 when the overflow proof holds (ops/dtypes; the third
+        # engine dispatcher consulting the autotuner table — the fused
+        # engine has no pallas variant, so only the dtype half applies)
+        from .dtypes import kernel_plan, poa_int16_ok
+        from .poa_pallas import pallas_mode
+
+        _, self.score_dtype = kernel_plan(
+            pallas_mode(), "fused", (self.N, self.L),
+            (self.match, self.mismatch, self.gap, self.P),
+            poa_int16_ok(self.N, self.L, self.match, self.mismatch,
+                         self.gap),
+            lambda dt: False)  # no pallas variant: dtype half only
         self._code_of = np.full(256, 4, dtype=np.int8)
         for i, b in enumerate(b"ACGT"):
             self._code_of[b] = i
@@ -635,14 +652,16 @@ class FusedPOA:
         if self.runner.sharding is not None:
             raw = fused_raw(self.N, self.L, d, self.P, self.match,
                             self.mismatch, self.gap,
-                            banded_only=self.banded_only)
+                            banded_only=self.banded_only,
+                            score_dtype=self.score_dtype)
             out = self.runner.run(raw, *state, seqs, lens, wts, rlo,
                                   rhi, band, lbase,
                                   donate_argnums=tuple(range(11)))
         else:
             fn = fused_builder(self.N, self.L, d, self.P, self.match,
                                self.mismatch, self.gap,
-                               banded_only=self.banded_only)
+                               banded_only=self.banded_only,
+                               score_dtype=self.score_dtype)
             out = fn(*state, seqs, lens, wts, rlo, rhi, band, lbase)
         # first-dispatch compile telemetry (shared record_compile_once
         # idiom); the key is the full program identity
@@ -650,7 +669,7 @@ class FusedPOA:
             "fused",
             (self.N, self.L, d, self.P, self.match, self.mismatch,
              self.gap, self.banded_only, self.B,
-             self.runner.sharding is not None),
+             self.runner.sharding is not None, self.score_dtype),
             time.perf_counter() - t0)
         return out
 
@@ -842,7 +861,8 @@ class FusedPOA:
                     lanes=self.B,
                     useful_cells=sum(min(max(0, dep - done), d)
                                      for dep in depths),
-                    total_cells=self.B * d)
+                    total_cells=self.B * d,
+                    kernel="xla", dtype=self.score_dtype)
             pl.stats.bump("launches", len(calls))
             return state
 

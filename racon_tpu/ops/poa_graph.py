@@ -225,21 +225,26 @@ def max_pred_distance(preds: np.ndarray) -> int:
     return int(np.where(preds > 0, k1 - preds, 0).max(initial=0))
 
 
-def _mark_compiled(eng, nb: int, lb: int, ring_ok: bool,
-                   seconds: float) -> None:
+def _mark_compiled(eng, nb: int, lb: int, ring_ok: bool, seconds: float,
+                   kernel: str = "xla", dtype: str = "int32",
+                   packed: bool = False) -> None:
     """First-dispatch compile telemetry (the shared OccupancyStats
     record_compile_once idiom): the key is the full program identity —
-    bucket shape, pinned batch width, ring variant, scoring, engine."""
+    bucket shape, pinned batch width, ring variant, scoring, engine,
+    and the kernel-plane choices (pallas/xla, score dtype, packed
+    operands) that each compile a distinct program."""
     eng.sched.stats.record_compile_once(
         "session",
         (nb, lb, eng.batch_rows.get((nb, lb)), bool(ring_ok),
-         eng.match, eng.mismatch, eng.gap, eng.max_pred, eng.use_pallas),
+         eng.match, eng.mismatch, eng.gap, eng.max_pred, kernel, dtype,
+         packed),
         seconds)
 
 
 @functools.lru_cache(maxsize=None)
 def graph_aligner(n_nodes: int, seq_len: int, max_pred: int, match: int,
-                  mismatch: int, gap: int, ring: int = 0):
+                  mismatch: int, gap: int, ring: int = 0,
+                  score_dtype: str = "int32", packed_seq: bool = False):
     """Jitted batched graph-NW align + traceback for one shape bucket.
 
     Args (all leading dim B = batch; preds/centers ship as int16 — half
@@ -263,34 +268,46 @@ def graph_aligner(n_nodes: int, seq_len: int, max_pred: int, match: int,
     preds and falls back to the full-carry program otherwise). Results
     are bit-identical between the two variants; per-node sink scores are
     collected into a side carry as rows retire.
+
+    `score_dtype='int16'` halves the DP carry and backpointer-source
+    rows (legal only under ops/dtypes.poa_int16_ok's per-bucket
+    overflow proof; bit-identical by construction). `packed_seq` takes
+    the layer bases 2-bit packed ([B, L//4] uint8, encode.pack_2bit)
+    and unpacks + pad-restores them on device from `lens` — a 4x cut in
+    per-layer sequence traffic for ACGT-only windows.
     """
     import jax
     import jax.numpy as jnp
 
     N, L, P = n_nodes, seq_len, max_pred
-    NEG = jnp.int32(_NEG)
+    DT = jnp.int16 if score_dtype == "int16" else jnp.int32
+    NEG = jnp.asarray(-(1 << 14) if score_dtype == "int16" else _NEG, DT)
     W = ring
 
     def align(codes, preds, centers, sinks, seq, lens, band):
         B = codes.shape[0]
+        if packed_seq:
+            from .encode import unpack_2bit_jax
+
+            seq = unpack_2bit_jax(seq, L, lens)
         preds = preds.astype(jnp.int32)
         centers = centers.astype(jnp.int32)
         jidx = jnp.arange(L + 1, dtype=jnp.int32)
+        jg = (jidx * gap).astype(DT)
         l32 = lens.astype(jnp.int32)
         band2 = (band // 2).astype(jnp.int32)
 
         # virtual source row: D[0][j] = j*gap within the layer, NEG beyond
-        h0 = jnp.where(jidx[None, :] <= l32[:, None], jidx[None, :] * gap,
-                       NEG).astype(jnp.int32)
+        h0 = jnp.where(jidx[None, :] <= l32[:, None], jg[None, :], NEG)
         if W:
             # ring carry: slot 0 = virtual source (always resident), slot
             # 1 + (r-1) % W = DP row r; scores side-carry collects each
             # row's sink-column value as it is produced
-            H = jnp.full((B, W + 1, L + 1), NEG, dtype=jnp.int32)
+            H = jnp.full((B, W + 1, L + 1), NEG, dtype=DT)
             H = H.at[:, 0, :].set(h0)
-            scores0 = jnp.full((B, N), NEG, dtype=jnp.int32)
+            scores0 = jnp.full((B, N), NEG, dtype=DT)
         else:
-            H = jnp.full((B, N + 1, L + 1), NEG, dtype=jnp.int32)
+            H = jnp.full((B, N + 1, L + 1), NEG, dtype=DT)
             H = H.at[:, 0, :].set(h0)
 
         def step(carry, xs):
@@ -309,7 +326,7 @@ def graph_aligner(n_nodes: int, seq_len: int, max_pred: int, match: int,
             rows = jnp.take_along_axis(H, pk[:, :, None], axis=1)
             rows = jnp.where((preds_k >= 0)[:, :, None], rows, NEG)
             sub = jnp.where(seq == code_k[:, None], match,
-                            mismatch).astype(jnp.int32)          # [B, L]
+                            mismatch).astype(DT)                 # [B, L]
             diag = rows[:, :, :-1] + sub[:, None, :]             # [B, P, L]
             vert = rows[:, :, 1:] + gap                          # [B, P, L]
             best = jnp.max(jnp.maximum(diag, vert), axis=1)      # [B, L]
@@ -328,7 +345,7 @@ def graph_aligner(n_nodes: int, seq_len: int, max_pred: int, match: int,
             pre = jnp.where(inband, best, NEG)
             seed0 = jnp.where(jlo == 1, row0, NEG)
             cat = jnp.concatenate([seed0[:, None], pre], axis=1)
-            run = jax.lax.cummax(cat - jidx * gap, axis=1) + jidx * gap
+            run = jax.lax.cummax(cat - jg, axis=1) + jg
             hrow = jnp.where(inband, run[:, 1:], pre)
             new_row = jnp.concatenate([row0[:, None], hrow], axis=1)
 
@@ -458,11 +475,24 @@ class DeviceGraphPOA:
         # + sorted packing when armed, occupancy telemetry always
         self.sched = (scheduler if scheduler is not None
                       else BatchScheduler.from_env())
-        #: RACON_TPU_PALLAS=1 routes VMEM-sized buckets through the
-        #: resident pallas window-sweep kernel (ops/poa_pallas.py) instead
-        #: of the XLA scan program — experimental until profiled on chip
-        self.use_pallas = (bool(os.environ.get("RACON_TPU_PALLAS"))
-                           if use_pallas is None else use_pallas)
+        #: RACON_TPU_PALLAS routes VMEM-sized buckets through the
+        #: resident pallas window-sweep kernel (ops/poa_pallas.py)
+        #: instead of the XLA scan program: `1` = always (when the VMEM
+        #: envelope fits), `auto` = per-bucket via the persisted
+        #: autotuner winner table (sched/autotune; no entry -> XLA,
+        #: today's default), unset/0 = off. The constructor bool forces
+        #: on/off for tests.
+        from .poa_pallas import pallas_mode
+
+        if use_pallas is None:
+            self.pallas_posture = pallas_mode()
+        else:
+            self.pallas_posture = "on" if use_pallas else "off"
+        self.use_pallas = self.pallas_posture != "off"
+        #: per-bucket (use_pallas, score_dtype) dispatch plans, resolved
+        #: lazily (the autotuner table / envelope proofs don't change
+        #: within a run)
+        self._plans: dict = {}
 
         self.match = match
         self.mismatch = mismatch
@@ -557,12 +587,7 @@ class DeviceGraphPOA:
         if windows is not None:
             self.adapt(windows)
         for (nb, lb) in self.buckets:
-            t0 = time.perf_counter()
             B = self.batch_rows[(nb, lb)]
-            fn = self._pallas_kernel(nb, lb)
-            wants_nnodes = fn is not None
-            if fn is None:
-                fn = self._scan_kernel(nb, lb)
             # a valid tiny problem: linear 2-node chain, 2-base layer
             codes = np.full((B, nb), 5, dtype=np.int8)
             codes[:, :2] = 0
@@ -577,16 +602,25 @@ class DeviceGraphPOA:
             seq[:, :2] = 0
             lens = np.full(B, 2, dtype=np.int32)
             band = np.zeros(B, dtype=np.int32)
-            if wants_nnodes:
-                out = self._run_pallas(fn, codes, preds, centers, sinks,
-                                       seq, lens, band,
-                                       np.full(B, 2, dtype=np.int32))
-            else:
-                out = self.runner.run(fn, codes, preds, centers, sinks,
-                                      seq, lens, band)
+            # through the run's own dispatch entry point, so the warmed
+            # program (kernel choice, dtype, packing) is EXACTLY the one
+            # the scheduling loop will request
+            nnodes = np.full(B, 2, dtype=np.int32)
+            out = self._run_bucket(nb, lb, codes, preds, centers, sinks,
+                                   seq, lens, band, nnodes)
             _materialize(out)  # block
-            _mark_compiled(self, nb, lb, ring_ok=True,
-                           seconds=time.perf_counter() - t0)
+            from .encode import pack_bases_enabled
+
+            if pack_bases_enabled():
+                # the ACGT-only job above warmed the packed-operand
+                # program; real data carries N/IUPAC windows whose
+                # batches request the UNPACKED variant — a distinct
+                # program that must not compile cold mid-run
+                seq_n = seq.copy()
+                seq_n[:, 1] = 4
+                out = self._run_bucket(nb, lb, codes, preds, centers,
+                                       sinks, seq_n, lens, band, nnodes)
+                _materialize(out)
 
     def _bucket(self, n_nodes: int, length: int) -> tuple[int, int]:
         return next((nb, lb) for nb, lb in self.buckets
@@ -733,31 +767,38 @@ class DeviceGraphPOA:
                 # occupancy recorded AFTER the dispatch call returned
                 # (the aligner's discipline: a batch killed before the
                 # device saw it must not be accounted as device work)
+                use_pallas, dtype = self._plan(nb, lb)
                 self.sched.stats.record(
                     "session", (nb, lb), jobs=len(part), lanes=B,
                     useful_cells=int(
                         (jobs["nnodes"][sel].astype(np.int64)
                          * (jobs["len"][sel].astype(np.int64) + 1)).sum()),
-                    total_cells=B * nb * (lb + 1))
+                    total_cells=B * nb * (lb + 1),
+                    kernel="pallas" if use_pallas else "xla", dtype=dtype)
                 batches.append(meta + (len(part), lb, out))
         return batches
 
-    def _pallas_kernel(self, nb, lb):
-        """The pallas resident-window sweep for a bucket, or None when it
-        is disabled or the bucket exceeds the VMEM budget."""
-        if not self.use_pallas:
-            return None
-        from .poa_pallas import fits_vmem, window_sweep
+    def _plan(self, nb, lb) -> tuple[bool, str]:
+        """(use_pallas, score_dtype) for one bucket — the kernel-plane
+        dispatch decision: the Pallas posture (forced / env / the
+        persisted autotuner winner table under `auto`), the corrected
+        VMEM envelope gate, and the dtype-shrinking proof
+        (ops/dtypes.poa_int16_ok; int32 whenever it fails)."""
+        plan = self._plans.get((nb, lb))
+        if plan is None:
+            from .dtypes import kernel_plan, poa_int16_ok
+            from .poa_pallas import fits_vmem
 
-        if not fits_vmem(nb, lb):
-            return None
-        import jax
+            plan = self._plans[(nb, lb)] = kernel_plan(
+                self.pallas_posture, "session", (nb, lb),
+                (self.match, self.mismatch, self.gap, self.max_pred),
+                poa_int16_ok(nb, lb, self.match, self.mismatch, self.gap),
+                lambda dt: fits_vmem(nb, lb, self.max_pred, dt))
+        return plan
 
-        interp = jax.default_backend() == "cpu"
-        return window_sweep(nb, lb, self.max_pred, self.match,
-                            self.mismatch, self.gap, interpret=interp)
-
-    def _scan_kernel(self, nb, lb, ring_ok: bool = True):
+    def _scan_kernel(self, nb, lb, ring_ok: bool = True,
+                     score_dtype: str = "int32",
+                     packed_seq: bool = False):
         """The XLA scan program for a bucket: ring-carried (last RING rows
         only, ~nb/RING smaller carry) when every predecessor in the batch
         is within RING ranks, full-carry otherwise (lazy-compiled; see
@@ -767,8 +808,73 @@ class DeviceGraphPOA:
             self._warned_full = True
             log_info("[racon_tpu::DeviceGraphPOA] long back-edge batch: "
                      "using the full-carry DP program")
+        # default-valued kwargs are omitted so the lru key (and thus the
+        # jit cache entry) is shared with plain graph_aligner(...) calls
+        kwargs: dict = {}
+        if score_dtype != "int32":
+            kwargs["score_dtype"] = score_dtype
+        if packed_seq:
+            kwargs["packed_seq"] = True
         return graph_aligner(nb, lb, self.max_pred, self.match,
-                             self.mismatch, self.gap, ring=ring)
+                             self.mismatch, self.gap, ring=ring, **kwargs)
+
+    def _run_bucket(self, nb, lb, codes, preds, centers, sinks, seqs,
+                    lens, band, nnodes):
+        """Dispatch ONE padded batch through the bucket's planned
+        program — the single device entry point shared by precompile()
+        and the scheduling loop, so the programs warmed up front are
+        exactly the programs the run requests. Handles the kernel
+        choice (pallas/XLA), the score dtype, 2-bit operand packing
+        (ACGT-only batches; the XLA path packs the layer bases, the
+        pallas path additionally packs the node codes — it carries the
+        per-job node counts the restore needs) and the first-dispatch
+        compile telemetry."""
+        import time
+
+        import jax
+
+        from .encode import pack_2bit, pack_bases_enabled, packable
+
+        use_pallas, dtype = self._plan(nb, lb)
+        can_pack = pack_bases_enabled() and packable(seqs, lens)
+        t0 = time.perf_counter()
+        if use_pallas:
+            from .poa_pallas import window_sweep
+
+            packed = can_pack and packable(codes, nnodes)
+            # default kwargs omitted: lru/jit keys shared with direct
+            # window_sweep(...) calls (profiling, tests)
+            kwargs: dict = {}
+            if dtype != "int32":
+                kwargs["score_dtype"] = dtype
+            if packed:
+                kwargs["packed"] = True
+            fn = window_sweep(nb, lb, self.max_pred, self.match,
+                              self.mismatch, self.gap,
+                              interpret=jax.default_backend() == "cpu",
+                              **kwargs)
+            c = pack_2bit(codes) if packed else codes
+            s = pack_2bit(seqs) if packed else seqs
+            # pallas path: per-job real node count bounds its row sweep
+            out = self._run_pallas(fn, c, preds, centers, sinks, s,
+                                   lens, band, nnodes)
+            _mark_compiled(self, nb, lb, True,
+                           time.perf_counter() - t0, kernel="pallas",
+                           dtype=dtype, packed=packed)
+            return out
+        # ring validity: every predecessor within RING ranks of its node
+        # (measured: 29 lambda / 72 synthbench, see RING; the full-carry
+        # program covers the rare batch that exceeds it)
+        ring_ok = max_pred_distance(preds) <= RING
+        fn = self._scan_kernel(nb, lb, ring_ok=ring_ok, score_dtype=dtype,
+                               packed_seq=can_pack)
+        s = pack_2bit(seqs) if can_pack else seqs
+        out = self.runner.run(fn, codes, preds, centers, sinks, s,
+                              lens, band)
+        _mark_compiled(self, nb, lb, ring_ok,
+                       seconds=time.perf_counter() - t0, dtype=dtype,
+                       packed=can_pack)
+        return out
 
     def _dispatch(self, jobs, sel, nb, lb, B):
         pad = B - len(sel)
@@ -781,45 +887,17 @@ class DeviceGraphPOA:
                                   dtype=out.dtype)])
             return out
 
-        codes = take(jobs["codes"][:, :nb], 5)
-        preds = take(jobs["preds"][:, :nb, :self.max_pred], -1)
-        centers = take(jobs["centers"][:, :nb], 0)
-        sinks = take(jobs["sinks"][:, :nb], 0)
-        seqs = take(jobs["seqs"][:, :lb], 5)
-        lens = take(jobs["len"], 0)
-        band = take(jobs["band"], 0)
-        fn = self._pallas_kernel(nb, lb)
-        if fn is not None:
-            # pallas path: per-job real node count bounds its row sweep
-            return self._run_pallas(fn, codes, preds, centers, sinks,
-                                    seqs, lens, band,
-                                    take(jobs["nnodes"], 0))
-        # ring validity: every predecessor within RING ranks of its node
-        # (measured: 29 lambda / 72 synthbench, see RING; the full-carry
-        # program covers the rare batch that exceeds it)
-        import time
-
-        ring_ok = max_pred_distance(preds) <= RING
-        fn = self._scan_kernel(nb, lb, ring_ok=ring_ok)
-        t0 = time.perf_counter()
-        out = self.runner.run(fn, codes, preds, centers, sinks, seqs,
-                              lens, band)
-        _mark_compiled(self, nb, lb, ring_ok,
-                       seconds=time.perf_counter() - t0)
-        return out
+        return self._run_bucket(
+            nb, lb, take(jobs["codes"][:, :nb], 5),
+            take(jobs["preds"][:, :nb, :self.max_pred], -1),
+            take(jobs["centers"][:, :nb], 0),
+            take(jobs["sinks"][:, :nb], 0),
+            take(jobs["seqs"][:, :lb], 5),
+            take(jobs["len"], 0), take(jobs["band"], 0),
+            take(jobs["nnodes"], 0))
 
     def _run_pallas(self, fn, *args):
-        """Run the pallas sweep across every device: the grid is
-        sequential per core, so the batch is split device-wise (the
-        batch width is already a multiple of n_devices, _pin_batch) and
-        each shard dispatched async to its chip — the multi-GPU
-        batch-per-device loop of cudapolisher.cpp:228-345."""
-        devs = self.runner.devices
-        if len(devs) == 1:
-            return fn(*args)
-        import jax
-
-        per = args[0].shape[0] // len(devs)
-        return [fn(*(jax.device_put(a[i * per:(i + 1) * per], d)
-                     for a in args))
-                for i, d in enumerate(devs)]
+        """Run the pallas sweep across every device (the batch width is
+        already a multiple of n_devices, _pin_batch) — the shared
+        per-device split both kernel planes use."""
+        return self.runner.run_split(fn, *args)

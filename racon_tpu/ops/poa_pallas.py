@@ -34,23 +34,56 @@ budget cannot hold.
 from __future__ import annotations
 
 import functools
+import os
 
 _NEG = -(1 << 29)
+_NEG16 = -(1 << 14)
 
-#: VMEM the resident job may use (scores + backpointers + slack); the
-#: largest session bucket (2048, 640) needs ~10.6 MB of the ~16 MB
+#: VMEM the resident job may use (scores + backpointers + operand
+#: blocks + slack); the largest session bucket (2048, 640) needs
+#: ~10.6 MB of the ~16 MB
 VMEM_BUDGET = 14 << 20
 
 
-def fits_vmem(n_nodes: int, seq_len: int) -> bool:
-    h = (n_nodes + 1) * (seq_len + 1) * 4
-    bps = n_nodes * (seq_len + 1) * 4
-    return h + bps + (1 << 20) <= VMEM_BUDGET
+def pallas_mode() -> str:
+    """RACON_TPU_PALLAS posture shared by every engine dispatcher:
+    'off' (unset/0 — XLA programs only, today's default), 'on' (`1` —
+    the Pallas kernel whenever the VMEM envelope fits), or 'auto'
+    (consult the persisted per-bucket winner table, sched/autotune;
+    buckets without a measured entry dispatch XLA exactly as off)."""
+    raw = (os.environ.get("RACON_TPU_PALLAS") or "").strip().lower()
+    if not raw or raw == "0":
+        return "off"
+    if raw == "auto":
+        return "auto"
+    return "on"
+
+
+def fits_vmem(n_nodes: int, seq_len: int, max_pred: int = 8,
+              score_dtype: str = "int32") -> bool:
+    """True when one (window, layer) job is resident-VMEM feasible.
+
+    Budgets EVERYTHING `window_sweep` places in VMEM, not only the
+    scratch: the H score matrix (at the chosen dtype), the int8
+    backpointer matrix, AND the per-grid-step operand blocks — codes,
+    preds [1, N, P], centers, sinks, seq, the rank output — which the
+    BlockSpecs stage as int32 (the original accounting omitted the
+    operands entirely, under-budgeting the envelope bucket by ~15%%).
+    The aligner kernel's envelope check (ops/align_pallas.fits_vmem)
+    shares this discipline and the same budget constant."""
+    dbytes = 2 if score_dtype == "int16" else 4
+    h = (n_nodes + 1) * (seq_len + 1) * dbytes
+    bps = n_nodes * (seq_len + 1)                     # int8 plane
+    operands = (3 * n_nodes                           # codes/centers/sinks
+                + n_nodes * max_pred                  # preds
+                + 2 * seq_len) * 4                    # seq + rank output
+    return h + bps + operands + (1 << 20) <= VMEM_BUDGET
 
 
 @functools.lru_cache(maxsize=None)
 def window_sweep(n_nodes: int, seq_len: int, max_pred: int, match: int,
-                 mismatch: int, gap: int, interpret: bool = False):
+                 mismatch: int, gap: int, interpret: bool = False,
+                 score_dtype: str = "int32", packed: bool = False):
     """Jitted fn(codes, preds, centers, sinks, seq, lens, band, nnodes)
     -> ranks [B, L] i32, one grid step per batch row.
 
@@ -59,6 +92,13 @@ def window_sweep(n_nodes: int, seq_len: int, max_pred: int, match: int,
     i16, sinks [B,N] u8, seq [B,L] i8, lens/band [B] i32) plus nnodes
     [B] i32 — the per-job real node count. Returns graph_aligner's rank
     encoding (node rank, -1 insertion, -2 beyond lens).
+
+    `score_dtype='int16'` halves the resident H matrix (legal only
+    under ops/dtypes.poa_int16_ok's per-bucket overflow proof —
+    bit-identical results by construction). `packed` takes 2-bit packed
+    codes/seq ([B, N//4] / [B, L//4] uint8, encode.pack_2bit) and
+    unpacks + pad-restores them with XLA ops before the kernel — a 4x
+    cut in node/sequence transfer for ACGT-only windows.
     """
     import jax
     import jax.numpy as jnp
@@ -66,17 +106,19 @@ def window_sweep(n_nodes: int, seq_len: int, max_pred: int, match: int,
     from jax.experimental.pallas import tpu as pltpu
 
     N, L, P = n_nodes, seq_len, max_pred
+    DT = jnp.int16 if score_dtype == "int16" else jnp.int32
 
     def kernel(scal_ref, codes_ref, preds_ref, centers_ref, sinks_ref,
                seq_ref, out_ref, H, bps):
-        NEG = jnp.int32(_NEG)
+        NEG = jnp.asarray(_NEG16 if score_dtype == "int16" else _NEG, DT)
         slen = scal_ref[0, 0]
         band = scal_ref[0, 1]
         nn = scal_ref[0, 2]
         jidx = jax.lax.broadcasted_iota(jnp.int32, (1, L + 1), 1)
+        jg = (jidx * gap).astype(DT)
 
         # virtual source row: D[0][j] = j*gap within the layer
-        H[0:1, :] = jnp.where(jidx <= slen, jidx * gap, NEG)
+        H[0:1, :] = jnp.where(jidx <= slen, jg, NEG)
 
         seq2 = seq_ref[0:1, :]                                  # [1, L]
         band2 = band // 2
@@ -86,7 +128,7 @@ def window_sweep(n_nodes: int, seq_len: int, max_pred: int, match: int,
             code_k = codes_ref[0, k - 1]
             center_k = centers_ref[0, k - 1]
 
-            rows = jnp.full((P, L + 1), NEG, dtype=jnp.int32)
+            rows = jnp.full((P, L + 1), NEG, dtype=DT)
             for p in range(P):                       # static P, unrolled
                 pr = preds_ref[0, k - 1, p]
                 r2 = H[pl.ds(jnp.maximum(pr, 0), 1), :]         # [1, L+1]
@@ -94,7 +136,7 @@ def window_sweep(n_nodes: int, seq_len: int, max_pred: int, match: int,
                     rows, jnp.where(pr >= 0, r2, NEG), (p, 0))
 
             sub = jnp.where(seq2 == code_k, match,
-                            mismatch).astype(jnp.int32)         # [1, L]
+                            mismatch).astype(DT)                # [1, L]
             diag = rows[:, :-1] + sub                           # [P, L]
             vert = rows[:, 1:] + gap
             best = jnp.max(jnp.maximum(diag, vert), axis=0,
@@ -111,17 +153,17 @@ def window_sweep(n_nodes: int, seq_len: int, max_pred: int, match: int,
             cat = jnp.concatenate([seed0, pre], axis=1)         # [1, L+1]
             # in-row gap recurrence: running max via Hillis-Steele
             # doubling (deterministic TPU lowering; log2(L+1) steps)
-            x = cat - jidx * gap
+            x = cat - jg
             s = 1
             while s <= L:
                 shifted = jnp.concatenate(
-                    [jnp.full((1, s), NEG, jnp.int32), x[:, :-s]], axis=1)
+                    [jnp.full((1, s), NEG, DT), x[:, :-s]], axis=1)
                 x = jnp.maximum(x, shifted)
                 s <<= 1
-            run = x + jidx * gap
+            run = x + jg
             hrow = jnp.where(inb, run[:, 1:], pre)              # [1, L]
             new_row = jnp.concatenate(
-                [jnp.full((1, 1), row0, jnp.int32), hrow], axis=1)
+                [jnp.full((1, 1), row0, DT), hrow], axis=1)
 
             # backpointers, graph_aligner's encoding and tie order:
             # diagonal via pred p -> p; vertical via pred p -> P+p;
@@ -137,8 +179,10 @@ def window_sweep(n_nodes: int, seq_len: int, max_pred: int, match: int,
             is_v0 = (row0 == rows[:, 0:1] + gap)                # [P, 1]
             bp0 = (P + jnp.argmax(is_v0, axis=0)).reshape(1, 1)
             H[pl.ds(k, 1), :] = new_row
+            # codes <= 2P <= 16: an int8 plane, a quarter of the int32
+            # footprint the first cut of this kernel budgeted
             bps[pl.ds(k - 1, 1), :] = jnp.concatenate(
-                [bp0.astype(jnp.int32), bpc], axis=1)
+                [bp0, bpc], axis=1).astype(jnp.int8)
             return carry
 
         jax.lax.fori_loop(1, nn + 1, row, 0)
@@ -159,7 +203,8 @@ def window_sweep(n_nodes: int, seq_len: int, max_pred: int, match: int,
         def tb_body(st):
             r, j = st
             code = jnp.where(r > 0,
-                             bps[jnp.maximum(r - 1, 0), jnp.maximum(j, 0)],
+                             bps[jnp.maximum(r - 1, 0),
+                                 jnp.maximum(j, 0)].astype(jnp.int32),
                              2 * P)
             is_diag = code < P
             is_vert = (code >= P) & (code < 2 * P)
@@ -183,6 +228,11 @@ def window_sweep(n_nodes: int, seq_len: int, max_pred: int, match: int,
                             jnp.where(nn > 0, slen, 0)))
 
     def call(codes, preds, centers, sinks, seq, lens, band, nnodes):
+        if packed:
+            from .encode import unpack_2bit_jax
+
+            codes = unpack_2bit_jax(codes, N, nnodes)
+            seq = unpack_2bit_jax(seq, L, lens)
         B = codes.shape[0]
         scal = jnp.stack([lens.astype(jnp.int32),
                           band.astype(jnp.int32),
@@ -205,8 +255,8 @@ def window_sweep(n_nodes: int, seq_len: int, max_pred: int, match: int,
                                    memory_space=vmem),
             out_shape=jax.ShapeDtypeStruct((B, L), jnp.int32),
             scratch_shapes=[
-                pltpu.VMEM((N + 1, L + 1), jnp.int32),   # H
-                pltpu.VMEM((N, L + 1), jnp.int32),       # backpointers
+                pltpu.VMEM((N + 1, L + 1), DT),          # H
+                pltpu.VMEM((N, L + 1), jnp.int8),        # backpointers
             ],
             interpret=interpret,
         )(scal, codes.astype(jnp.int32), preds.astype(jnp.int32),

@@ -58,6 +58,24 @@ class BatchRunner:
         n = self.n_devices
         return ((batch + n - 1) // n) * n
 
+    def run_split(self, fn, *arrays):
+        """Manual per-device batch split for kernels whose grid is
+        sequential per core (the Pallas resident kernels): each chip
+        gets B/N rows dispatched async — the multi-GPU batch-per-device
+        loop of cudapolisher.cpp:228-345, shared by BOTH kernel planes
+        (DeviceGraphPOA._run_pallas, align.BatchAligner). The leading
+        dim must be a multiple of n_devices (round_batch). Returns the
+        kernel's output directly on one device, else the list of
+        per-shard outputs in device order (caller concatenates)."""
+        if len(self.devices) == 1:
+            return fn(*arrays)
+        import jax
+
+        per = arrays[0].shape[0] // len(self.devices)
+        return [fn(*(jax.device_put(a[i * per:(i + 1) * per], d)
+                     for a in arrays))
+                for i, d in enumerate(self.devices)]
+
     def run(self, fn, *arrays, out_batch_axes=0, donate_argnums=()):
         """Invoke jitted `fn` on operands whose leading dim is the batch.
 

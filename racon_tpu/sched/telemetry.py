@@ -56,10 +56,16 @@ class OccupancyStats:
         self.hists = None
 
     def record(self, engine: str, bucket, jobs: int, lanes: int,
-               useful_cells: int, total_cells: int) -> None:
+               useful_cells: int, total_cells: int,
+               kernel: str | None = None, dtype: str | None = None) -> None:
         """Account one dispatched batch. `bucket` is any hashable shape
         descriptor (stringified for the snapshot); `total_cells` is the
-        batch's full dispatched capacity (>= useful_cells)."""
+        batch's full dispatched capacity (>= useful_cells). `kernel`
+        ('xla' | 'pallas') and `dtype` ('int32' | 'int16') record the
+        bucket's dispatched program choice — the device-kernel plane's
+        per-bucket decision, surfaced next to the occupancy numbers in
+        the bench JSON and synthbench report (constant per bucket within
+        a run; last write wins)."""
         key = (engine, str(bucket))
         with self._lock:
             b = self._buckets.get(key)
@@ -72,6 +78,10 @@ class OccupancyStats:
             b["lanes"] += int(lanes)
             b["useful_cells"] += int(useful_cells)
             b["padded_cells"] += int(total_cells) - int(useful_cells)
+            if kernel is not None:
+                b["kernel"] = kernel
+            if dtype is not None:
+                b["dtype"] = dtype
 
     def record_compile(self, engine: str, seconds: float,
                        count: int = 1) -> None:

@@ -104,6 +104,112 @@ def test_fits_vmem_envelope():
     assert fits_vmem(2048, 640)       # the largest session bucket
     assert fits_vmem(320, 256)
     assert not fits_vmem(4096, 1024)  # beyond the resident budget
+    # the corrected accounting includes the operand blocks: a high
+    # in-degree (preds [1, N, P] staged as int32) shrinks the envelope
+    assert fits_vmem(2048, 640, max_pred=8)
+    assert not fits_vmem(2048, 640, max_pred=1024)
+    # int16 H halves the dominant term — a shape the int32 budget
+    # rejects fits narrow
+    assert not fits_vmem(3072, 896, max_pred=8, score_dtype="int32")
+    assert fits_vmem(3072, 896, max_pred=8, score_dtype="int16")
+
+
+def test_window_sweep_dtype_and_packing_variants_match_oracle():
+    """Every (score_dtype, packed) variant of BOTH kernels must equal
+    the int32 XLA oracle on the same jobs — the dtype-shrinking and
+    base-packing identity contract (params 3,-5,-4: int16-eligible at
+    this bucket per ops/dtypes.poa_int16_ok)."""
+    from racon_tpu.ops.dtypes import poa_int16_ok
+    from racon_tpu.ops.encode import pack_2bit
+
+    rng = random.Random(53)
+    N, L, P = 96, 96, 4
+    ts, qs = [], []
+    for _ in range(5):
+        t = bytes(rng.choice(ACGT) for _ in range(rng.randint(40, N - 8)))
+        ts.append(t)
+        qs.append(mutate(rng, t, 0.15)[:L])
+    codes, preds, centers, sinks, seqs, lens, band = linear_graph_inputs(
+        ts, qs, N, L, max_pred=P)
+    # one zero-length padding row (nnodes == 0), the batch-pad shape
+    codes[-1, :] = 5
+    seqs[-1, :] = 5
+    lens[-1] = 0
+    sinks[-1, :] = 0
+    preds[-1, :, :] = -1
+    nn = _nnodes_of(codes)
+    assert poa_int16_ok(N, L, 3, -5, -4)
+
+    oracle = np.asarray(graph_aligner(N, L, P, 3, -5, -4)(
+        codes, preds, centers, sinks, seqs, lens, band))
+    for bandw in (0, 32):
+        band[:] = bandw
+        ref = np.asarray(graph_aligner(N, L, P, 3, -5, -4)(
+            codes, preds, centers, sinks, seqs, lens, band))
+        if bandw == 0:
+            np.testing.assert_array_equal(ref, oracle)
+        for dtype in ("int32", "int16"):
+            kwargs = {} if dtype == "int32" else {"score_dtype": dtype}
+            xla = graph_aligner(N, L, P, 3, -5, -4, **kwargs)
+            np.testing.assert_array_equal(
+                np.asarray(xla(codes, preds, centers, sinks, seqs, lens,
+                               band)), ref, err_msg=f"xla {dtype}")
+            xp = graph_aligner(N, L, P, 3, -5, -4, packed_seq=True,
+                               **kwargs)
+            np.testing.assert_array_equal(
+                np.asarray(xp(codes, preds, centers, sinks,
+                              pack_2bit(seqs), lens, band)), ref,
+                err_msg=f"xla packed {dtype}")
+            for packed in (False, True):
+                pk = dict(kwargs)
+                if packed:
+                    pk["packed"] = True
+                pls = window_sweep(N, L, P, 3, -5, -4, interpret=True,
+                                   **pk)
+                c = pack_2bit(codes) if packed else codes
+                s = pack_2bit(seqs) if packed else seqs
+                np.testing.assert_array_equal(
+                    np.asarray(pls(c, preds, centers, sinks, s, lens,
+                                   band, nn)), ref,
+                    err_msg=f"pallas {dtype} packed={packed} "
+                            f"band={bandw}")
+
+
+def test_int16_identical_at_envelope_boundary_scores():
+    """Scores sitting just under the int16 envelope bound: scoring
+    params of magnitude 100 put real path scores within ~1% of the
+    NEG16 sentinel at this shape — the proof's worst case — and the
+    narrow DP must still be bit-identical to int32 (banded AND full
+    DP, both kernels)."""
+    from racon_tpu.ops.dtypes import poa_int16_ok
+
+    N, L, P = 96, 64, 4
+    m, mm, g = 100, -100, -100
+    assert poa_int16_ok(N, L, m, mm, g)          # (162)*100 <= 16383
+    assert not poa_int16_ok(N + 2, L, m, mm, g)  # one row past the bound
+
+    rng = random.Random(61)
+    ts, qs = [], []
+    for _ in range(4):
+        t = bytes(rng.choice(ACGT) for _ in range(N - 10))
+        ts.append(t)
+        qs.append(mutate(rng, t, 0.2)[:L])
+    qs[0] = b"A" * L if ts[0][:1] != b"A" else b"C" * L  # worst mismatch run
+    codes, preds, centers, sinks, seqs, lens, band = linear_graph_inputs(
+        ts, qs, N, L, max_pred=P)
+    nn = _nnodes_of(codes)
+    for bandw in (0, 16):
+        band[:] = bandw
+        ref = np.asarray(graph_aligner(N, L, P, m, mm, g)(
+            codes, preds, centers, sinks, seqs, lens, band))
+        narrow = np.asarray(graph_aligner(N, L, P, m, mm, g,
+                                          score_dtype="int16")(
+            codes, preds, centers, sinks, seqs, lens, band))
+        np.testing.assert_array_equal(narrow, ref)
+        pls = np.asarray(window_sweep(N, L, P, m, mm, g, interpret=True,
+                                      score_dtype="int16")(
+            codes, preds, centers, sinks, seqs, lens, band, nn))
+        np.testing.assert_array_equal(pls, ref)
 
 
 def test_pallas_session_engine_byte_identical_to_host():

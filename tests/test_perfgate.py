@@ -227,6 +227,54 @@ def test_missing_latency_tail_metric_rc2(tmp_path, capsys):
     assert "warm.p99_s" in capsys.readouterr().err
 
 
+def synth_artifact(wps=6.0):
+    return {"mode": "synth",
+            "synth": {"windows_per_s": wps, "windows": 20},
+            "occupancy": {}}
+
+
+def test_synth_windows_per_s_floor(tmp_path, capsys):
+    art = write(tmp_path / "SYNTH.json", synth_artifact(6.0))
+    assert perfgate.main(["--artifact", art,
+                          "--windows-per-s-min", "5.0"]) == 0
+    assert perfgate.main(["--artifact", art,
+                          "--windows-per-s-min", "7.0"]) == 1
+    # no floor AND no --against: a synth artifact has no implicit
+    # baseline — broken gate, not silent pass
+    assert perfgate.main(["--artifact", art]) == 2
+
+
+def test_synth_relative_vs_prior_round(tmp_path):
+    prior = write(tmp_path / "SYNTH_r1.json", synth_artifact(10.0))
+    cand = write(tmp_path / "SYNTH_r2.json", synth_artifact(7.0))
+    # -30% vs the prior synth round: regression even though the
+    # absolute floor passes
+    assert perfgate.main(["--artifact", cand, "--against", prior,
+                          "--windows-per-s-min", "5.0"]) == 1
+    assert perfgate.main(["--artifact", prior, "--against", cand]) == 0
+
+
+def test_windows_per_s_min_mandatory_names_key(tmp_path, capsys):
+    """--windows-per-s-min over an artifact that carries no windows/s
+    (a serve artifact) is a BROKEN GATE naming the dotted key — CI must
+    distinguish 'artifact changed shape' from 'perf regressed'."""
+    art = write(tmp_path / "SERVE.json", serve_artifact(p50=0.30))
+    assert perfgate.main(["--artifact", art,
+                          "--windows-per-s-min", "5.0"]) == 2
+    assert "synth.windows_per_s" in capsys.readouterr().err
+
+
+def test_synth_broken_against_stays_broken(tmp_path, capsys):
+    """An explicitly requested --against that cannot resolve must stay
+    rc 2 even when the absolute floor is also requested — the relative
+    comparison was asked for, so it silently not running is a broken
+    gate, not a pass."""
+    art = write(tmp_path / "SYNTH.json", synth_artifact(6.0))
+    missing = str(tmp_path / "nope.json")
+    assert perfgate.main(["--artifact", art, "--against", missing,
+                          "--windows-per-s-min", "1.0"]) == 2
+
+
 def test_repo_current_artifacts_pass():
     """The acceptance half: the default invocation against the repo's
     own committed artifacts exits 0."""

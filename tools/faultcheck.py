@@ -173,7 +173,7 @@ def validate_trace(trace_path, stats):
 
 
 def run_cell(paths, clean, depth, aligner, spec, timeout,
-             adaptive=False, trace=False):
+             adaptive=False, trace=False, pallas=False):
     trace_path = None
     if trace:
         fd, trace_path = tempfile.mkstemp(suffix=".json",
@@ -181,7 +181,7 @@ def run_cell(paths, clean, depth, aligner, spec, timeout,
         os.close(fd)
     try:
         return _run_cell(paths, clean, depth, aligner, spec, timeout,
-                         adaptive, trace_path)
+                         adaptive, trace_path, pallas)
     finally:
         if trace_path is not None:
             try:
@@ -191,7 +191,7 @@ def run_cell(paths, clean, depth, aligner, spec, timeout,
 
 
 def _run_cell(paths, clean, depth, aligner, spec, timeout,
-              adaptive, trace_path):
+              adaptive, trace_path, pallas=False):
     from racon_tpu.obs import trace as obs_trace
     from racon_tpu.resilience.faults import reset_fault_plan
 
@@ -199,6 +199,12 @@ def _run_cell(paths, clean, depth, aligner, spec, timeout,
     os.environ["RACON_TPU_FAULT_PLAN"] = spec
     os.environ["RACON_TPU_DEVICE_RETRIES"] = "1"
     os.environ["RACON_TPU_RETRY_BACKOFF"] = "0.01"
+    if pallas:
+        # the Pallas kernel plane (interpret mode on this CPU backend):
+        # injected faults must quarantine / fall back exactly like the
+        # XLA chunks — the fault hooks live at the pipeline layer, so a
+        # Pallas-dispatched chunk routes through the identical ladder
+        os.environ["RACON_TPU_PALLAS"] = "1"
     reset_fault_plan()
     if trace:
         obs_trace.configure(trace_path)
@@ -210,6 +216,7 @@ def _run_cell(paths, clean, depth, aligner, spec, timeout,
     finally:
         wall = time.perf_counter() - t0
         os.environ.pop("RACON_TPU_FAULT_PLAN", None)
+        os.environ.pop("RACON_TPU_PALLAS", None)
         reset_fault_plan()
         if trace:
             try:
@@ -306,18 +313,36 @@ def main() -> int:
                 print("[faultcheck] FAIL: adaptive-bucket clean run "
                       "diverged from static", file=sys.stderr)
                 return 1
+        # pallas-column clean gate: the kernel-plane contract is that a
+        # clean RACON_TPU_PALLAS=1 run is byte-identical to the XLA one
+        # — checked once, so every pallas cell compares against the
+        # same bytes the other columns do
+        os.environ["RACON_TPU_PALLAS"] = "1"
+        try:
+            for aligner in (0, 1):
+                pallas_clean = polish(paths, 2, aligner, 0.0)[0]
+                if pallas_clean != clean[2, aligner]:
+                    print("[faultcheck] FAIL: pallas clean run diverged "
+                          "from XLA", file=sys.stderr)
+                    return 1
+        finally:
+            os.environ.pop("RACON_TPU_PALLAS", None)
         width = max(len(m[0]) for m in rows)
         print(f"{'injection point':<{width}}  depth0"
               f"{'':<30}depth2{'':<30}depth2+sched"
-              f"{'':<24}depth2+trace{'':<24}serve", file=sys.stderr)
+              f"{'':<24}depth2+trace{'':<24}depth2+pallas"
+              f"{'':<23}serve", file=sys.stderr)
         # the 4th column runs with span tracing armed: the injected run
         # must additionally produce a valid Chrome trace whose
-        # fault/quarantine instant events match the degradation counters
-        columns = ((0, False, False), (2, False, False),
-                   (2, True, False), (2, False, True))
-        # the 5th column submits the fault as a per-job plan against ONE
-        # live warm server shared by every row — surviving the whole
-        # poisoned sequence is itself part of the gate
+        # fault/quarantine instant events match the degradation
+        # counters; the 5th runs the Pallas kernel plane (aligner rows
+        # dispatch the resident wavefront kernel in interpret mode)
+        columns = ((0, False, False, False), (2, False, False, False),
+                   (2, True, False, False), (2, False, True, False),
+                   (2, False, False, True))
+        # the final (serve) column submits the fault as a per-job plan
+        # against ONE live warm server shared by every row — surviving
+        # the whole poisoned sequence is itself part of the gate
         from racon_tpu.serve import PolishClient, PolishServer
 
         serve_sock = os.path.join(tmp, "faultcheck.sock")
@@ -328,9 +353,10 @@ def main() -> int:
         try:
             for name, aligner, spec, timeout, _slow in rows:
                 cells = []
-                for depth, adaptive, traced in columns:
+                for depth, adaptive, traced, pallas in columns:
                     cell = run_cell(paths, clean, depth, aligner, spec,
-                                    timeout, adaptive, trace=traced)
+                                    timeout, adaptive, trace=traced,
+                                    pallas=pallas)
                     failures += cell.startswith("FAIL")
                     cells.append(f"{cell:<36}")
                 cell = run_serve_cell(client, paths, clean, aligner,

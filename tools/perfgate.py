@@ -27,10 +27,18 @@ Metric extraction understands both artifact shapes:
     RELATIVELY (tolerance-pct) against the `--against` reference
     whenever both artifacts carry the key.
 
+  - synthbench `--json` artifacts (`"mode": "synth"`):
+    `synth.windows_per_s`, HIGHER is better — gated ABSOLUTELY against
+    `--windows-per-s-min` (the kernel-plane regression floor) and
+    RELATIVELY against a prior synth artifact via `--against`. Synth
+    artifacts have no implicit baseline (the published BASELINE numbers
+    measure the reference sample, a different workload), so with only
+    the floor requested the relative gate is skipped.
+
 A missing gated metric is a BROKEN GATE, not a traceback: the error
 names the dotted key (`warm.seq_p50_s`, `slo.miss_rate`,
-`warm.p99_s`, `warm.ttfb_p50_s`) and exits 2, so CI can tell "the
-artifact changed shape" from "perf regressed".
+`warm.p99_s`, `warm.ttfb_p50_s`, `synth.windows_per_s`) and exits 2,
+so CI can tell "the artifact changed shape" from "perf regressed".
 
 Baseline resolution, in order:
 
@@ -131,6 +139,20 @@ def extract(doc: dict, path: str = "<artifact>") -> dict:
             if val is not None:
                 out[key] = float(val)
         return out
+    if inner.get("mode") == "synth":
+        # synthbench --json artifact: windows_per_s, HIGHER is better.
+        # No implicit baseline exists for it (the published BASELINE
+        # numbers measure the reference sample, a different workload) —
+        # gate it absolutely (--windows-per-s-min) and/or against a
+        # prior synth artifact (--against).
+        value = _lookup(inner, "synth.windows_per_s")
+        if value is None:
+            raise GateError(
+                f"{path}: artifact lacks gated metric "
+                "'synth.windows_per_s'")
+        return {"name": "synthbench windows/s", "value": float(value),
+                "unit": "windows/sec", "higher_better": True,
+                "kind": "synth"}
     if inner.get("unit") == "windows/sec":
         metric = str(inner.get("metric", ""))
         value = float(inner.get("value") or 0.0)
@@ -181,6 +203,12 @@ def resolve_baseline(cand: dict, args, candidate_path: str) -> tuple:
                             "direction than the candidate")
         return ref["value"], os.path.basename(args.against), ref
     baseline_path = os.path.join(args.dir, "BASELINE.json")
+    if cand.get("kind") == "synth":
+        # a published sample-workload baseline is not comparable with a
+        # synthetic-scale run; synth artifacts gate absolutely and/or
+        # against an explicit prior synth artifact only
+        raise GateError("synth artifact has no implicit baseline "
+                        "(use --windows-per-s-min and/or --against)")
     if os.path.isfile(baseline_path):
         published = (load_artifact(baseline_path).get("published")
                      or {})
@@ -266,6 +294,24 @@ def latency_checks(cand: dict, ref: dict | None, args,
     return checks
 
 
+def wps_floor_check(cand: dict, args,
+                    candidate_path: str) -> list[tuple[str, float, float]]:
+    """Absolute windows/s floor (--windows-per-s-min): mandatory once
+    requested — a candidate without a windows/sec metric (e.g. a serve
+    artifact) is a named-key broken gate, exit 2 — so a kernel-plane
+    regression fails CI the same way serve regressions do."""
+    if args.windows_per_s_min is None:
+        return []
+    if not cand["higher_better"]:
+        raise GateError(
+            f"{candidate_path}: artifact lacks gated metric "
+            "'synth.windows_per_s' (serve artifacts carry no "
+            "windows/s; --windows-per-s-min gates synthbench/bench "
+            "artifacts)")
+    return [("windows/s floor", cand["value"],
+             float(args.windows_per_s_min))]
+
+
 def run(args) -> int:
     if args.artifact:
         candidate_path = args.artifact
@@ -276,17 +322,42 @@ def run(args) -> int:
         candidate_path = arts[-1]
     doc = load_artifact(candidate_path)
     cand = extract(doc, candidate_path)
-    reference, ref_desc, ref = resolve_baseline(cand, args,
-                                                candidate_path)
-    ok, delta = gate(cand["value"], reference, args.tolerance_pct,
-                     cand["higher_better"])
-    failures = 0 if ok else 1
-    verdict = "PASS" if ok else "FAIL"
-    print(f"[perfgate] {verdict}: {os.path.basename(candidate_path)} "
-          f"{cand['name']} = {cand['value']:g} {cand['unit']} vs "
-          f"{reference:g} ({ref_desc}): {delta:+.1f}% "
-          f"(tolerance -{abs(args.tolerance_pct):g}%)",
-          file=sys.stderr)
+    # the absolute windows/s floor resolves FIRST: a mandatory flag over
+    # the wrong artifact shape must exit 2 naming the dotted key, not
+    # trip over baseline resolution
+    wps_checks = wps_floor_check(cand, args, candidate_path)
+    try:
+        reference, ref_desc, ref = resolve_baseline(cand, args,
+                                                    candidate_path)
+    except GateError:
+        # a synth artifact gated only by its absolute floor needs no
+        # baseline — but ONLY when no explicit baseline was requested:
+        # a --against that fails to resolve (corrupt file, wrong
+        # direction, no usable prior) must stay a broken gate, or the
+        # requested relative comparison silently never runs
+        if (cand.get("kind") == "synth"
+                and args.windows_per_s_min is not None
+                and not args.against):
+            reference, ref_desc, ref = None, "", None
+        else:
+            raise
+    failures = 0
+    if reference is not None:
+        ok, delta = gate(cand["value"], reference, args.tolerance_pct,
+                         cand["higher_better"])
+        failures += 0 if ok else 1
+        verdict = "PASS" if ok else "FAIL"
+        print(f"[perfgate] {verdict}: {os.path.basename(candidate_path)} "
+              f"{cand['name']} = {cand['value']:g} {cand['unit']} vs "
+              f"{reference:g} ({ref_desc}): {delta:+.1f}% "
+              f"(tolerance -{abs(args.tolerance_pct):g}%)",
+              file=sys.stderr)
+    for name, value, floor in wps_checks:
+        check_ok = value >= floor
+        failures += 0 if check_ok else 1
+        print(f"[perfgate] {'PASS' if check_ok else 'FAIL'}: "
+              f"{os.path.basename(candidate_path)} {name} = {value:g} "
+              f"(min {floor:g})", file=sys.stderr)
     for name, value, limit in slo_checks(doc, cand, args,
                                          candidate_path):
         check_ok = value <= limit
@@ -322,6 +393,14 @@ def main(argv=None) -> int:
                          "everything)")
     ap.add_argument("--tolerance-pct", type=float, default=10.0,
                     help="allowed regression in percent (default 10)")
+    ap.add_argument("--windows-per-s-min", type=float, default=None,
+                    help="absolute floor on the candidate's windows/s "
+                         "(synthbench --json or bench artifacts); "
+                         "mandatory once passed — a candidate without "
+                         "the metric exits 2 naming the dotted key. "
+                         "For synth artifacts this also makes the "
+                         "relative gate optional (no implicit baseline "
+                         "exists for synthetic workloads)")
     ap.add_argument("--slo-miss-rate", type=float, default=None,
                     help="allowed deadline-miss rate for servebench "
                          "artifacts (default: gate at 0.0 whenever the "

@@ -171,6 +171,13 @@ def main(argv=None):
     ap.add_argument("--golden-out", default=None,
                     help="write the polished FASTA here (golden artifact; "
                          "deterministic for a given seed/params)")
+    ap.add_argument("--json", metavar="PATH", default=None,
+                    help="write a machine-readable artifact (mode "
+                         "'synth': windows_per_s, phase seconds, "
+                         "identity, per-bucket occupancy incl. the "
+                         "dispatched kernel/dtype choice) — the shape "
+                         "tools/perfgate.py gates with "
+                         "--windows-per-s-min / --against")
     ap.add_argument("--trace", metavar="PATH", default=None,
                     help="record a Chrome trace (Perfetto) of the polish "
                          "to PATH and report trace-recording overhead vs "
@@ -330,9 +337,13 @@ def main(argv=None):
                   f"{'on' if polisher.scheduler.adaptive else 'off'})",
                   file=sys.stderr)
             for bucket, b in e["buckets"].items():
+                plan = ""
+                if "kernel" in b or "dtype" in b:
+                    plan = (f", kernel {b.get('kernel', '?')}"
+                            f"/{b.get('dtype', '?')}")
                 print(f"[synthbench]   bucket {bucket}: {b['jobs']} jobs "
                       f"/ {b['batches']} batches, occupancy "
-                      f"{b['occupancy_pct']:.1f}%", file=sys.stderr)
+                      f"{b['occupancy_pct']:.1f}%{plan}", file=sys.stderr)
 
     if args.golden_out:
         with open(args.golden_out, "wb") as fh:
@@ -352,6 +363,29 @@ def main(argv=None):
           f"polished error {d_pol / genome_len * 100:.2f}%  "
           f"(identity {100 - d_pol / genome_len * 100:.3f}%)",
           file=sys.stderr)
+    if args.json:
+        import json
+
+        artifact = {
+            "mode": "synth",
+            "synth": {
+                "windows_per_s": round(n_windows / polish_s, 3)
+                if polish_s > 0 else 0.0,
+                "windows": n_windows,
+                "init_s": round(init_s, 3),
+                "polish_s": round(polish_s, 3),
+                "identity_pct": round(100 - d_pol / genome_len * 100, 4),
+                "genome_kb": args.genome_kb,
+                "coverage": args.coverage,
+                "seed": args.seed,
+            },
+            # per-bucket occupancy INCLUDING the dispatched kernel/dtype
+            # choice — the autotuner's decision made visible per run
+            "occupancy": polisher.occupancy_stats,
+        }
+        with open(args.json, "w") as fh:
+            json.dump(artifact, fh, indent=1, sort_keys=True)
+        print(f"[synthbench] wrote artifact {args.json}", file=sys.stderr)
     try:
         import resource
 
