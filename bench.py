@@ -213,6 +213,7 @@ def phase_consensus(mode: str) -> int:
            "adaptive_buckets": polisher.scheduler.adaptive,
            "stages": _stage_fields(polisher),
            "occupancy": polisher.occupancy_stats,
+           "mesh": _mesh_info(),
            # the unified observability snapshot (racon_tpu/obs): the
            # stage/occupancy fields above, re-published under one
            # namespaced schema (pipeline.* / sched.* / resilience.*)
@@ -227,6 +228,14 @@ def _jax_platform() -> str:
     import jax
 
     return jax.devices()[0].platform
+
+
+def _mesh_info() -> dict:
+    """The shared mesh-block schema (parallel/mesh.py). Worker lanes
+    are a serve-only concept — one-shot bench phases always run 1."""
+    from racon_tpu.parallel.mesh import mesh_info
+
+    return mesh_info()
 
 
 def _cpu_backend_refused() -> bool:
@@ -268,6 +277,7 @@ def phase_aligner() -> int:
                       "adaptive_buckets": polisher.scheduler.adaptive,
                       "stages": _stage_fields(polisher),
                       "occupancy": polisher.occupancy_stats,
+                      "mesh": _mesh_info(),
                       "metrics": polisher.metrics.snapshot()}))
     return 0
 
@@ -479,7 +489,7 @@ def main() -> int:
     # much of each dispatched device shape was real work, plus warm-vs-
     # cold compile-cache evidence for the initialize-time comparison
     for key in ("occupancy", "init_s", "precompile_s", "cache_warm",
-                "adaptive_buckets", "metrics"):
+                "adaptive_buckets", "metrics", "mesh"):
         if key in res:
             stage_fields[key] = res[key]
     label = {"fused": "device_fused", "device": "device",

@@ -171,6 +171,14 @@ class Polisher:
         # default. Events carry phase / done / total; emission is
         # best-effort and monotonic per phase (emit_progress).
         self.progress_hook = None
+        # device-mesh pin (serve worker lanes): a parallel.mesh
+        # BatchRunner the consensus engines dispatch through instead of
+        # the full auto-discovered mesh. The serve batcher sets it so an
+        # ISOLATION job (own fault plan / strict) runs solo on ONE
+        # lane's sub-mesh while the other lanes keep serving; None (the
+        # one-shot default) lets every engine build its own full-mesh
+        # runner.
+        self.device_runner = None
         self._progress_phase: str | None = None
         self._progress_hwm: tuple[str, int, int] = ("", 0, 0)
         import threading as _threading
@@ -604,7 +612,8 @@ class Polisher:
             if self.tpu_aligner_batches > 0:
                 from ..ops.align import BatchAligner
                 aligner = BatchAligner(band_width=self.tpu_aligner_band_width,
-                                       scheduler=self.scheduler)
+                                       scheduler=self.scheduler,
+                                       runner=self.device_runner)
                 pipeline = self._make_pipeline()
                 fb: list[tuple[list[int], object]] = []
                 # concurrent fallback jobs split the thread budget so the
@@ -783,7 +792,8 @@ class Polisher:
                           banded=self.tpu_banded_alignment,
                           band_width=self.tpu_aligner_band_width,
                           logger=self.logger, engine=self.tpu_engine,
-                          pipeline=pipeline, scheduler=self.scheduler)
+                          pipeline=pipeline, scheduler=self.scheduler,
+                          runner=self.device_runner)
         t_consensus = _time.perf_counter()
         with profile_ctx, pipeline:
             engine.generate_consensus(self.windows, self.trim)

@@ -432,7 +432,10 @@ class BatchAligner:
                 for i in idxs:
                     groups.setdefault((edge, band_of[i]), []).append(i)
 
+        from ..sched import shard_interleave
+
         chunks: list[tuple[int, int, int, list[int]]] = []
+        n_dev = runner.n_devices
         for (edge, band), idxs in sorted(groups.items()):
             # sorted packing: shape-homogeneous chunks instead of arrival
             # order (results land by original index, so output order is
@@ -440,10 +443,26 @@ class BatchAligner:
             idxs = self.sched.order(idxs, key=shape_of)
             n_waves = 2 * edge + 1
             lane_bytes = n_waves * (band // 4)
-            max_lanes = max(runner.n_devices,
-                            self.MAX_BP_BYTES // lane_bytes)
-            for s in range(0, len(idxs), max_lanes):
-                chunks.append((edge, band, n_waves, idxs[s:s + max_lanes]))
+            max_lanes = max(n_dev, self.MAX_BP_BYTES // lane_bytes)
+            if n_dev > 1:
+                # device-aware chunking: BODY chunks are multiples of
+                # the mesh width (zero round_batch padding lanes, rows
+                # interleaved so each shard carries an even share of
+                # the sorted lengths) and the remainder dispatches as
+                # its own small chunk on a sub-mesh (for_batch) instead
+                # of padding whole lanes up to the full device count
+                stride = max(n_dev, (max_lanes // n_dev) * n_dev)
+                body = (len(idxs) // n_dev) * n_dev
+                for s in range(0, body, stride):
+                    part = idxs[s:s + min(stride, body - s)]
+                    chunks.append((edge, band, n_waves,
+                                   shard_interleave(part, n_dev)))
+                if body < len(idxs):
+                    chunks.append((edge, band, n_waves, idxs[body:]))
+            else:
+                for s in range(0, len(idxs), max_lanes):
+                    chunks.append((edge, band, n_waves,
+                                   idxs[s:s + max_lanes]))
 
         # per-bucket kernel/dtype plan, resolved once: the Pallas posture
         # (constructor override, else RACON_TPU_PALLAS incl. the `auto`
@@ -476,7 +495,12 @@ class BatchAligner:
             kern, dtype = plan_for(edge, band)
             qs = [pairs[i][0] for i in idx]
             ts = [pairs[i][1] for i in idx]
-            lanes = runner.round_batch(len(idx))
+            # tail batches smaller than the mesh dispatch on a SUB-MESH
+            # (largest device count <= batch) instead of padding whole
+            # lanes up to the full device count; for_batch is
+            # deterministic in len(idx), so dispatch() resolves the
+            # same runner
+            lanes = runner.for_batch(len(idx)).round_batch(len(idx))
             q_arr, q_lens = encode_padded(qs + [b"A"] * (lanes - len(idx)),
                                           edge)
             t_arr, t_lens = encode_padded(ts + [b"A"] * (lanes - len(idx)),
@@ -503,6 +527,9 @@ class BatchAligner:
 
             edge, band, n_waves, idx = chunk
             kern, dtype, do_pack, q_op, t_op, q_lens, t_lens, offs = ops
+            # the sub-mesh pack() sized the lanes for (zero padding
+            # lanes on tails smaller than the mesh)
+            r = runner.for_batch(len(idx))
             # compile telemetry: the first dispatch of a new shape blocks
             # through trace + XLA build (near-zero when the persistent
             # compile cache is warm) — charge that wall to the shape.
@@ -513,12 +540,12 @@ class BatchAligner:
                 fn = align_pallas.wavefront_align(
                     edge, band, dtype, do_pack,
                     interpret=jax.default_backend() == "cpu")
-                out = runner.run_split(fn, q_op, t_op,
-                                       q_lens.astype(np.int32),
-                                       t_lens.astype(np.int32), offs)
+                out = r.run_split(fn, q_op, t_op,
+                                  q_lens.astype(np.int32),
+                                  t_lens.astype(np.int32), offs)
             else:
                 kernel = _kernel_for(band, n_waves, dtype, do_pack)
-                out = runner.run(
+                out = r.run(
                     kernel, q_op, t_op, q_lens.astype(np.int32),
                     t_lens.astype(np.int32), offs,
                     out_batch_axes=(1, 0))  # bp is [n_waves, B, band//4]
@@ -529,15 +556,22 @@ class BatchAligner:
             # occupancy telemetry, recorded at dispatch (a chunk killed
             # by a fault or the circuit breaker must not be accounted as
             # device work): useful DP cells = per-pair wave count x band
-            # vs the batch's full n_waves x band x lanes
+            # vs the batch's full n_waves x band x lanes — plus the mesh
+            # view (per-shard useful split; what full-mesh round_batch
+            # rounding would have dispatched)
+            row_cells = [(len(pairs[i][0]) + len(pairs[i][1]) + 1) * band
+                         for i in idx]
+            per = offs.shape[0] // r.n_devices
             self.sched.stats.record(
                 "aligner", (edge, band), jobs=len(idx),
                 lanes=offs.shape[0],
-                useful_cells=sum(
-                    (len(pairs[i][0]) + len(pairs[i][1]) + 1) * band
-                    for i in idx),
+                useful_cells=sum(row_cells),
                 total_cells=offs.shape[0] * n_waves * band,
-                kernel=kern, dtype=dtype)
+                kernel=kern, dtype=dtype, n_devices=r.n_devices,
+                shard_useful=[sum(row_cells[s * per:(s + 1) * per])
+                              for s in range(r.n_devices)],
+                full_mesh_cells=(runner.round_batch(len(idx))
+                                 * n_waves * band))
             pl.stats.bump("launches")
             return kern, out, q_lens, t_lens, offs
 

@@ -44,12 +44,16 @@ class BatchPOA:
                  device_batches: int = 0, banded: bool = False,
                  band_width: int = 0, logger: Logger | None = None,
                  engine: str | None = None, pipeline=None,
-                 scheduler=None):
+                 scheduler=None, runner=None):
         self.match = match
         # the occupancy-aware batch scheduler (sched/), threaded into
         # whichever device engine runs; None lets each engine default
         # from the environment posture
         self.scheduler = scheduler
+        # an explicit parallel.mesh.BatchRunner pins the device engines
+        # to a sub-mesh — the serve layer's worker lanes each dispatch
+        # through their own device partition; None = the full mesh
+        self.runner = runner
         self.mismatch = mismatch
         self.gap = gap
         self.window_length = window_length
@@ -217,7 +221,8 @@ class BatchPOA:
                              num_threads=self.num_threads,
                              logger=self.logger,
                              banded_only=self.banded_only,
-                             scheduler=self.scheduler)
+                             scheduler=self.scheduler,
+                             runner=self.runner)
             # RACON_TPU_FUSED_FALLBACK picks who polishes the windows the
             # fused engine cannot take (graph overflowed its envelope):
             # "session" (default) keeps the whole batch on device via the
@@ -254,7 +259,8 @@ class BatchPOA:
                                         num_threads=self.num_threads,
                                         logger=self.logger,
                                         banded_only=self.banded_only,
-                                        scheduler=static_sched)
+                                        scheduler=static_sched,
+                                        runner=self.runner)
                 sub_res, sub_st = engine.consensus(
                     [packed[i] for i in rest])
                 for i, r, st in zip(rest, sub_res, sub_st):
@@ -267,7 +273,8 @@ class BatchPOA:
                                     num_threads=self.num_threads,
                                     logger=self.logger,
                                     banded_only=self.banded_only,
-                                    scheduler=self.scheduler)
+                                    scheduler=self.scheduler,
+                                    runner=self.runner)
             results, statuses = engine.consensus(packed)
         leftover = []
         for w, r in zip(todo, results):

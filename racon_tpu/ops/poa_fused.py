@@ -848,6 +848,8 @@ class FusedPOA:
             # state stays on device across chained calls (a fetch here
             # would round-trip ~5 MB of graph arrays per call); only the
             # final state is materialized for the host finalizer
+            n_dev = self.runner.n_devices
+            per = self.B // n_dev
             for d, ops, done in calls:
                 state = self._call(d, state, *ops, done)
                 # occupancy in LAYER units, recorded AFTER the call
@@ -855,14 +857,22 @@ class FusedPOA:
                 # device work): every lane pays all d layer steps of
                 # every chained call, real or padded. Each window counts
                 # as a job ONCE (on its chunk's first call) so jobs
-                # totals stay comparable across engines.
+                # totals stay comparable across engines. The mesh view
+                # splits the chunk's rows into per-device shards; B is
+                # pinned (no sub-mesh tails), so the full-mesh baseline
+                # equals the dispatched capacity.
+                row_layers = [min(max(0, dep - done), d)
+                              for dep in depths]
                 self.sched.stats.record(
                     "fused", d, jobs=len(chunk) if done == 0 else 0,
                     lanes=self.B,
-                    useful_cells=sum(min(max(0, dep - done), d)
-                                     for dep in depths),
+                    useful_cells=sum(row_layers),
                     total_cells=self.B * d,
-                    kernel="xla", dtype=self.score_dtype)
+                    kernel="xla", dtype=self.score_dtype,
+                    n_devices=n_dev,
+                    shard_useful=[sum(row_layers[s * per:(s + 1) * per])
+                                  for s in range(n_dev)],
+                    full_mesh_cells=self.B * d)
             pl.stats.bump("launches", len(calls))
             return state
 
@@ -907,8 +917,24 @@ class FusedPOA:
                 raise err
             _tick(chunk)
 
-        chunk_items = [fused_idx[s:s + self.B]
-                       for s in range(0, len(fused_idx), self.B)]
+        # mesh balance: within each FULL chunk, windows round-robin
+        # across the per-device row shards (the chunk list IS the row
+        # order and B/n_dev rows per shard align exactly with the
+        # strided groups), so the depth-sorted deep windows spread over
+        # the mesh instead of loading the first shard; pure permutation
+        # — per-window results are row-position-independent. The tail
+        # chunk keeps sorted order: its graph-state rows are contiguous
+        # from row 0, so a strided reorder would NOT line up with the
+        # shard boundaries anyway (the padding rows are pinned to the
+        # end of the batch by _init_state).
+        from ..sched import shard_interleave
+
+        n_dev = self.runner.n_devices
+        chunk_items = [
+            (shard_interleave(chunk, n_dev) if len(chunk) == self.B
+             else chunk)
+            for chunk in (fused_idx[s:s + self.B]
+                          for s in range(0, len(fused_idx), self.B))]
         strict = strict_mode()
         try:
             # the pipeline already counts and times every stage callback;

@@ -465,7 +465,7 @@ class DeviceGraphPOA:
                  max_pred: int = MAX_PRED, buckets=None,
                  batch_rows: int | None = None, cycle_jobs: int = _CYCLE_JOBS,
                  banded_only: bool = False, use_pallas: bool | None = None,
-                 scheduler=None):
+                 scheduler=None, runner=None):
         from ..parallel.mesh import BatchRunner
         from ..sched import BatchScheduler
 
@@ -500,7 +500,10 @@ class DeviceGraphPOA:
         self.num_threads = num_threads
         self.logger = logger
         self.banded_only = banded_only
-        self.runner = BatchRunner()
+        # an explicit runner pins this engine to a sub-mesh (the serve
+        # layer's worker lanes each pass their own); default is the full
+        # auto-discovered mesh
+        self.runner = runner if runner is not None else BatchRunner()
         self.max_nodes = max_nodes
         self.max_len = max_len
         self.max_pred = max_pred
@@ -685,10 +688,12 @@ class DeviceGraphPOA:
                 break
             # commit the oldest batch (blocks only on ITS device result;
             # younger batches keep computing via async dispatch)
-            win, layer, band, npart, lb, out = inflight.popleft()
+            win, layer, band, npart, lb, out, rows = inflight.popleft()
             with trace.span("session.commit", engine="session",
                             jobs=npart):
-                ranks = _materialize(out)[:npart, :lb]
+                # gather by the dispatch scatter's row map (job j is on
+                # row rows[j], not row j)
+                ranks = _materialize(out)[rows][:, :lb]
                 session.commit(win, layer, band, ranks)
             freed += npart
             if bar is not None:
@@ -763,19 +768,31 @@ class DeviceGraphPOA:
                         jobs["band"][sel].copy())
                 with trace.span("session.dispatch", engine="session",
                                 bucket=f"{nb}x{lb}", jobs=len(part)):
-                    out = self._dispatch(jobs, sel, nb, lb, B)
+                    out, rows = self._dispatch(jobs, sel, nb, lb, B)
                 # occupancy recorded AFTER the dispatch call returned
                 # (the aligner's discipline: a batch killed before the
                 # device saw it must not be accounted as device work)
                 use_pallas, dtype = self._plan(nb, lb)
+                # mesh view: job j landed on shard j % n_devices (the
+                # _dispatch round-robin scatter), so per-shard useful
+                # cells — the balance the scale curve gates on — come
+                # from strided sums. The batch is always padded to the
+                # pinned width B (a per-tail program shape would
+                # compile cold mid-run), so the full-mesh baseline
+                # equals the dispatched capacity.
+                n_dev = self.runner.n_devices
+                row_cells = (jobs["nnodes"][sel].astype(np.int64)
+                             * (jobs["len"][sel].astype(np.int64) + 1))
+                shard_useful = [int(row_cells[s::n_dev].sum())
+                                for s in range(n_dev)]
                 self.sched.stats.record(
                     "session", (nb, lb), jobs=len(part), lanes=B,
-                    useful_cells=int(
-                        (jobs["nnodes"][sel].astype(np.int64)
-                         * (jobs["len"][sel].astype(np.int64) + 1)).sum()),
+                    useful_cells=int(row_cells.sum()),
                     total_cells=B * nb * (lb + 1),
-                    kernel="pallas" if use_pallas else "xla", dtype=dtype)
-                batches.append(meta + (len(part), lb, out))
+                    kernel="pallas" if use_pallas else "xla", dtype=dtype,
+                    n_devices=n_dev, shard_useful=shard_useful,
+                    full_mesh_cells=B * nb * (lb + 1))
+                batches.append(meta + (len(part), lb, out, rows))
         return batches
 
     def _plan(self, nb, lb) -> tuple[bool, str]:
@@ -877,14 +894,20 @@ class DeviceGraphPOA:
         return out
 
     def _dispatch(self, jobs, sel, nb, lb, B):
-        pad = B - len(sel)
+        """Pad/scatter one bucket batch and dispatch it. Returns
+        (device_out, rows): `rows[j]` is the batch row job j landed on —
+        round-robin across the mesh's per-device shards, so each device
+        carries an even share of the real (and of the padding) rows
+        instead of the last shard eating all the pad. Per-row results
+        are position-independent; commit gathers by `rows`."""
+        n_dev = self.runner.n_devices
+        per = B // n_dev
+        j = np.arange(len(sel), dtype=np.int64)
+        rows = (j % n_dev) * per + j // n_dev
 
         def take(arr, fill):
-            out = arr[sel]
-            if pad:
-                out = np.concatenate(
-                    [out, np.full((pad,) + out.shape[1:], fill,
-                                  dtype=out.dtype)])
+            out = np.full((B,) + arr.shape[1:], fill, dtype=arr.dtype)
+            out[rows] = arr[sel]
             return out
 
         return self._run_bucket(
@@ -894,7 +917,7 @@ class DeviceGraphPOA:
             take(jobs["sinks"][:, :nb], 0),
             take(jobs["seqs"][:, :lb], 5),
             take(jobs["len"], 0), take(jobs["band"], 0),
-            take(jobs["nnodes"], 0))
+            take(jobs["nnodes"], 0)), rows
 
     def _run_pallas(self, fn, *args):
         """Run the pallas sweep across every device (the batch width is

@@ -16,6 +16,38 @@ from __future__ import annotations
 import numpy as np
 
 
+def mesh_info(worker_lanes: int = 1) -> dict:
+    """The mesh posture a perf artifact was measured under — ONE schema
+    shared by bench.py / synthbench / servebench, because
+    tools/perfgate.py refuses cross-mesh comparisons key-by-key: a
+    field added here reaches every artifact at once instead of drifting
+    per tool. (windows/s on 1 chip vs 8 is a different machine, not a
+    regression.)"""
+    import os
+
+    return {"n_devices": BatchRunner().n_devices,
+            "worker_lanes": int(worker_lanes),
+            "max_devices_env": os.environ.get(
+                "RACON_TPU_MAX_DEVICES") or None}
+
+
+def partition_devices(devices, k: int) -> list[list]:
+    """Split a device list into `k` contiguous, near-equal sub-lists —
+    the serve layer's worker-lane partition (each lane becomes an
+    independent sub-mesh with its own BatchRunner). `k` clamps to the
+    device count (a lane with zero devices schedules nothing) and the
+    first len(devices) % k lanes carry the extra device."""
+    devices = list(devices)
+    k = max(1, min(int(k), len(devices)))
+    base, extra = divmod(len(devices), k)
+    out, start = [], 0
+    for i in range(k):
+        n = base + (1 if i < extra else 0)
+        out.append(devices[start:start + n])
+        start += n
+    return out
+
+
 class BatchRunner:
     """Runs batched kernels with the leading axis sharded over all devices.
 
@@ -48,6 +80,7 @@ class BatchRunner:
             self.mesh = None
             self.sharding = None
         self._wrapped: dict = {}
+        self._subs: dict[int, "BatchRunner"] = {}
 
     @property
     def n_devices(self) -> int:
@@ -58,6 +91,25 @@ class BatchRunner:
         n = self.n_devices
         return ((batch + n - 1) // n) * n
 
+    def for_batch(self, batch: int) -> "BatchRunner":
+        """The runner a batch of `batch` rows should dispatch through:
+        this runner when the batch fills the mesh, else a cached
+        SUB-MESH over the first `batch` devices — so a tail batch
+        smaller than the mesh ships with ZERO padding lanes instead of
+        rounding up to the full device count (`round_batch` padding
+        waste grows with slice size; a 3-row tail on an 8-chip slice
+        would burn 5 whole padded lanes). Per-row results are
+        independent of batch composition, so the output is
+        byte-identical either way (dryrun-pinned)."""
+        n = self.n_devices
+        if batch >= n or batch < 1 or n == 1:
+            return self
+        sub = self._subs.get(batch)
+        if sub is None:
+            sub = self._subs[batch] = BatchRunner(
+                devices=self.devices[:batch])
+        return sub
+
     def run_split(self, fn, *arrays):
         """Manual per-device batch split for kernels whose grid is
         sequential per core (the Pallas resident kernels): each chip
@@ -66,15 +118,24 @@ class BatchRunner:
         (DeviceGraphPOA._run_pallas, align.BatchAligner). The leading
         dim must be a multiple of n_devices (round_batch). Returns the
         kernel's output directly on one device, else the list of
-        per-shard outputs in device order (caller concatenates)."""
+        per-shard outputs in device order (caller concatenates).
+
+        ALL shards are placed before the first kernel call: device_put
+        is async, so shard k+1's host->device transfer overlaps shard
+        k's compute instead of serializing transfer/dispatch per device
+        (the old interleaved loop paid the full transfer latency on the
+        dispatch path for every device after the first). Concatenating
+        the per-shard outputs in device order is identical to the
+        single-device result row-for-row (test-pinned)."""
         if len(self.devices) == 1:
             return fn(*arrays)
         import jax
 
         per = arrays[0].shape[0] // len(self.devices)
-        return [fn(*(jax.device_put(a[i * per:(i + 1) * per], d)
-                     for a in arrays))
-                for i, d in enumerate(self.devices)]
+        placed = [[jax.device_put(a[i * per:(i + 1) * per], d)
+                   for a in arrays]
+                  for i, d in enumerate(self.devices)]
+        return [fn(*ops) for ops in placed]
 
     def run(self, fn, *arrays, out_batch_axes=0, donate_argnums=()):
         """Invoke jitted `fn` on operands whose leading dim is the batch.

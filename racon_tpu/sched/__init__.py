@@ -54,10 +54,28 @@ from .telemetry import OccupancyStats
 
 __all__ = ["BatchScheduler", "OccupancyStats", "enable_compile_cache",
            "ladder_1d", "ladder_2d", "pack_iteration", "padded_cost_1d",
-           "round_up"]
+           "round_up", "shard_interleave"]
 
 
-def pack_iteration(items: list, cap: int, shape_key, age_key):
+def shard_interleave(items: list, n_devices: int) -> list:
+    """Strided round-robin of a shape-sorted row list across `n`
+    device shards: shard s receives items s, s+n, s+2n, ... — so a
+    sorted batch's large rows spread evenly across the mesh instead of
+    piling the heaviest work onto the last shard (contiguous split of
+    a sorted list = systematically imbalanced per-device wall time).
+    Pure permutation: per-row results are position-independent, so the
+    caller's output bytes cannot change."""
+    n = int(n_devices)
+    if n <= 1 or len(items) <= n:
+        return list(items)
+    out: list = []
+    for s in range(n):
+        out.extend(items[s::n])
+    return out
+
+
+def pack_iteration(items: list, cap: int, shape_key, age_key,
+                   lane_multiple: int = 1):
     """Incremental packing entry point for the continuous serve feeder
     (serve/batcher.py): from a pending pool, pick ONE bounded,
     shape-homogeneous batch that still guarantees progress for the
@@ -71,16 +89,28 @@ def pack_iteration(items: list, cap: int, shape_key, age_key):
     iteration) while the oldest item always ships this iteration — no
     starvation however the shapes interleave.
 
+    `lane_multiple` is the dispatching mesh's device count: when the
+    pool is deep enough, the slab is rounded DOWN to a multiple of it
+    so the engine's per-device shards split evenly without padding
+    lanes (the trimmed items lead the very next iteration — they only
+    ever wait one extra dispatch). A pool smaller than one multiple
+    ships whole; the engines then dispatch it on a sub-mesh
+    (`BatchRunner.for_batch`) rather than padding up to the full mesh.
+
     Returns `(batch, rest)`; `rest` preserves the sorted order, ready
     to re-pool."""
     if not items:
         return [], []
     ordered = sorted(items, key=shape_key)
     cap = max(1, int(cap))
+    size = min(cap, len(ordered))
+    m = max(1, int(lane_multiple))
+    if size > m and size % m:
+        size = (size // m) * m
     oldest = min(range(len(ordered)), key=lambda i: age_key(ordered[i]))
-    start = min(oldest, max(0, len(ordered) - cap))
-    return (ordered[start:start + cap],
-            ordered[:start] + ordered[start + cap:])
+    start = min(oldest, max(0, len(ordered) - size))
+    return (ordered[start:start + size],
+            ordered[:start] + ordered[start + size:])
 
 
 def enable_compile_cache(path: str) -> None:

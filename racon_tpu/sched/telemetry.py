@@ -30,6 +30,28 @@ import threading
 _seen_shapes: set = set()
 
 
+def _copy_bucket(b: dict) -> dict:
+    """Deep-enough bucket copy for reads escaping the lock: the
+    shard_useful LIST must be copied under the lock too, or a
+    concurrent record() mutates it mid-read and exports torn per-shard
+    sums."""
+    return {k: (list(v) if isinstance(v, list) else v)
+            for k, v in b.items()}
+
+
+def accumulate_cells(acc: list, vals) -> list:
+    """Element-wise accumulate `vals` into `acc`, extending past the
+    end — THE shard-list accumulation, shared by record()/merge_from()/
+    snapshot() and synthbench's cross-engine scale aggregation (one
+    copy, so the semantics cannot drift between them)."""
+    for i, v in enumerate(vals):
+        if i < len(acc):
+            acc[i] += int(v)
+        else:
+            acc.append(int(v))
+    return acc
+
+
 class OccupancyStats:
     """Thread-safe per-(engine, bucket) occupancy counters.
 
@@ -57,7 +79,10 @@ class OccupancyStats:
 
     def record(self, engine: str, bucket, jobs: int, lanes: int,
                useful_cells: int, total_cells: int,
-               kernel: str | None = None, dtype: str | None = None) -> None:
+               kernel: str | None = None, dtype: str | None = None,
+               n_devices: int | None = None,
+               shard_useful=None,
+               full_mesh_cells: int | None = None) -> None:
         """Account one dispatched batch. `bucket` is any hashable shape
         descriptor (stringified for the snapshot); `total_cells` is the
         batch's full dispatched capacity (>= useful_cells). `kernel`
@@ -65,7 +90,16 @@ class OccupancyStats:
         bucket's dispatched program choice — the device-kernel plane's
         per-bucket decision, surfaced next to the occupancy numbers in
         the bench JSON and synthbench report (constant per bucket within
-        a run; last write wins)."""
+        a run; last write wins).
+
+        The mesh view (all optional, so host-only engines stay
+        unchanged): `n_devices` is the dispatching mesh width,
+        `shard_useful` the per-device-shard useful-cell split of this
+        batch (accumulated element-wise — the per-shard balance number
+        synthbench's scale curve gates on), and `full_mesh_cells` what
+        the batch WOULD have dispatched under full-mesh `round_batch`
+        rounding — the baseline the sub-mesh tail dispatch is measured
+        against (equal to `total_cells` when no sub-mesh was taken)."""
         key = (engine, str(bucket))
         with self._lock:
             b = self._buckets.get(key)
@@ -82,6 +116,14 @@ class OccupancyStats:
                 b["kernel"] = kernel
             if dtype is not None:
                 b["dtype"] = dtype
+            if n_devices is not None:
+                b["n_devices"] = int(n_devices)
+            if shard_useful is not None:
+                accumulate_cells(b.setdefault("shard_useful", []),
+                                 shard_useful)
+            if full_mesh_cells is not None:
+                b["full_mesh_cells"] = (b.get("full_mesh_cells", 0)
+                                        + int(full_mesh_cells))
 
     def record_compile(self, engine: str, seconds: float,
                        count: int = 1) -> None:
@@ -122,12 +164,44 @@ class OccupancyStats:
                         {"engine": engine, "shape": str(key)})
         return True
 
+    def merge_from(self, other: "OccupancyStats") -> None:
+        """Fold another instance's counters into this one. The serve
+        batcher keeps ONE OccupancyStats per worker lane — so each
+        lane's per-iteration compile delta is exact under lane
+        concurrency (a shared instance would charge one lane's compile
+        to whichever other lane's delta window it landed in) — and
+        merges them through a scratch instance for the lifetime
+        occupancy view."""
+        with other._lock:
+            buckets = {k: _copy_bucket(v)
+                       for k, v in other._buckets.items()}
+            compiles = {k: dict(v) for k, v in other._compiles.items()}
+        with self._lock:
+            for key, b in buckets.items():
+                mine = self._buckets.get(key)
+                if mine is None:
+                    self._buckets[key] = b
+                    continue
+                for k, v in b.items():
+                    if k == "n_devices" or isinstance(v, str):
+                        mine[k] = v  # descriptors: last write wins
+                    elif isinstance(v, list):
+                        accumulate_cells(mine.setdefault(k, []), v)
+                    else:
+                        mine[k] = mine.get(k, 0) + v
+            for engine, c in compiles.items():
+                mine = self._compiles.setdefault(
+                    engine, {"compiles": 0, "compile_s": 0.0})
+                mine["compiles"] += c["compiles"]
+                mine["compile_s"] += c["compile_s"]
+
     def snapshot(self) -> dict:
         """{engine: {"buckets": {bucket: {..., "occupancy_pct"}},
                      "occupancy_pct", "compiles", "compile_s"}} —
         JSON-ready; empty dict when nothing was dispatched."""
         with self._lock:
-            buckets = {k: dict(v) for k, v in self._buckets.items()}
+            buckets = {k: _copy_bucket(v)
+                       for k, v in self._buckets.items()}
             compiles = {k: dict(v) for k, v in self._compiles.items()}
         out: dict = {}
         for (engine, bucket), b in sorted(buckets.items()):
@@ -142,6 +216,35 @@ class OccupancyStats:
                                  for b in e["buckets"].values())
             e["occupancy_pct"] = (round(100.0 * useful / total, 2)
                                   if total else 0.0)
+            # the mesh view, aggregated across buckets that carry it:
+            # per-shard useful-cell balance (max/min over the engine's
+            # element-wise shard sums) and the padded-cell fraction vs
+            # what full-mesh round_batch rounding would have dispatched
+            # — the numbers the scale-curve perfgate gates. RAW sums
+            # (useful/total/full-mesh cells) ride along so cross-engine
+            # consumers (synthbench _scale_point) can combine fractions
+            # without re-walking buckets.
+            shards: list[int] = []
+            fm_cells = fm_useful = 0
+            for b in e["buckets"].values():
+                accumulate_cells(shards, b.get("shard_useful", ()))
+                if "full_mesh_cells" in b:
+                    fm_cells += b["full_mesh_cells"]
+                    fm_useful += b["useful_cells"]
+            if shards:
+                e["shard_useful"] = shards
+                if min(shards) > 0:
+                    e["shard_balance"] = round(
+                        max(shards) / min(shards), 4)
+            if total:
+                e["useful_cells"] = useful
+                e["total_cells"] = total
+                e["padded_frac"] = round((total - useful) / total, 6)
+            if fm_cells:
+                e["full_mesh_cells"] = fm_cells
+                e["full_mesh_useful"] = fm_useful
+                e["padded_frac_full_mesh"] = round(
+                    (fm_cells - fm_useful) / fm_cells, 6)
         for engine, c in compiles.items():
             e = out.setdefault(engine, {"buckets": {}})
             e["compiles"] = c["compiles"]

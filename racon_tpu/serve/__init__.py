@@ -28,10 +28,11 @@ benchmarks: tools/servebench.py; failure matrix: tools/faultcheck.py
 
 from .batcher import WindowBatcher
 from .client import (JobFailed, PolishClient, PolishResult, QueueFull,
-                     ServeError, ServerDraining)
+                     ServeError, ServerDraining, TenantQuota)
 from .queue import Job, JobQueue
 from .server import PolishServer, ServeConfig, make_synth_dataset
 
 __all__ = ["WindowBatcher", "PolishClient", "PolishResult", "PolishServer",
            "ServeConfig", "Job", "JobQueue", "ServeError", "QueueFull",
-           "ServerDraining", "JobFailed", "make_synth_dataset"]
+           "ServerDraining", "TenantQuota", "JobFailed",
+           "make_synth_dataset"]

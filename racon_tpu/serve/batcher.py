@@ -43,12 +43,31 @@ never waits when a full iteration is already pending.
 
 Isolation: a job carrying its own fault plan or a strict posture never
 shares an iteration — it runs its polisher's own `_consensus_pass()`
-(own pipeline, own injected faults) under the exec lock, so an injected
-`DeviceError` storm fails exactly one job while the feeder, the warm
-engines and every concurrent job continue untouched. An engine-pass
+(own pipeline, own injected faults) solo on ONE lane, so an injected
+`DeviceError` storm fails exactly one job while the feeders, the warm
+engines and every concurrent job continue untouched. Scope note: the
+lane pin covers the CONSENSUS pass (the batcher's domain); a job that
+additionally arms the device aligner runs its align phase inside
+`Polisher.initialize()` on the worker thread BEFORE it reaches the
+batcher, over the full mesh — serve jobs default to host alignment, so
+this only matters when a request opts into `tpu_aligner_batches`. An engine-pass
 failure inside a shared iteration fails every job with windows IN that
 iteration (their remaining pooled windows are withdrawn); jobs in other
-iterations and the feeder itself survive.
+iterations and the feeders themselves survive.
+
+WORKER LANES (`worker_lanes` / RACON_TPU_WORKER_LANES / `serve
+--worker-lanes`, default 1 = the single-feeder behavior): the device
+list partitions into K contiguous SUB-MESHES (parallel.mesh
+.partition_devices), each backed by its own BatchRunner, feeder thread
+and execution lock — so iterations (including ones for different
+engine-parameter keys, which can never share a batch anyway) run
+CONCURRENTLY across the slice instead of queueing on one full-mesh
+exec lock. Per-window consensus is independent of both batch
+composition and mesh width, so output stays byte-identical at any lane
+count (test-pinned at --worker-lanes {1,2}). Isolation jobs pick the
+least-busy lane and hold only ITS lock. `K` clamps to the device
+count; per-lane iteration/busy telemetry rides `snapshot()` and the
+serve `scrape` (one busy gauge per lane).
 """
 
 from __future__ import annotations
@@ -183,6 +202,28 @@ def _trace_ids(tickets) -> list[str]:
             if tid]
 
 
+class _Lane:
+    """One worker lane: a sub-mesh BatchRunner, its own exec lock (the
+    feeder thread and any isolation job routed here serialize on it; two
+    LANES never share it), its own BatchScheduler/OccupancyStats (so a
+    per-iteration compile delta is exact — a shared stats object would
+    charge one lane's concurrent compile into another lane's delta
+    window) and its telemetry counters. Counter fields are guarded by
+    the batcher's `_cond`."""
+
+    __slots__ = ("index", "runner", "scheduler", "lock", "busy",
+                 "iterations", "busy_s")
+
+    def __init__(self, index: int, runner, scheduler):
+        self.index = index
+        self.runner = runner
+        self.scheduler = scheduler
+        self.lock = threading.Lock()
+        self.busy = False
+        self.iterations = 0
+        self.busy_s = 0.0
+
+
 def _engine_key(p) -> tuple:
     """Engine-parameter identity: jobs share an iteration only when
     every knob that can influence a window's consensus bytes matches."""
@@ -208,12 +249,29 @@ class WindowBatcher:
     coalesce before a short iteration (0 = dispatch immediately)."""
 
     def __init__(self, iteration_windows: int = 256,
-                 max_wait_s: float = 0.0, scheduler=None):
+                 max_wait_s: float = 0.0, scheduler=None,
+                 worker_lanes: int | None = None, devices=None):
+        import os
+
         from ..pipeline import PipelineStats
         from ..sched import BatchScheduler
 
         self.iteration_windows = max(1, int(iteration_windows))
         self.max_wait_s = max(0.0, float(max_wait_s))
+        #: sub-mesh worker lanes (see module docstring); None defers to
+        #: RACON_TPU_WORKER_LANES, default 1 — the single-feeder path
+        if worker_lanes is None:
+            try:
+                worker_lanes = int(
+                    os.environ.get("RACON_TPU_WORKER_LANES", "") or 1)
+            except ValueError:
+                worker_lanes = 1
+        self.worker_lanes = max(1, int(worker_lanes))
+        #: explicit device list (tests); None = auto-discovery with the
+        #: RACON_TPU_MAX_DEVICES cap, resolved lazily at first consensus
+        #: so constructing a batcher never forces the jax import
+        self._devices = devices
+        self._lanes: list[_Lane] | None = None
         #: one scheduler + stage-stat sink for every shared iteration:
         #: the server-lifetime occupancy/compile telemetry servebench
         #: reads
@@ -229,15 +287,17 @@ class WindowBatcher:
         #: [arrival_seq, arrival_t, ticket, window]
         self._pools: dict[tuple, list] = {}
         self._entry_seq = itertools.count()
-        self._exec_lock = threading.Lock()
         self._iter_seq = itertools.count()
-        self._feeder: threading.Thread | None = None
+        #: per-lane feeder threads, indexed by lane (None = not yet
+        #: spawned; dead feeders are respawned at the next submit)
+        self._feeders: list[threading.Thread | None] = []
         self._stop = False
         self._held = False
         self.counters = {"iterations": 0, "solo_iterations": 0,
                          "shared_iterations": 0, "jobs": 0, "windows": 0,
                          "max_jobs_in_iteration": 0,
-                         "max_windows_in_iteration": 0}
+                         "max_windows_in_iteration": 0,
+                         "max_concurrent_iterations": 0}
 
     # ------------------------------------------------------------ entry
     def consensus(self, polisher, on_windows=None) -> None:
@@ -253,12 +313,26 @@ class WindowBatcher:
 
         if polisher.faults is not None or strict_mode():
             # isolation iteration: injected faults / strict posture stay
-            # on this job's own pipeline and never touch a shared batch
+            # on this job's own pipeline and never touch a shared batch.
+            # It runs SOLO on the least-busy lane — holding only that
+            # lane's lock and dispatching on its sub-mesh — so the other
+            # lanes' iterations keep flowing underneath a poisoned job
+            with self._cond:
+                lanes = self._lanes_locked()
+                lane = min(lanes, key=lambda l: (l.busy, l.index))
             it = next(self._iter_seq)
-            t0 = time.perf_counter()
-            with self._exec_lock:
-                polisher._consensus_pass()
-            t1 = time.perf_counter()
+            polisher.device_runner = lane.runner
+            with lane.lock:
+                # clock starts INSIDE the lock (the shared-iteration
+                # discipline): time spent queueing behind a running
+                # iteration must not inflate the lane's busy seconds
+                t0 = time.perf_counter()
+                self._lane_busy(lane, True)
+                try:
+                    polisher._consensus_pass()
+                finally:
+                    t1 = time.perf_counter()
+                    self._lane_busy(lane, False, t1 - t0)
             if self.hists is not None:
                 self.hists.observe("serve.iteration", t1 - t0)
             self._account(1, len(polisher.windows), solo=True)
@@ -320,32 +394,86 @@ class WindowBatcher:
             raise ticket.error
         polisher.serve_batch = ticket.batch_info()
 
+    # ----------------------------------------------------------- lanes
+    def _lanes_locked(self) -> list[_Lane]:
+        """Build the lane partition on first use (caller holds `_cond`):
+        one sub-mesh BatchRunner per lane over a contiguous slice of the
+        device list, plus one scheduler/stats instance per lane (the
+        single-lane case keeps the batcher's own — today's behavior
+        exactly). worker_lanes=1 keeps today's single full-mesh lane;
+        K clamps to the device count."""
+        if self._lanes is None:
+            from ..parallel.mesh import BatchRunner, partition_devices
+            from ..sched import BatchScheduler, OccupancyStats
+
+            base = BatchRunner(devices=self._devices)
+            if self.worker_lanes == 1 or base.n_devices == 1:
+                self._lanes = [_Lane(0, base, self.scheduler)]
+            else:
+                lanes = []
+                for i, group in enumerate(partition_devices(
+                        base.devices, self.worker_lanes)):
+                    sched = BatchScheduler(
+                        adaptive=self.scheduler.adaptive,
+                        stats=OccupancyStats())
+                    sched.stats.hists = self.scheduler.stats.hists
+                    lanes.append(_Lane(i, BatchRunner(devices=group),
+                                       sched))
+                self._lanes = lanes
+        return self._lanes
+
+    def _lane_busy(self, lane: _Lane, busy: bool,
+                   dt: float = 0.0) -> None:
+        """Flip a lane's busy flag (the scrape gauge) and, on release,
+        charge the iteration to its counters; tracks the high-water mark
+        of concurrently-executing lanes — servebench's receipt that the
+        lanes genuinely overlap."""
+        with self._cond:
+            lane.busy = busy
+            if busy:
+                n = sum(1 for l in (self._lanes or ()) if l.busy)
+                self.counters["max_concurrent_iterations"] = max(
+                    self.counters["max_concurrent_iterations"], n)
+            else:
+                lane.iterations += 1
+                lane.busy_s += dt
+
     # ----------------------------------------------------------- feeder
     def _ensure_feeder_locked(self) -> None:
-        """Start the feeder thread lazily (caller holds `_cond` and has
-        already checked `_stop` — a refused submit must not spawn a
-        throwaway thread or clobber the handle close() is joining)."""
-        if self._feeder is not None and self._feeder.is_alive():
-            return
-        t = threading.Thread(target=self._feeder_loop,
-                             name="racon-tpu-serve-feeder",
-                             daemon=True)
-        self._feeder = t
-        t.start()
+        """Start one feeder thread per lane lazily, and RESTART any lane
+        whose feeder died (caller holds `_cond` and has already checked
+        `_stop` — a refused submit must not spawn throwaway threads or
+        clobber handles close() is joining). Per-lane granularity
+        matters: a feeder killed by an unexpected pool-scan error must
+        not leave its sub-mesh permanently idle while the siblings keep
+        the batcher looking alive."""
+        lanes = self._lanes_locked()
+        if len(self._feeders) < len(lanes):
+            self._feeders += [None] * (len(lanes) - len(self._feeders))
+        for lane in lanes:
+            t = self._feeders[lane.index]
+            if t is not None and t.is_alive():
+                continue
+            t = threading.Thread(target=self._feeder_loop, args=(lane,),
+                                 name="racon-tpu-serve-feeder-"
+                                      f"{lane.index}",
+                                 daemon=True)
+            self._feeders[lane.index] = t
+            t.start()
 
     def close(self, timeout: float = 5.0) -> None:
-        """Stop the feeder once the pool is empty. Jobs already pooled
+        """Stop the feeders once the pool is empty. Jobs already pooled
         finish; new consensus() calls are refused."""
         with self._cond:
             self._stop = True
             self._held = False
             self._cond.notify_all()
-        feeder = self._feeder
-        if feeder is not None and feeder.is_alive() \
-                and feeder is not threading.current_thread():
-            feeder.join(timeout)
+        for feeder in self._feeders:
+            if feeder is not None and feeder.is_alive() \
+                    and feeder is not threading.current_thread():
+                feeder.join(timeout)
 
-    def _feeder_loop(self) -> None:
+    def _feeder_loop(self, lane: _Lane) -> None:
         while True:
             batch = None
             with self._cond:
@@ -370,7 +498,7 @@ class WindowBatcher:
                              if len(p) >= self.iteration_windows),
                             None)
                         if full is not None:
-                            batch = self._extract_locked(full)
+                            batch = self._extract_locked(full, lane)
                             break
                         # brief coalescing wait, bounded by the OLDEST
                         # entry's age
@@ -379,12 +507,12 @@ class WindowBatcher:
                         if left > 0:
                             self._cond.wait(min(left, 0.5))
                             continue
-                    batch = self._extract_locked(key)
+                    batch = self._extract_locked(key, lane)
                     break
             if not batch:
                 continue
             try:
-                self._run_iteration(batch)
+                self._run_iteration(batch, lane)
             except BaseException as exc:  # noqa: BLE001 — the feeder
                 # must outlive any single iteration: fail the
                 # participants, keep draining the pool
@@ -404,17 +532,20 @@ class WindowBatcher:
                 best, best_seq = key, seq
         return best
 
-    def _extract_locked(self, key: tuple) -> list:
+    def _extract_locked(self, key: tuple, lane: _Lane) -> list:
         """Take one iteration's entries via the sched layer's
         incremental packing: a shape-homogeneous slab of at most
         `iteration_windows` windows that contains (and therefore
-        ships) the oldest pending entry."""
+        ships) the oldest pending entry, rounded to the extracting
+        LANE's device multiple when the pool is deep enough (zero
+        round_batch padding lanes on the sub-mesh)."""
         from ..sched import pack_iteration
 
         batch, rest = pack_iteration(
             self._pools[key], self.iteration_windows,
             shape_key=lambda e: _shape_key(e[3]),
-            age_key=lambda e: e[0])
+            age_key=lambda e: e[0],
+            lane_multiple=lane.runner.n_devices)
         if rest:
             self._pools[key] = rest
         else:
@@ -422,12 +553,34 @@ class WindowBatcher:
         return batch
 
     # -------------------------------------------------------- execution
-    def _compile_totals(self) -> tuple[int, float]:
-        snap = self.scheduler.stats.snapshot()
+    def _merged_stats(self):
+        """One OccupancyStats view across the batcher's own stats and
+        every distinct per-lane instance (a scratch merge — cheap, the
+        counters are a handful of dicts)."""
+        from ..sched import OccupancyStats
+
+        with self._cond:
+            lanes = list(self._lanes or ())
+        parts = [self.scheduler.stats] + [
+            lane.scheduler.stats for lane in lanes
+            if lane.scheduler is not self.scheduler]
+        if len(parts) == 1:
+            return self.scheduler.stats
+        merged = OccupancyStats()
+        for p in parts:
+            merged.merge_from(p)
+        return merged
+
+    def _compile_totals(self, stats=None) -> tuple[int, float]:
+        """(compiles, compile_s) of `stats` — one lane's instance for
+        per-iteration deltas (exact under lane concurrency), or the
+        merged server-lifetime view when omitted."""
+        snap = (stats if stats is not None
+                else self._merged_stats()).snapshot()
         return (sum(e.get("compiles", 0) for e in snap.values()),
                 sum(e.get("compile_s", 0.0) for e in snap.values()))
 
-    def _run_iteration(self, batch: list) -> None:
+    def _run_iteration(self, batch: list, lane: _Lane) -> None:
         from ..ops.poa import BatchPOA
         from ..pipeline import DispatchPipeline
         from ..resilience import Watchdog
@@ -441,8 +594,9 @@ class WindowBatcher:
         it = next(self._iter_seq)
         progress = _IterProgress(
             [(t, len(ws)) for t, ws in per_ticket.items()], it)
-        with self._exec_lock:
-            pre_c, pre_s = self._compile_totals()
+        with lane.lock:
+            self._lane_busy(lane, True)
+            pre_c, pre_s = self._compile_totals(lane.scheduler.stats)
             pipeline = DispatchPipeline(
                 depth=p0.tpu_pipeline_depth,
                 stats=self.pipeline_stats,
@@ -459,16 +613,21 @@ class WindowBatcher:
                                       else None),
                               engine=p0.tpu_engine,
                               pipeline=pipeline,
-                              scheduler=self.scheduler)
+                              scheduler=lane.scheduler,
+                              runner=lane.runner)
             t0 = time.perf_counter()
-            with pipeline:
-                engine.generate_consensus(windows, p0.trim)
-            t1 = time.perf_counter()
-            post_c, post_s = self._compile_totals()
+            try:
+                with pipeline:
+                    engine.generate_consensus(windows, p0.trim)
+            finally:
+                t1 = time.perf_counter()
+                self._lane_busy(lane, False, t1 - t0)
+            post_c, post_s = self._compile_totals(lane.scheduler.stats)
         tr = trace.get_tracer()
         if tr is not None:
             tr.complete("serve.iteration", t0, t1,
-                        {"iteration": it, "jobs": len(tickets),
+                        {"iteration": it, "lane": lane.index,
+                         "jobs": len(tickets),
                          "windows": len(windows),
                          "trace_ids": _trace_ids(tickets)})
         if self.hists is not None:
@@ -539,9 +698,18 @@ class WindowBatcher:
     def snapshot(self) -> dict:
         with self._cond:
             out = dict(self.counters)
-        compiles, compile_s = self._compile_totals()
+            out["worker_lanes"] = (len(self._lanes)
+                                   if self._lanes is not None
+                                   else self.worker_lanes)
+            out["lanes"] = [
+                {"lane": l.index, "n_devices": l.runner.n_devices,
+                 "iterations": l.iterations,
+                 "busy": l.busy, "busy_s": round(l.busy_s, 4)}
+                for l in (self._lanes or ())]
+        stats = self._merged_stats()
+        compiles, compile_s = self._compile_totals(stats)
         out["compiles"] = compiles
         out["compile_s"] = round(compile_s, 3)
-        out["occupancy"] = self.scheduler.stats.snapshot()
+        out["occupancy"] = stats.snapshot()
         out["pipeline"] = self.pipeline_stats.snapshot()
         return out

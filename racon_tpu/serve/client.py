@@ -8,6 +8,8 @@ exception taxonomy below, so callers branch on types, not message
 strings:
 
     QueueFull       admission control rejected; `retry_after` seconds
+    TenantQuota     this tenant's queued-job quota is full (QueueFull
+                    subclass, same `retry_after` backoff contract)
     ServerDraining  server is shutting down, resubmit elsewhere
     JobFailed       the job ran and failed; `error_type` names the
                     errors.py class (DeviceError, DeviceTimeout, ...)
@@ -69,6 +71,16 @@ class ServerDraining(ServeError):
     pass
 
 
+class TenantQuota(QueueFull):
+    """Per-tenant admission quota hit; carries `retry_after` like a
+    full-queue reject (and subclasses QueueFull, so `retries=` backoff
+    in submit() covers it too)."""
+
+    def __init__(self, code, message, response):
+        super().__init__(code, message, response)
+        self.tenant = response.get("tenant", "")
+
+
 class JobFailed(ServeError):
     def __init__(self, code, message, response):
         super().__init__(code, message, response)
@@ -76,7 +88,7 @@ class JobFailed(ServeError):
 
 
 _ERROR_TYPES = {"queue-full": QueueFull, "draining": ServerDraining,
-                "job-failed": JobFailed}
+                "tenant-quota": TenantQuota, "job-failed": JobFailed}
 
 
 class PolishResult:

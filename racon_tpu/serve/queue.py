@@ -75,6 +75,22 @@ class QueueFull(AdmissionError):
         self.retry_after = retry_after
 
 
+class TenantQuotaExceeded(AdmissionError):
+    """Hard per-tenant admission quota (RACON_TPU_SERVE_TENANT_QUOTA):
+    the tenant already has `quota` jobs QUEUED. Weights alone only shape
+    service ORDER — without this cap one tenant can still fill the whole
+    queue depth and every other tenant eats full-queue rejects."""
+
+    def __init__(self, tenant: str, quota: int, retry_after: float):
+        super().__init__(
+            f"tenant {tenant or '<anonymous>'!r} has {quota} job(s) "
+            f"queued (per-tenant quota {quota}); retry in "
+            f"{retry_after:.2f}s")
+        self.tenant = tenant
+        self.quota = quota
+        self.retry_after = retry_after
+
+
 class Draining(AdmissionError):
     def __init__(self):
         super().__init__("server is draining; not admitting jobs")
@@ -249,10 +265,17 @@ class JobQueue:
     MAX_TRACKED_TENANTS = 64
 
     def __init__(self, maxsize: int, workers: int = 1, hists=None,
-                 tenant_weights: dict | None = None):
+                 tenant_weights: dict | None = None,
+                 tenant_quota: int = 0):
         self.maxsize = max(1, int(maxsize))
         self.workers = max(1, int(workers))
         self.tenant_weights = dict(tenant_weights or {})
+        #: hard cap on QUEUED jobs per tenant (0 = off): admission-time
+        #: protection weights cannot give — see TenantQuotaExceeded
+        self.tenant_quota = max(0, int(tenant_quota))
+        #: live queued count per tenant (quota enforcement; jobs leave
+        #: the count at pop time, expired included)
+        self._queued_by_tenant: dict[str, int] = {}
         self._lock = threading.Lock()
         self._not_empty = threading.Condition(self._lock)
         #: priority -> _PriorityClass; scheduling pops the highest
@@ -285,8 +308,8 @@ class JobQueue:
         #: swallowed — accounting must never strand a job.
         self.on_event = None
         self.counters = {"submitted": 0, "admitted": 0, "rejected_full": 0,
-                         "rejected_draining": 0, "expired": 0,
-                         "completed": 0, "failed": 0,
+                         "rejected_draining": 0, "rejected_quota": 0,
+                         "expired": 0, "completed": 0, "failed": 0,
                          "deadline_hit": 0, "deadline_miss": 0}
         #: per-tenant lifetime counters (admitted/completed/failed) —
         #: the fairness story's receipt in stats/scrape
@@ -327,6 +350,17 @@ class JobQueue:
             if self._count >= self.maxsize:
                 self.counters["rejected_full"] += 1
                 raise QueueFull(self._retry_after_locked())
+            queued = self._queued_by_tenant.get(job.tenant, 0)
+            if self.tenant_quota and queued >= self.tenant_quota:
+                self.counters["rejected_quota"] += 1
+                # backoff until one of THIS tenant's queued jobs drains,
+                # from the same service-time EMA the full-queue hint uses
+                est = (self._ema_service_s * max(1, queued)
+                       / self.workers)
+                raise TenantQuotaExceeded(
+                    job.tenant, self.tenant_quota,
+                    min(max(est, self.RETRY_MIN), self.RETRY_MAX))
+            self._queued_by_tenant[job.tenant] = queued + 1
             self.counters["admitted"] += 1
             self._tenant_counter_locked(job.tenant)["admitted"] += 1
             cls = self._classes.setdefault(job.priority,
@@ -397,6 +431,13 @@ class JobQueue:
         job = q.popleft()
         cls.count -= 1
         self._count -= 1
+        # quota ledger: expired jobs pop through here too, so a tenant
+        # whose jobs all expired regains its quota slots
+        left = self._queued_by_tenant.get(job.tenant, 0) - 1
+        if left > 0:
+            self._queued_by_tenant[job.tenant] = left
+        else:
+            self._queued_by_tenant.pop(job.tenant, None)
         if not q:
             self._retire_tenant(cls.tenants, cls.rr, cls.deficit,
                                 tenant)

@@ -65,7 +65,8 @@ from ..utils.logger import log_info
 from .batcher import WindowBatcher
 from .protocol import (ProtocolError, error_response, max_frame_bytes,
                        recv_frame, send_frame)
-from .queue import Draining, Job, JobQueue, QueueFull
+from .queue import (Draining, Job, JobQueue, QueueFull,
+                    TenantQuotaExceeded)
 
 #: request option keys a submit may carry; anything else is rejected
 #: with `bad-request` (a typo'd knob must not silently polish with
@@ -173,6 +174,19 @@ class ServeConfig:
         self.iteration_windows = max(1, kw.pop(
             "iteration_windows",
             _env_int("RACON_TPU_SERVE_ITERATION_WINDOWS", 256)))
+        # sub-mesh worker lanes (serve/batcher.py): partition the device
+        # list into K independent sub-meshes, each with its own feeder
+        # thread + exec lock, so iterations run concurrently across the
+        # slice; 1 (the default) keeps the single full-mesh feeder
+        self.worker_lanes = max(1, kw.pop(
+            "worker_lanes", _env_int("RACON_TPU_WORKER_LANES", 1)))
+        # hard per-tenant admission quota (queue.py): cap on QUEUED jobs
+        # per tenant, rejected typed with retry_after; 0 = off. Weights
+        # shape service order; the quota is the only thing stopping one
+        # tenant from filling the whole queue depth
+        self.tenant_quota = max(0, kw.pop(
+            "tenant_quota",
+            _env_int("RACON_TPU_SERVE_TENANT_QUOTA", 0)))
         explicit_max_wait = "max_wait_s" in kw
         self.max_wait_s = max(0.0, kw.pop(
             "max_wait_s",
@@ -362,10 +376,12 @@ class PolishServer:
         self.hists = HistogramSet()
         self.queue = JobQueue(cfg.queue_depth, workers=cfg.workers,
                               hists=self.hists,
-                              tenant_weights=cfg.tenant_weights)
+                              tenant_weights=cfg.tenant_weights,
+                              tenant_quota=cfg.tenant_quota)
         self.batcher = WindowBatcher(
             iteration_windows=cfg.iteration_windows,
-            max_wait_s=cfg.max_wait_s)
+            max_wait_s=cfg.max_wait_s,
+            worker_lanes=cfg.worker_lanes)
         self.batcher.hists = self.hists
         self.batcher.pipeline_stats.hists = self.hists
         self.batcher.scheduler.stats.hists = self.hists
@@ -479,6 +495,8 @@ class PolishServer:
         log_info(f"[racon_tpu::serve] listening on {cfg.address} "
                  f"({cfg.workers} workers, queue depth "
                  f"{cfg.queue_depth}"
+                 + (f", {cfg.worker_lanes} worker lanes"
+                    if cfg.worker_lanes > 1 else "")
                  + (f", warm in {self._warm['warmup_s']:.2f}s"
                     if self._warm else "")
                  + (f", metrics on 127.0.0.1:{cfg.metrics_port}"
@@ -853,6 +871,15 @@ class PolishServer:
             return error_response("queue-full", str(exc),
                                   retry_after=round(exc.retry_after, 3),
                                   job_id=job_id)
+        except TenantQuotaExceeded as exc:
+            if self.journal is not None:
+                self.journal.record("rejected-quota", job=job.id,
+                                    trace=trace_id,
+                                    tenant=job.tenant or None,
+                                    retry_after=round(exc.retry_after, 3))
+            return error_response("tenant-quota", str(exc),
+                                  retry_after=round(exc.retry_after, 3),
+                                  tenant=job.tenant, job_id=job_id)
         except Draining as exc:
             if self.journal is not None:
                 self.journal.record("rejected-draining", job=job.id,
@@ -1182,13 +1209,16 @@ class PolishServer:
         b = self.batcher.snapshot()
         counters = {f"serve.jobs.{k}": q[k] for k in (
             "submitted", "admitted", "rejected_full",
-            "rejected_draining", "expired", "completed", "failed",
-            "deadline_hit", "deadline_miss")}
+            "rejected_draining", "rejected_quota", "expired",
+            "completed", "failed", "deadline_hit", "deadline_miss")}
         counters["serve.batch.iterations"] = b["iterations"]
         counters["serve.batch.shared_iterations"] = \
             b["shared_iterations"]
         counters["serve.batch.windows"] = b["windows"]
         counters["serve.compiles"] = b["compiles"]
+        for lane in b.get("lanes") or ():
+            counters[f"serve.lane.{lane['lane']}.iterations"] = \
+                lane["iterations"]
         # per-tenant fairness receipts. Tenant ids embed in the metric
         # NAME, so only ids that survive Prometheus sanitization
         # unchanged ([A-Za-z0-9_]) are exported — 'team.a' and
@@ -1221,7 +1251,13 @@ class PolishServer:
             "serve.inflight": self._inflight_count(),
             "serve.draining": self._draining.is_set(),
             "serve.service_time_ema_seconds": q["ema_service_s"],
+            "serve.worker_lanes": b.get("worker_lanes", 1),
         }
+        for lane in b.get("lanes") or ():
+            gauges[f"serve.lane.{lane['lane']}.busy"] = (
+                lane["busy"],
+                "1 while this worker lane is executing a device "
+                "iteration (sub-mesh occupancy view)")
         for engine, e in (b.get("occupancy") or {}).items():
             if "occupancy_pct" in e:
                 gauges[f"sched.{engine}.occupancy_pct"] = \
@@ -1309,6 +1345,20 @@ def serve_main(argv: list[str]) -> int:
                     help="per-tenant fair-scheduling weights, e.g. "
                          "'gold=4,free=1,default=1' "
                          "(RACON_TPU_SERVE_TENANT_WEIGHTS)")
+    ap.add_argument("--tenant-quota", type=int, default=None,
+                    help="hard per-tenant admission quota: max QUEUED "
+                         "jobs per tenant, excess submits rejected "
+                         "typed with retry_after "
+                         "(RACON_TPU_SERVE_TENANT_QUOTA, default 0 = "
+                         "off)")
+    ap.add_argument("--worker-lanes", type=int, default=None,
+                    help="partition the device mesh into this many "
+                         "sub-mesh worker lanes, each with its own "
+                         "feeder thread + engines, so device "
+                         "iterations run concurrently across the "
+                         "slice (RACON_TPU_WORKER_LANES, default 1; "
+                         "clamps to the device count; output stays "
+                         "byte-identical at any lane count)")
     ap.add_argument("--gather-ms", type=float, default=None,
                     help="DEPRECATED (round-barrier era): aliased to "
                          "--max-wait-ms with a deprecation warning")
@@ -1389,6 +1439,10 @@ def serve_main(argv: list[str]) -> int:
         kw["iteration_windows"] = args.iteration_windows
     if args.tenant_weights is not None:
         kw["tenant_weights"] = args.tenant_weights
+    if args.tenant_quota is not None:
+        kw["tenant_quota"] = args.tenant_quota
+    if args.worker_lanes is not None:
+        kw["worker_lanes"] = args.worker_lanes
     if args.gather_ms is not None:
         # deprecated alias: ServeConfig warns and maps it to max_wait_s
         kw["gather_window_s"] = args.gather_ms / 1000.0

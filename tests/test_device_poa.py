@@ -338,7 +338,7 @@ def test_session_stats_counters():
     session.close()
 
 
-def test_graft_entry_dryrun():
+def test_graft_entry_dryrun(capsys):
     import sys
     sys.path.insert(0, "/root/repo")
     import __graft_entry__
@@ -346,6 +346,13 @@ def test_graft_entry_dryrun():
     ranks = fn(*args)
     assert np.asarray(ranks).shape[0] == args[0].shape[0]
     __graft_entry__.dryrun_multichip(8)
+    # the dryrun's assertions must actually have RUN: its success line
+    # is the receipt. A skip sentinel (MULTICHIP_r01.json recorded one
+    # passing with rc 0) must fail here, not slip through tier 1.
+    out = capsys.readouterr().out
+    assert "__GRAFT_DRYRUN_SKIP__" not in out
+    assert "dryrun_multichip: 8-device batch-sharded POA + aligner + " \
+           "fused kernels OK" in out
 
 
 def test_max_nodes_env_knob_resolves_at_construction(monkeypatch, capsys):

@@ -285,3 +285,96 @@ def test_repo_current_artifacts_pass():
         capture_output=True, text=True, timeout=60)
     assert proc.returncode == 0, proc.stderr
     assert "[perfgate] PASS" in proc.stderr
+
+
+# --------------------------------------------------- mesh + scale gates
+def scale_artifact(identical=True, balance=1.2, padded=0.05,
+                   baseline=0.2, n_devices=8):
+    return {
+        "mode": "synth",
+        "synth": {"windows_per_s": 5.0},
+        "mesh": {"n_devices": n_devices, "worker_lanes": 1,
+                 "max_devices_env": str(n_devices)},
+        "scale": {"identical": identical, "curve": [
+            {"n_devices": 1, "windows_per_s": 2.0, "golden_sha": "a"},
+            {"n_devices": n_devices, "windows_per_s": 5.0,
+             "shard_balance": balance, "padded_frac": padded,
+             "padded_frac_full_mesh": baseline, "golden_sha": "a"},
+        ]},
+    }
+
+
+def test_cross_mesh_comparison_refused_rc2(tmp_path, capsys):
+    """The satellite: a --against reference measured on a different
+    mesh is a broken gate naming the mismatched key, never a verdict."""
+    ref = bench_artifact(100.0, 2.0)
+    ref["parsed"]["mesh"] = {"n_devices": 1, "worker_lanes": 1}
+    cand = bench_artifact(95.0, 1.9)
+    cand["parsed"]["mesh"] = {"n_devices": 8, "worker_lanes": 1}
+    ref_path = write(tmp_path / "BENCH_r01.json", ref)
+    write(tmp_path / "BENCH_r02.json", cand)
+    assert perfgate.main(["--dir", str(tmp_path),
+                          "--against", ref_path]) == 2
+    assert "mesh.n_devices" in capsys.readouterr().err
+    # same n_devices but different serve lane count: also refused
+    ref["parsed"]["mesh"] = {"n_devices": 8, "worker_lanes": 2}
+    write(tmp_path / "BENCH_r01.json", ref)
+    assert perfgate.main(["--dir", str(tmp_path),
+                          "--against", ref_path]) == 2
+    assert "mesh.worker_lanes" in capsys.readouterr().err
+    # identical mesh: the comparison proceeds (and passes at -5%)
+    ref["parsed"]["mesh"] = {"n_devices": 8, "worker_lanes": 1}
+    write(tmp_path / "BENCH_r01.json", ref)
+    assert perfgate.main(["--dir", str(tmp_path),
+                          "--against", ref_path]) == 0
+
+
+def test_mesh_block_optional_for_legacy_artifacts(tmp_path):
+    """Artifacts predating the mesh block still compare (no refusal
+    when either side lacks it)."""
+    ref = bench_artifact(100.0, 2.0)
+    cand = bench_artifact(95.0, 1.9)
+    cand["parsed"]["mesh"] = {"n_devices": 8, "worker_lanes": 1}
+    ref_path = write(tmp_path / "BENCH_r01.json", ref)
+    write(tmp_path / "BENCH_r02.json", cand)
+    assert perfgate.main(["--dir", str(tmp_path),
+                          "--against", ref_path]) == 0
+
+
+def test_scale_curve_gates(tmp_path, capsys):
+    """Scale artifacts gate shard balance (default 1.5), the strict
+    padded-vs-full-mesh-baseline win, and curve byte-identity."""
+    art = write(tmp_path / "BENCH_r01.json", scale_artifact())
+    assert perfgate.main(["--dir", str(tmp_path), "--artifact", art,
+                          "--windows-per-s-min", "1.0"]) == 0
+    # an imbalanced shard split fails the default 1.5 gate
+    write(tmp_path / "BENCH_r01.json", scale_artifact(balance=2.0))
+    assert perfgate.main(["--dir", str(tmp_path), "--artifact", art,
+                          "--windows-per-s-min", "1.0"]) == 1
+    assert "shard_balance" in capsys.readouterr().err
+    # ...but passes an explicitly laxer limit
+    assert perfgate.main(["--dir", str(tmp_path), "--artifact", art,
+                          "--windows-per-s-min", "1.0",
+                          "--scale-balance-max", "2.5"]) == 0
+    # padded fraction NOT strictly below the full-mesh baseline fails
+    write(tmp_path / "BENCH_r01.json",
+          scale_artifact(padded=0.2, baseline=0.2))
+    assert perfgate.main(["--dir", str(tmp_path), "--artifact", art,
+                          "--windows-per-s-min", "1.0"]) == 1
+    assert "padded_frac" in capsys.readouterr().err
+    # diverged FASTA across mesh sizes fails
+    write(tmp_path / "BENCH_r01.json", scale_artifact(identical=False))
+    assert perfgate.main(["--dir", str(tmp_path), "--artifact", art,
+                          "--windows-per-s-min", "1.0"]) == 1
+    assert "scale.identical" in capsys.readouterr().err
+
+
+def test_scale_balance_max_mandatory_when_requested(tmp_path, capsys):
+    """--scale-balance-max over an artifact without a scale block is a
+    named-key broken gate (the slo.miss_rate convention)."""
+    art = write(tmp_path / "BENCH_r01.json",
+                {"mode": "synth", "synth": {"windows_per_s": 5.0}})
+    assert perfgate.main(["--dir", str(tmp_path), "--artifact", art,
+                          "--windows-per-s-min", "1.0",
+                          "--scale-balance-max", "1.5"]) == 2
+    assert "scale.curve" in capsys.readouterr().err

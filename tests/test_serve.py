@@ -293,6 +293,153 @@ def test_late_job_joins_next_iteration_not_a_round(dataset, solo_bytes,
         srv.drain(timeout=10)
 
 
+def test_cross_job_identity_worker_lanes2(dataset, solo_bytes,
+                                          tmp_path_factory):
+    """THE worker-lanes acceptance pin (serve half): a --worker-lanes 2
+    server — device list partitioned into two sub-mesh lanes, each with
+    its own feeder — still produces exactly the solo-run bytes for
+    concurrent jobs, streamed parts included."""
+    sock = str(tmp_path_factory.mktemp("lanes") / "s.sock")
+    srv = PolishServer(socket_path=sock, workers=2, worker_lanes=2,
+                       warmup=False).start()
+    try:
+        assert srv.batcher.worker_lanes == 2
+        cl = PolishClient(socket_path=sock)
+        results = _pool_jobs(srv, cl, dataset, 2)
+        for r in results:
+            assert r is not None
+            assert r.fasta == solo_bytes
+        # streamed submit on the lanes server: parts concat identical
+        parts: list = []
+        assert cl.submit(*dataset,
+                         on_part=parts.append).fasta == solo_bytes
+        assert b"".join(p["fasta"].encode("latin-1")
+                        for p in parts) == solo_bytes
+        snap = srv.batcher.snapshot()
+        assert snap["worker_lanes"] == 2
+        assert len(snap["lanes"]) == 2
+        assert {ln["n_devices"] for ln in snap["lanes"]} == {4}
+        assert sum(ln["iterations"] for ln in snap["lanes"]) == \
+            snap["iterations"]
+    finally:
+        srv.drain(timeout=10)
+
+
+def test_worker_lanes_isolation_job_fails_alone(dataset, solo_bytes,
+                                                tmp_path_factory):
+    """Lane-level fault isolation: a strict fault-plan job runs SOLO on
+    one lane and fails typed, while a concurrent clean job (on the
+    other lane) returns byte-identical output and the server survives."""
+    sock = str(tmp_path_factory.mktemp("lanefault") / "s.sock")
+    srv = PolishServer(socket_path=sock, workers=2, worker_lanes=2,
+                       warmup=False).start()
+    try:
+        cl = PolishClient(socket_path=sock)
+        clean: dict = {}
+
+        def clean_job():
+            clean["r"] = cl.submit(*dataset, retries=3)
+
+        t = threading.Thread(target=clean_job)
+        t.start()
+        with pytest.raises(JobFailed) as exc_info:
+            # consensus-phase poison (host loop pack stage — the shape
+            # the existing poisoned-job gate uses); strict, so the
+            # isolation path runs it SOLO on one lane
+            cl.submit(*dataset, strict=True,
+                      fault_plan="pack:chunk=0:raise")
+        assert exc_info.value.error_type == "DeviceError"
+        t.join(60)
+        assert clean["r"].fasta == solo_bytes
+        # and the server still serves after the poisoned job
+        assert cl.submit(*dataset).fasta == solo_bytes
+    finally:
+        srv.drain(timeout=10)
+
+
+def test_tenant_quota_rejects_typed_with_retry_after():
+    """Hard per-tenant admission quota (unit level): the tenant at its
+    queued cap gets a typed reject with retry_after while OTHER tenants
+    still admit; popped jobs free quota slots."""
+    from racon_tpu.serve.queue import TenantQuotaExceeded
+
+    q = JobQueue(maxsize=8, tenant_quota=2)
+    q.submit(Job("a1", "s", "o", "t", {}, tenant="heavy"))
+    q.submit(Job("a2", "s", "o", "t", {}, tenant="heavy"))
+    with pytest.raises(TenantQuotaExceeded) as exc_info:
+        q.submit(Job("a3", "s", "o", "t", {}, tenant="heavy"))
+    assert exc_info.value.retry_after > 0
+    assert "heavy" in str(exc_info.value)
+    assert q.counters["rejected_quota"] == 1
+    # another tenant is unaffected by heavy's cap
+    q.submit(Job("b1", "s", "o", "t", {}, tenant="light"))
+    # popping one of heavy's jobs frees a slot
+    assert q.pop(timeout=0.5) is not None
+    q.submit(Job("a4", "s", "o", "t", {}, tenant="heavy"))
+    assert q.counters["admitted"] == 4
+
+
+def test_tenant_quota_end_to_end(dataset, tmp_path_factory):
+    """The quota over the wire: with RACON_TPU_SERVE_TENANT_QUOTA=1 a
+    tenant's second QUEUED job answers `tenant-quota` with retry_after
+    while a different tenant still admits."""
+    from racon_tpu.serve import TenantQuota
+
+    sock = str(tmp_path_factory.mktemp("quota") / "s.sock")
+    srv = PolishServer(socket_path=sock, workers=1, tenant_quota=1,
+                       warmup=False).start()
+    try:
+        cl = PolishClient(socket_path=sock)
+        srv.batcher.hold()  # keep the first job in flight
+        try:
+            outcomes: dict = {}
+
+            def submit(key, tenant):
+                try:
+                    outcomes[key] = cl.submit(*dataset, tenant=tenant)
+                except Exception as exc:  # noqa: BLE001 — asserted below
+                    outcomes[key] = exc
+
+            def wait_until(cond, what):
+                deadline = time.monotonic() + 30
+                while not cond():
+                    assert time.monotonic() < deadline, what
+                    time.sleep(0.01)
+
+            t = threading.Thread(target=submit, args=("j1", "gold"))
+            t.start()
+            # job 1 must have been POPPED by the (single) worker — the
+            # quota counts QUEUED jobs only, so its slot must be free
+            wait_until(lambda: srv.queue.counters["admitted"] == 1
+                       and len(srv.queue) == 0,
+                       "job 1 never reached the worker")
+            # job 2 queues (worker busy behind the held feeder)
+            t2 = threading.Thread(target=submit, args=("j2", "gold"))
+            t2.start()
+            wait_until(lambda: len(srv.queue) == 1,
+                       "job 2 never queued")
+            # job 3 hits gold's quota of 1 queued job
+            with pytest.raises(TenantQuota) as exc_info:
+                cl.submit(*dataset, tenant="gold")
+            assert exc_info.value.code == "tenant-quota"
+            assert exc_info.value.retry_after > 0
+            # a different tenant still admits past gold's cap
+            t3 = threading.Thread(target=submit, args=("j3", "free"))
+            t3.start()
+            wait_until(lambda: len(srv.queue) == 2,
+                       "free-tenant job never queued")
+            assert srv.queue.counters["rejected_quota"] == 1
+        finally:
+            srv.batcher.release()
+        for thread in (t, t2, t3):
+            thread.join(60)
+        for key in ("j1", "j2", "j3"):
+            assert not isinstance(outcomes.get(key), Exception), \
+                (key, outcomes.get(key))
+    finally:
+        srv.drain(timeout=10)
+
+
 def test_batcher_mixed_params_do_not_merge(dataset):
     """Jobs whose engine parameters differ must not share an iteration
     — and both must still match their own solo bytes."""
@@ -1044,12 +1191,16 @@ def test_stream_identity_under_quarantine(dataset, solo_bytes,
         srv.drain(timeout=10)
 
 
+@pytest.mark.parametrize("worker_lanes", [1, 2])
 def test_midstream_disconnect_kills_nothing(dataset, solo_bytes,
-                                            tmp_path_factory):
+                                            tmp_path_factory,
+                                            worker_lanes):
     """A streaming client that vanishes mid-job costs only its own
-    connection: the job still completes and is accounted, the feeder
-    and the next client are untouched."""
-    srv, cl = _serve_pair(tmp_path_factory, "unix", workers=2)
+    connection: the job still completes and is accounted, the feeders
+    and the next client are untouched — at one feeder lane and across
+    the two-sub-mesh lane partition alike."""
+    srv, cl = _serve_pair(tmp_path_factory, "unix", workers=2,
+                          worker_lanes=worker_lanes)
     try:
         sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
         sock.settimeout(15.0)

@@ -248,6 +248,60 @@ def _run_cell(paths, clean, depth, aligner, spec, timeout,
             + (f" ({', '.join(extras)})" if extras else ""))
 
 
+def run_serve_lanes_cell(client, paths, clean, aligner, spec, timeout):
+    """One serve-lanes2 cell: the row's fault as a per-job strict plan
+    against the shared --worker-lanes 2 server, CONCURRENT with a clean
+    job. Isolation jobs run solo on one lane, so the injected fault may
+    fail only the poisoned job (typed) while the clean job on the other
+    lane(s) returns bytes identical to the clean run."""
+    from racon_tpu.serve.client import JobFailed, ServeError
+
+    os.environ["RACON_TPU_DEVICE_RETRIES"] = "0"
+    opts = {"tpu_aligner_batches": aligner}
+    if timeout:
+        opts["tpu_device_timeout"] = timeout
+    clean_result: dict = {}
+
+    def clean_job():
+        try:
+            clean_result["resp"] = client.submit(
+                *paths, options={"tpu_aligner_batches": aligner},
+                retries=3)
+        except Exception as exc:  # noqa: BLE001 — checked below
+            clean_result["exc"] = exc
+
+    t = threading.Thread(target=clean_job)
+    t.start()
+    t0 = time.perf_counter()
+    try:
+        client.submit(*paths, fault_plan=spec, strict=True, options=opts)
+        t.join(WALL_CAP)
+        return "FAIL poisoned job succeeded"
+    except JobFailed as exc:
+        etype = exc.error_type
+        if etype not in ("DeviceError", "DeviceTimeout", "ChunkCorrupt"):
+            t.join(WALL_CAP)
+            return f"FAIL untyped failure ({etype})"
+    except ServeError as exc:
+        t.join(WALL_CAP)
+        return f"FAIL {exc.code}: {exc}"
+    except Exception as exc:
+        t.join(WALL_CAP)
+        return f"FAIL {type(exc).__name__}: {exc}"
+    if time.perf_counter() - t0 > WALL_CAP:
+        return f"FAIL over budget ({time.perf_counter() - t0:.0f}s)"
+    t.join(WALL_CAP)
+    if "exc" in clean_result:
+        return (f"FAIL concurrent clean job died "
+                f"({type(clean_result['exc']).__name__}: "
+                f"{clean_result['exc']})")
+    if "resp" not in clean_result:
+        return "FAIL concurrent clean job never finished"
+    if clean_result["resp"].fasta != clean[2, aligner]:
+        return "FAIL concurrent clean job diverged"
+    return f"pass  {etype}, clean lane identical"
+
+
 def run_serve_cell(client, paths, clean, aligner, spec, timeout):
     """One serve-column cell: the row's fault as a per-job plan, strict,
     against the shared live server (see module docstring)."""
@@ -331,7 +385,7 @@ def main() -> int:
         print(f"{'injection point':<{width}}  depth0"
               f"{'':<30}depth2{'':<30}depth2+sched"
               f"{'':<24}depth2+trace{'':<24}depth2+pallas"
-              f"{'':<23}serve", file=sys.stderr)
+              f"{'':<23}serve{'':<31}serve-lanes2", file=sys.stderr)
         # the 4th column runs with span tracing armed: the injected run
         # must additionally produce a valid Chrome trace whose
         # fault/quarantine instant events match the degradation
@@ -350,6 +404,16 @@ def main() -> int:
                               quality_threshold=-1.0,
                               warmup=False).start()
         client = PolishClient(socket_path=serve_sock)
+        # the 7th column shares a SECOND live server running two
+        # sub-mesh worker lanes: the poisoned strict job (solo on one
+        # lane) must fail typed while a CONCURRENT clean job on the
+        # other lane stays byte-identical — lane-level fault isolation
+        lanes_sock = os.path.join(tmp, "faultcheck_lanes.sock")
+        lanes_server = PolishServer(socket_path=lanes_sock, workers=2,
+                                    worker_lanes=2,
+                                    quality_threshold=-1.0,
+                                    warmup=False).start()
+        lanes_client = PolishClient(socket_path=lanes_sock)
         try:
             for name, aligner, spec, timeout, _slow in rows:
                 cells = []
@@ -363,12 +427,21 @@ def main() -> int:
                                       spec, timeout)
                 failures += cell.startswith("FAIL")
                 cells.append(f"{cell:<36}")
+                cell = run_serve_lanes_cell(lanes_client, paths, clean,
+                                            aligner, spec, timeout)
+                failures += cell.startswith("FAIL")
+                cells.append(f"{cell:<36}")
                 print(f"{name:<{width}}  {''.join(cells)}",
                       file=sys.stderr)
         finally:
             os.environ.pop("RACON_TPU_DEVICE_RETRIES", None)
-            server.drain(timeout=30)
-    n_cells = (len(columns) + 1) * len(rows)
+            try:
+                server.drain(timeout=30)
+            finally:
+                # a failed drain of the first server must not leak the
+                # lanes server's threads/socket
+                lanes_server.drain(timeout=30)
+    n_cells = (len(columns) + 2) * len(rows)
     print(f"[faultcheck] {'FAIL' if failures else 'PASS'}: "
           f"{n_cells - failures}/{n_cells} cells green",
           file=sys.stderr)

@@ -35,10 +35,22 @@ Metric extraction understands both artifact shapes:
     measure the reference sample, a different workload), so with only
     the floor requested the relative gate is skipped.
 
+  - synthbench `--scale-curve` artifacts additionally carry a `scale`
+    block: gated on byte-identity across mesh sizes, per-shard
+    useful-cell balance (`--scale-balance-max`, default 1.5 when the
+    block is present) and each multi-device point's padded-cell
+    fraction sitting STRICTLY below its full-mesh-rounding baseline.
+
+Artifacts that record a `mesh` block ({n_devices, worker_lanes, ...})
+are only compared against references measured on the SAME mesh — a
+cross-mesh `--against` exits 2 naming the mismatched key
+(`mesh.n_devices` / `mesh.worker_lanes`).
+
 A missing gated metric is a BROKEN GATE, not a traceback: the error
 names the dotted key (`warm.seq_p50_s`, `slo.miss_rate`,
-`warm.p99_s`, `warm.ttfb_p50_s`, `synth.windows_per_s`) and exits 2,
-so CI can tell "the artifact changed shape" from "perf regressed".
+`warm.p99_s`, `warm.ttfb_p50_s`, `synth.windows_per_s`,
+`scale.curve`) and exits 2, so CI can tell "the artifact changed
+shape" from "perf regressed".
 
 Baseline resolution, in order:
 
@@ -138,6 +150,8 @@ def extract(doc: dict, path: str = "<artifact>") -> dict:
             val = _lookup(inner, dotted)
             if val is not None:
                 out[key] = float(val)
+        if isinstance(inner.get("mesh"), dict):
+            out["mesh"] = inner["mesh"]
         return out
     if inner.get("mode") == "synth":
         # synthbench --json artifact: windows_per_s, HIGHER is better.
@@ -150,9 +164,12 @@ def extract(doc: dict, path: str = "<artifact>") -> dict:
             raise GateError(
                 f"{path}: artifact lacks gated metric "
                 "'synth.windows_per_s'")
-        return {"name": "synthbench windows/s", "value": float(value),
-                "unit": "windows/sec", "higher_better": True,
-                "kind": "synth"}
+        out = {"name": "synthbench windows/s", "value": float(value),
+               "unit": "windows/sec", "higher_better": True,
+               "kind": "synth"}
+        if isinstance(inner.get("mesh"), dict):
+            out["mesh"] = inner["mesh"]
+        return out
     if inner.get("unit") == "windows/sec":
         metric = str(inner.get("metric", ""))
         value = float(inner.get("value") or 0.0)
@@ -162,6 +179,8 @@ def extract(doc: dict, path: str = "<artifact>") -> dict:
                "higher_better": True}
         if inner.get("vs_baseline"):
             out["vs_baseline"] = float(inner["vs_baseline"])
+        if isinstance(inner.get("mesh"), dict):
+            out["mesh"] = inner["mesh"]
         return out
     raise GateError(f"{path}: unrecognized artifact shape "
                     f"(keys {sorted(inner)[:8]})")
@@ -294,6 +313,80 @@ def latency_checks(cand: dict, ref: dict | None, args,
     return checks
 
 
+def check_mesh_comparable(cand: dict, ref: dict | None,
+                          ref_desc: str) -> None:
+    """Refuse cross-mesh comparisons: an artifact measured on 1 chip vs
+    one measured on 8 (or at different serve worker-lane counts) is a
+    different machine, not a perf delta. Only enforced when BOTH
+    artifacts carry a mesh block (older artifacts predate it)."""
+    cm = cand.get("mesh")
+    rm = (ref or {}).get("mesh")
+    if not cm or not rm:
+        return
+    for key in ("n_devices", "worker_lanes"):
+        a, b = cm.get(key), rm.get(key)
+        if a is not None and b is not None and a != b:
+            raise GateError(
+                f"cross-mesh comparison refused: candidate "
+                f"mesh.{key}={a} vs reference ({ref_desc}) "
+                f"mesh.{key}={b} — re-measure on the same mesh or "
+                "pass --ref-value")
+
+
+def scale_checks(doc: dict, args,
+                 candidate_path: str) -> list[tuple[str, bool, str]]:
+    """Mesh-scaling gates for synthbench --scale-curve artifacts:
+    (name, ok, detail) triples. Gated whenever the artifact carries a
+    `scale` block (the slo.miss_rate convention) or the operator passed
+    --scale-balance-max explicitly — and an explicit request over an
+    artifact without the block is a named-key broken gate. Per point
+    with more than one device: per-shard useful-cell balance
+    (max/min <= the limit, default 1.5) and the tail-batch padded-cell
+    fraction STRICTLY below the full-mesh-rounding baseline (the
+    sub-mesh dispatch win must be real, not rounding noise); plus the
+    curve's byte-identity flag."""
+    explicit = args.scale_balance_max is not None
+    inner = doc.get("parsed", doc)
+    scale = inner.get("scale") if isinstance(inner, dict) else None
+    if not isinstance(scale, dict) or not scale.get("curve"):
+        if explicit:
+            raise GateError(
+                f"{candidate_path}: artifact lacks gated metric "
+                "'scale.curve' (--scale-balance-max gates synthbench "
+                "--scale-curve artifacts)")
+        return []
+    limit = args.scale_balance_max if explicit else 1.5
+    identical = bool(scale.get("identical"))
+    checks = [("scale.identical", identical,
+               "byte-identical FASTA across mesh sizes" if identical
+               else "FASTA DIVERGED across mesh sizes")]
+    for pt in scale["curve"]:
+        n = pt.get("n_devices")
+        if not n or n <= 1:
+            continue  # 1-device points have no shards and no rounding
+        bal = pt.get("shard_balance")
+        if bal is not None:
+            checks.append((f"scale.shard_balance[{n}dev]",
+                           bal <= limit, f"{bal:g} <= {limit:g}"))
+        elif pt.get("shard_useful"):
+            # shards were recorded but the balance is undefined: some
+            # shard saw ZERO useful cells — the worst imbalance, which
+            # must fail the gate rather than silently skip it
+            checks.append((f"scale.shard_balance[{n}dev]", False,
+                           "a shard recorded zero useful cells "
+                           "(balance undefined = total imbalance)"))
+        pf = pt.get("padded_frac")
+        pfm = pt.get("padded_frac_full_mesh")
+        if pf is not None and pfm is not None:
+            checks.append((f"scale.padded_frac[{n}dev]", pf < pfm,
+                           f"{pf:g} < full-mesh baseline {pfm:g}"
+                           + ("" if pf < pfm else
+                              " (equal = no sub-mesh tail was "
+                              "dispatched; use a workload whose batch "
+                              "counts aren't exact lane multiples)")))
+    return checks
+
+
 def wps_floor_check(cand: dict, args,
                     candidate_path: str) -> list[tuple[str, float, float]]:
     """Absolute windows/s floor (--windows-per-s-min): mandatory once
@@ -341,6 +434,11 @@ def run(args) -> int:
             reference, ref_desc, ref = None, "", None
         else:
             raise
+    # mesh comparability resolves BEFORE any relative verdict prints: a
+    # cross-mesh --against is a broken gate (rc 2 naming the key), never
+    # a spurious PASS/FAIL
+    if ref is not None:
+        check_mesh_comparable(cand, ref, ref_desc)
     failures = 0
     if reference is not None:
         ok, delta = gate(cand["value"], reference, args.tolerance_pct,
@@ -372,6 +470,12 @@ def run(args) -> int:
         print(f"[perfgate] {'PASS' if check_ok else 'FAIL'}: "
               f"{os.path.basename(candidate_path)} {name} = {value:g}s "
               f"(limit {limit:g}s, {kind})", file=sys.stderr)
+    for name, check_ok, detail in scale_checks(doc, args,
+                                               candidate_path):
+        failures += 0 if check_ok else 1
+        print(f"[perfgate] {'PASS' if check_ok else 'FAIL'}: "
+              f"{os.path.basename(candidate_path)} {name} ({detail})",
+              file=sys.stderr)
     return 0 if not failures else 1
 
 
@@ -419,6 +523,17 @@ def main(argv=None) -> int:
                          "time-to-first-byte p50 (warm.ttfb_p50_s); "
                          "same mandatory/relative semantics as "
                          "--p99-max")
+    ap.add_argument("--scale-balance-max", type=float, default=None,
+                    help="per-shard useful-cell balance bound (max/min) "
+                         "for synthbench --scale-curve artifacts "
+                         "(default: gate at 1.5 whenever the artifact "
+                         "carries a scale block; passing a value makes "
+                         "the gate mandatory — an artifact without "
+                         "scale.curve then exits 2). The scale block "
+                         "is also always gated on curve byte-identity "
+                         "and on each multi-device point's padded-cell "
+                         "fraction being strictly below its full-mesh-"
+                         "rounding baseline")
     args = ap.parse_args(argv)
     try:
         return run(args)

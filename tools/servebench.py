@@ -114,6 +114,15 @@ def simulate_contig(rng, genome_len, coverage, read_len):
     return simulate(rng, genome_len, coverage, read_len, 0.12, 0.10)
 
 
+def _mesh_block(batcher_snap: dict) -> dict:
+    """The shared mesh-block schema (parallel/mesh.py), with the serve
+    batcher's actual lane count riding in."""
+    from racon_tpu.parallel.mesh import mesh_info
+
+    return mesh_info(
+        worker_lanes=batcher_snap.get("worker_lanes", 1))
+
+
 def cold_cli_run(paths, args) -> tuple[float, bytes]:
     """One fresh-process CLI run: the full cold tax, wall-clocked."""
     env = {k: v for k, v in os.environ.items() if "axon" not in k.lower()}
@@ -337,6 +346,14 @@ def main(argv=None) -> int:
                     help="continuous feeder iteration bound passed to "
                          "the server (smaller = finer streaming "
                          "granularity and faster late-join turnaround)")
+    ap.add_argument("--worker-lanes", type=int, default=None,
+                    help="sub-mesh worker lanes passed to the server "
+                         "(RACON_TPU_WORKER_LANES): device iterations "
+                         "run concurrently across the lane partition; "
+                         "with > 1 the bench additionally gates that "
+                         "iterations really overlapped on distinct "
+                         "lanes (batcher max_concurrent_iterations "
+                         ">= 2)")
     ap.add_argument("--json", default=None,
                     help="write the bench-style JSON artifact here")
     ap.add_argument("--qps", type=float, default=None,
@@ -370,6 +387,17 @@ def main(argv=None) -> int:
                     help="--check-slo: per-job deadline_s attached to "
                          "every wave job (default 120)")
     args = ap.parse_args(argv)
+
+    if args.worker_lanes is not None and args.worker_lanes > 1:
+        # worker lanes partition the DEVICE LIST: on the CPU bench
+        # backend expose enough virtual devices for a real partition
+        # (must be set before jax initializes — the same trick the
+        # test conftest and synthbench --scale-curve use)
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
 
     from racon_tpu.serve import PolishClient, PolishServer
 
@@ -405,6 +433,8 @@ def main(argv=None) -> int:
         server_kw = {}
         if args.iteration_windows is not None:
             server_kw["iteration_windows"] = args.iteration_windows
+        if args.worker_lanes is not None:
+            server_kw["worker_lanes"] = args.worker_lanes
         server = PolishServer(
             socket_path=sock, workers=args.workers, warmup=False,
             job_threads=args.threads, journal=journal_path,
@@ -603,6 +633,28 @@ def main(argv=None) -> int:
           f"{b['max_jobs_in_iteration']} jobs / "
           f"{b['max_windows_in_iteration']} windows per iteration)",
           file=sys.stderr)
+    lanes = b.get("lanes") or []
+    if len(lanes) > 1:
+        per_lane = ", ".join(
+            f"lane {ln['lane']} ({ln['n_devices']} dev): "
+            f"{ln['iterations']} its / {ln['busy_s']:.2f}s busy"
+            for ln in lanes)
+        concurrent = b.get("max_concurrent_iterations", 0)
+        print(f"[servebench] worker lanes: {per_lane}; max "
+              f"{concurrent} iterations concurrent "
+              f"[{'OK' if concurrent >= 2 else 'FAIL'} overlap]",
+              file=sys.stderr)
+        if concurrent < 2:
+            fail.append("worker lanes never ran iterations "
+                        "concurrently (max_concurrent_iterations "
+                        f"{concurrent})")
+    elif args.worker_lanes is not None and args.worker_lanes > 1:
+        # the lane partition clamped away (e.g. an inherited XLA_FLAGS
+        # pinning a 1-device mesh): the promised overlap gate cannot
+        # run — that must FAIL loudly, not silently pass
+        fail.append(f"--worker-lanes {args.worker_lanes} requested but "
+                    f"the server ran {max(len(lanes), 1)} lane(s) — "
+                    "the device mesh was too small to partition")
     for engine, e in (b.get("occupancy") or {}).items():
         if e.get("buckets"):
             print(f"[servebench] {engine} occupancy "
@@ -643,7 +695,10 @@ def main(argv=None) -> int:
             "iterations": {k: b[k] for k in
                            ("iterations", "shared_iterations", "jobs",
                             "windows", "max_jobs_in_iteration",
-                            "max_windows_in_iteration")},
+                            "max_windows_in_iteration",
+                            "max_concurrent_iterations")},
+            "lanes": b.get("lanes") or [],
+            "mesh": _mesh_block(b),
             "occupancy": b.get("occupancy", {}),
             "metrics": {"queue": snap["queue"],
                         "batcher": {k: v for k, v in b.items()

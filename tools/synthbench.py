@@ -147,6 +147,155 @@ def simulate(rng, genome_len, coverage, read_len, read_err, draft_err):
     return truth, draft, reads, paf
 
 
+def _scale_child_env(repo: str, n_devices: int) -> dict:
+    """A scrubbed environment pinning the child to a CPU mesh of
+    `n_devices` virtual devices (the __graft_entry__ dryrun discipline:
+    no axon shim on the path, platform forced before jax init)."""
+    env = dict(os.environ)
+    keep = [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+            if p and "axon_site" not in p and p != repo]
+    env["PYTHONPATH"] = os.pathsep.join([repo] + keep)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = [f for f in env.get("XLA_FLAGS", "").split()
+             if "xla_force_host_platform_device_count" not in f]
+    flags.append(f"--xla_force_host_platform_device_count={n_devices}")
+    env["XLA_FLAGS"] = " ".join(flags)
+    env["RACON_TPU_MAX_DEVICES"] = str(n_devices)
+    env.setdefault("JAX_COMPILATION_CACHE_DIR",
+                   "/tmp/racon_tpu_jax_cache")
+    return env
+
+
+def _scale_point(n_devices: int, doc: dict, sha: str) -> dict:
+    """One scale-curve point from a child artifact: throughput plus the
+    mesh-waste view aggregated across every device engine's buckets —
+    per-shard useful-cell balance (max/min; 1.0 = perfectly even) and
+    the padded-cell fraction vs the full-mesh round_batch baseline (the
+    sub-mesh tail dispatch win)."""
+    from racon_tpu.sched.telemetry import accumulate_cells
+
+    shards: list[int] = []
+    useful = total = fm_cells = fm_useful = 0
+    for engine in (doc.get("occupancy") or {}).values():
+        # the engine-level raw sums OccupancyStats.snapshot() publishes
+        # — summed across engines here (fractions cannot be combined,
+        # raw cells can)
+        accumulate_cells(shards, engine.get("shard_useful", ()))
+        useful += engine.get("useful_cells", 0)
+        total += engine.get("total_cells", 0)
+        fm_cells += engine.get("full_mesh_cells", 0)
+        fm_useful += engine.get("full_mesh_useful", 0)
+    synth = doc.get("synth") or {}
+    point = {"n_devices": n_devices,
+             "windows_per_s": synth.get("windows_per_s"),
+             "windows": synth.get("windows"),
+             "polish_s": synth.get("polish_s"),
+             "golden_sha": sha}
+    if shards:
+        point["shard_useful"] = shards
+        if min(shards) > 0:
+            point["shard_balance"] = round(max(shards) / min(shards), 4)
+    if total:
+        point["padded_frac"] = round((total - useful) / total, 6)
+    if fm_cells:
+        point["padded_frac_full_mesh"] = round(
+            (fm_cells - fm_useful) / fm_cells, 6)
+    return point
+
+
+def run_scale_curve(args) -> int:
+    """--scale-curve N1,N2,...: re-run the SAME workload once per mesh
+    size (subprocess per point — the virtual device count must be
+    pinned before jax initializes), assert the polished FASTA is
+    byte-identical at every size, and emit a `scale` block in the
+    --json artifact: windows/s, per-shard useful-cell balance, and the
+    padded-cell fraction vs the full-mesh-rounding baseline per point —
+    the numbers tools/perfgate.py gates mesh regressions on."""
+    import hashlib
+    import json
+    import subprocess
+
+    sizes = sorted({int(s) for s in args.scale_curve.split(",")
+                    if s.strip()})
+    if not sizes or min(sizes) < 1:
+        print("[synthbench] --scale-curve wants positive device counts",
+              file=sys.stderr)
+        return 2
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    me = os.path.abspath(__file__)
+    curve, shas = [], []
+    with tempfile.TemporaryDirectory(prefix="racon_scale_") as d:
+        for n in sizes:
+            child_json = os.path.join(d, f"scale_{n}.json")
+            golden = os.path.join(d, f"golden_{n}.fasta")
+            cmd = [sys.executable, me,
+                   "--genome-kb", str(args.genome_kb),
+                   "--coverage", str(args.coverage),
+                   "--read-len", str(args.read_len),
+                   "--read-err", str(args.read_err),
+                   "--draft-err", str(args.draft_err),
+                   "-w", str(args.window_length),
+                   "-t", str(args.threads),
+                   "-c", str(args.tpupoa_batches),
+                   "--tpualigner-batches", str(args.tpualigner_batches),
+                   "--seed", str(args.seed),
+                   "--json", child_json, "--golden-out", golden]
+            if args.adaptive_buckets:
+                cmd.append("--adaptive-buckets")
+            if args.fast_sim:
+                cmd.append("--fast-sim")
+            print(f"[synthbench] scale point: {n} device(s) ...",
+                  file=sys.stderr)
+            proc = subprocess.run(cmd, env=_scale_child_env(repo, n),
+                                  capture_output=True, text=True,
+                                  timeout=3600)
+            if proc.returncode != 0:
+                sys.stderr.write(proc.stderr[-4000:])
+                print(f"[synthbench] scale point {n} FAILED "
+                      f"(rc {proc.returncode})", file=sys.stderr)
+                return 1
+            with open(child_json) as fh:
+                doc = json.load(fh)
+            with open(golden, "rb") as fh:
+                sha = hashlib.sha256(fh.read()).hexdigest()
+            shas.append(sha)
+            point = _scale_point(n, doc, sha)
+            curve.append(point)
+            print(f"[synthbench]   {n} device(s): "
+                  f"{point['windows_per_s']} windows/s, shard balance "
+                  f"{point.get('shard_balance', 'n/a')}, padded "
+                  f"{point.get('padded_frac', 'n/a')} (full-mesh "
+                  f"baseline {point.get('padded_frac_full_mesh', 'n/a')})"
+                  f", sha {sha[:12]}", file=sys.stderr)
+    identical = len(set(shas)) == 1
+    print(f"[synthbench] scale curve: polished FASTA "
+          f"{'byte-identical' if identical else 'DIVERGED'} across mesh "
+          f"sizes {sizes}", file=sys.stderr)
+    if args.json:
+        head = curve[-1]
+        artifact = {
+            "mode": "synth",
+            "synth": {"windows_per_s": head["windows_per_s"],
+                      "windows": head["windows"],
+                      "polish_s": head["polish_s"],
+                      "genome_kb": args.genome_kb,
+                      "coverage": args.coverage,
+                      "seed": args.seed},
+            "scale": {"curve": curve, "identical": identical},
+            # describes the headline (largest-mesh) CHILD, not this
+            # orchestrator process — the one artifact whose mesh block
+            # cannot come from the shared mesh_info() helper
+            "mesh": {"n_devices": head["n_devices"],
+                     "worker_lanes": 1,
+                     "max_devices_env": str(head["n_devices"])},
+        }
+        with open(args.json, "w") as fh:
+            json.dump(artifact, fh, indent=1, sort_keys=True)
+        print(f"[synthbench] wrote artifact {args.json}",
+              file=sys.stderr)
+    return 0 if identical else 1
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--genome-kb", type=int, default=200)
@@ -163,6 +312,15 @@ def main(argv=None):
                          "(adaptive shape ladders + sorted packing); "
                          "the occupancy report below A/Bs the win")
     ap.add_argument("--seed", type=int, default=42)
+    ap.add_argument("--scale-curve", default=None, metavar="N1,N2,...",
+                    help="mesh-scaling sweep: re-run this workload once "
+                         "per virtual-CPU mesh size (e.g. '1,2,4,8'), "
+                         "assert byte-identical polished FASTA across "
+                         "sizes, and record windows/s + per-shard "
+                         "useful-cell balance + padded-cell fraction "
+                         "vs the full-mesh-rounding baseline per point "
+                         "in the --json artifact (gated by "
+                         "tools/perfgate.py --scale-balance-max)")
     ap.add_argument("--fast-sim", action="store_true",
                     help="vectorized simulator for multi-Mb genomes "
                          "(deterministic per seed, but a different RNG "
@@ -198,6 +356,9 @@ def main(argv=None):
                          "(target: < 2%% — the serve-mode overhead "
                          "budget)")
     args = ap.parse_args(argv)
+
+    if args.scale_curve:
+        return run_scale_curve(args)
 
     from racon_tpu.core.polisher import create_polisher, PolisherType
     from racon_tpu.native import edit_distance
@@ -366,6 +527,8 @@ def main(argv=None):
     if args.json:
         import json
 
+        from racon_tpu.parallel.mesh import mesh_info
+
         artifact = {
             "mode": "synth",
             "synth": {
@@ -382,6 +545,10 @@ def main(argv=None):
             # per-bucket occupancy INCLUDING the dispatched kernel/dtype
             # choice — the autotuner's decision made visible per run
             "occupancy": polisher.occupancy_stats,
+            # the mesh this number was measured on: perfgate refuses
+            # cross-mesh comparisons (1-chip vs 8-chip windows/s is a
+            # different machine, not a regression)
+            "mesh": mesh_info(),
         }
         with open(args.json, "w") as fh:
             json.dump(artifact, fh, indent=1, sort_keys=True)
