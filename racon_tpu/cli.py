@@ -131,6 +131,15 @@ usage: racon_tpu [options ...] <sequences> <overlaps> <target sequences>
             when its overflow envelope proof holds (half the DP bytes,
             bit-identical results), int32 forces the wide oracle
             everywhere (mirrors RACON_TPU_DTYPE)
+        --tpu-fused <auto|0|1>
+            default: auto
+            fused-engine chunk dispatch: 1 = the single-launch fused
+            align->window-slice->POA program (device-side slicing, one
+            launch + one fetch per chunk), 0 = the split chained path,
+            auto = per depth bucket from the persisted autotuner winner
+            table. Output is byte-identical in every mode; a faulted
+            fused chunk falls back to the split path (mirrors
+            RACON_TPU_FUSED)
         --tpu-strict
             re-raise device failures instead of degrading to the host
             fallback / per-window quarantine (mirrors RACON_TPU_STRICT;
@@ -205,6 +214,7 @@ def parse_args(argv: list[str]) -> dict | None:
         "tpu_compile_cache": None,
         "tpu_pallas": None,
         "tpu_dtype": None,
+        "tpu_fused": None,
         "tpu_trace": None,
         "tpu_metrics": None,
         "tpu_log_level": None,
@@ -230,6 +240,13 @@ def parse_args(argv: list[str]) -> dict | None:
         if v not in ("auto", "int32", "int16"):
             print("racon_tpu: --tpu-dtype must be 'auto', 'int32' or "
                   "'int16'", file=sys.stderr)
+            sys.exit(1)
+        return v
+
+    def _fused_choice(v: str) -> str:
+        if v not in ("0", "1", "auto"):
+            print("racon_tpu: --tpu-fused must be '0', '1' or 'auto'",
+                  file=sys.stderr)
             sys.exit(1)
         return v
 
@@ -266,6 +283,7 @@ def parse_args(argv: list[str]) -> dict | None:
                   "tpu-compile-cache": ("tpu_compile_cache", str),
                   "tpu-pallas": ("tpu_pallas", _pallas_choice),
                   "tpu-dtype": ("tpu_dtype", _dtype_choice),
+                  "tpu-fused": ("tpu_fused", _fused_choice),
                   "tpu-trace": ("tpu_trace", str),
                   "tpu-metrics": ("tpu_metrics", str),
                   "tpu-log-level": ("tpu_log_level", _level_choice),
@@ -415,6 +433,8 @@ def main(argv: list[str] | None = None) -> int:
             os.environ["RACON_TPU_PALLAS"] = opts["tpu_pallas"]
         if opts["tpu_dtype"] is not None:
             os.environ["RACON_TPU_DTYPE"] = opts["tpu_dtype"]
+        if opts["tpu_fused"] is not None:
+            os.environ["RACON_TPU_FUSED"] = opts["tpu_fused"]
         if opts["tpu_fault_plan"]:
             from .resilience import FaultPlan
 

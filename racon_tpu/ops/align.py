@@ -559,17 +559,18 @@ class BatchAligner:
             # vs the batch's full n_waves x band x lanes — plus the mesh
             # view (per-shard useful split; what full-mesh round_batch
             # rounding would have dispatched)
+            from .device_program import shard_useful_split
+
             row_cells = [(len(pairs[i][0]) + len(pairs[i][1]) + 1) * band
                          for i in idx]
-            per = offs.shape[0] // r.n_devices
             self.sched.stats.record(
                 "aligner", (edge, band), jobs=len(idx),
                 lanes=offs.shape[0],
                 useful_cells=sum(row_cells),
                 total_cells=offs.shape[0] * n_waves * band,
                 kernel=kern, dtype=dtype, n_devices=r.n_devices,
-                shard_useful=[sum(row_cells[s * per:(s + 1) * per])
-                              for s in range(r.n_devices)],
+                shard_useful=shard_useful_split(row_cells, offs.shape[0],
+                                                r.n_devices),
                 full_mesh_cells=(runner.round_batch(len(idx))
                                  * n_waves * band))
             pl.stats.bump("launches")
@@ -590,7 +591,7 @@ class BatchAligner:
             return kern, (bp, dist), q_lens, t_lens, offs
 
         def unpack(chunk, res):
-            streak["n"] = 0  # a chunk came all the way back: device alive
+            breaker.ok()  # a chunk came all the way back: device alive
             edge, band, n_waves, idx = chunk
             kern, out, q_lens, t_lens, offs = res
             if kern == "pallas":
@@ -626,13 +627,16 @@ class BatchAligner:
                 # rejected pairs tick when the host fallback aligns them
                 progress(accepted)
 
-        #: consecutive-chunk-failure circuit breaker (the FusedPOA
-        #: discipline): one flaky chunk degrades to the host fallback,
-        #: but a wedged device must not cost a watchdog deadline + retry
-        #: per chunk for the whole phase — after MAX_STREAK in a row the
-        #: pass aborts and the polisher's whole-phase host fallback runs
-        streak = {"n": 0}
-        MAX_STREAK = 3
+        #: consecutive-chunk-failure circuit breaker — the shared seam
+        #: implementation (ops/device_program.ChunkBreaker): one flaky
+        #: chunk degrades to the host fallback, but a wedged device must
+        #: not cost a watchdog deadline + retry per chunk for the whole
+        #: phase — past the streak the pass aborts and the polisher's
+        #: whole-phase host fallback runs
+        from .device_program import ChunkBreaker
+
+        breaker = ChunkBreaker("BatchAligner", pl.stats,
+                               "the device alignment pass")
 
         def chunk_error(chunk, exc):
             # a chunk dead after watchdog/retry: its pairs host-align via
@@ -641,22 +645,7 @@ class BatchAligner:
             # with near-identical text — the first prints, repeats are
             # counted (RACON_TPU_LOG_LEVEL=debug shows each)
             edge, band, n_waves, idx = chunk
-            streak["n"] += 1
-            warn_dedup(
-                "BatchAligner.device_chunk_failed",
-                f"[racon_tpu::BatchAligner] warning: device chunk "
-                f"failed ({type(exc).__name__}: {exc}); {len(idx)} "
-                "pairs to host fallback")
-            if streak["n"] >= MAX_STREAK:
-                from ..errors import DeviceError
-
-                pl.stats.bump("breaker_trips")
-                err = DeviceError(
-                    "BatchAligner",
-                    f"{streak['n']} consecutive device chunk failures; "
-                    "aborting the device alignment pass")
-                err.__cause__ = exc
-                raise err
+            breaker.failed(exc, f"{len(idx)} pairs to host fallback")
             on_reject(list(idx))
 
         pl.run(chunks, pack, dispatch, wait, unpack,

@@ -86,6 +86,15 @@ class BatchPOA:
                 f"[racon_tpu::BatchPOA] invalid TPU engine "
                 f"{self.engine!r} (expected 'session' or 'fused'; set via "
                 "--tpu-engine or RACON_TPU_ENGINE)")
+        # device engines cached across generate_consensus calls: the
+        # serve feeder's persistent dispatch loop reuses ONE BatchPOA
+        # per lane+engine-key, so per-iteration engine construction
+        # (kernel plans, batch-width pinning, runner lookups) drops out
+        # of the iteration hot path. Everything in an engine's identity
+        # is fixed at BatchPOA construction; only the logger is rebound
+        # per call.
+        self._device_engine = None
+        self._session_net = None
 
     #: windows per host batch call (bounds peak packed-buffer memory)
     HOST_CHUNK = 4096
@@ -217,12 +226,15 @@ class BatchPOA:
         if self.engine == "fused":
             from .poa_fused import FusedPOA
 
-            fused = FusedPOA(self.match, self.mismatch, self.gap,
-                             num_threads=self.num_threads,
-                             logger=self.logger,
-                             banded_only=self.banded_only,
-                             scheduler=self.scheduler,
-                             runner=self.runner)
+            if self._device_engine is None:
+                self._device_engine = FusedPOA(
+                    self.match, self.mismatch, self.gap,
+                    num_threads=self.num_threads,
+                    banded_only=self.banded_only,
+                    scheduler=self.scheduler,
+                    runner=self.runner)
+            fused = self._device_engine
+            fused.logger = self.logger
             # RACON_TPU_FUSED_FALLBACK picks who polishes the windows the
             # fused engine cannot take (graph overflowed its envelope):
             # "session" (default) keeps the whole batch on device via the
@@ -250,17 +262,19 @@ class BatchPOA:
                 # telemetry still flows into the shared counters
                 from ..sched import BatchScheduler
 
-                static_sched = BatchScheduler(
-                    adaptive=False,
-                    stats=(self.scheduler.stats
-                           if self.scheduler is not None else None))
-                engine = DeviceGraphPOA(self.match, self.mismatch,
-                                        self.gap,
-                                        num_threads=self.num_threads,
-                                        logger=self.logger,
-                                        banded_only=self.banded_only,
-                                        scheduler=static_sched,
-                                        runner=self.runner)
+                if self._session_net is None:
+                    static_sched = BatchScheduler(
+                        adaptive=False,
+                        stats=(self.scheduler.stats
+                               if self.scheduler is not None else None))
+                    self._session_net = DeviceGraphPOA(
+                        self.match, self.mismatch, self.gap,
+                        num_threads=self.num_threads,
+                        banded_only=self.banded_only,
+                        scheduler=static_sched,
+                        runner=self.runner)
+                engine = self._session_net
+                engine.logger = self.logger
                 sub_res, sub_st = engine.consensus(
                     [packed[i] for i in rest])
                 for i, r, st in zip(rest, sub_res, sub_st):
@@ -269,12 +283,15 @@ class BatchPOA:
             else:
                 engine = fused
         else:
-            engine = DeviceGraphPOA(self.match, self.mismatch, self.gap,
-                                    num_threads=self.num_threads,
-                                    logger=self.logger,
-                                    banded_only=self.banded_only,
-                                    scheduler=self.scheduler,
-                                    runner=self.runner)
+            if self._device_engine is None:
+                self._device_engine = DeviceGraphPOA(
+                    self.match, self.mismatch, self.gap,
+                    num_threads=self.num_threads,
+                    banded_only=self.banded_only,
+                    scheduler=self.scheduler,
+                    runner=self.runner)
+            engine = self._device_engine
+            engine.logger = self.logger
             results, statuses = engine.consensus(packed)
         leftover = []
         for w, r in zip(todo, results):

@@ -62,6 +62,22 @@ CHAIN calls: the state arrays stream out of one call and into the next
 with a layer-index base, so arbitrary depth costs no extra host work
 beyond the fetch/feed of the fixed-size state.
 
+FUSED single-launch mode (RACON_TPU_FUSED=auto|0|1, default auto):
+instead of the chained per-bucket calls with host-side window slicing,
+one device program runs a chunk's WHOLE chain — banded graph alignment,
+the window-slicing decisions (spanning / bpos-range subgraph bounds /
+the static-band rule, derived on device from the raw layer coordinates)
+and the POA row-update ingest — as one jitted scan with donated state
+buffers, so aligned coordinates never leave the chip between stages and
+per-chunk Python dispatch collapses to one launch + one fetch.
+Bit-identical to the split path by construction (integer-exact slicing,
+same layer scan); `auto` arbitrates fused-vs-split per depth bucket via
+the persisted autotuner winner table (sched/autotune, engine
+"fused_loop") under the same identity veto as the kernel plane, and a
+fused chunk that faults falls back to the split chained path — its
+DECLARED fallback — byte-identically before anything reaches the host
+engine tail.
+
 Requires jax x64 (the order keys are int64); enabled at kernel build.
 """
 
@@ -72,7 +88,6 @@ import os
 
 import numpy as np
 
-from ..errors import DeviceError
 from ..resilience import strict_mode
 from ..utils.logger import Logger, log_info, warn_dedup
 #: envelope shared with the session engine (ONE source of truth, incl.
@@ -86,13 +101,32 @@ from .poa_graph import (MAX_LEN, MAX_NODES, MAX_PRED, RING,
 #: layers per call; deeper windows chain calls with carried state
 DEPTH_BUCKETS = (8, 16, 32, 64)
 
+#: deepest chunk the FUSED single-launch program takes (beyond it the
+#: split chained path runs — one compiled program per distinct chunk
+#: total-depth must stay bounded, and chain-sums past this are rare
+#: tails, not the hot path)
+FUSED_LOOP_MAX_DEPTH = 128
+
 _NEG = -(1 << 29)
+
+
+def fused_mode() -> str:
+    """RACON_TPU_FUSED posture for the single-launch fused
+    align→window-slice→POA program: '1' = fused whenever the chunk
+    fits FUSED_LOOP_MAX_DEPTH, '0' = always the split chained path
+    (the pre-fusion behavior), 'auto' (default) = per-bucket via the
+    persisted autotuner winner table (sched/autotune engine
+    "fused_loop"; a cold table dispatches split). Invalid values fall
+    back to auto — never crash a run over a typo'd knob."""
+    raw = (os.environ.get("RACON_TPU_FUSED") or "auto").strip().lower()
+    return raw if raw in ("auto", "0", "1") else "auto"
 
 
 @functools.lru_cache(maxsize=None)
 def fused_raw(n_nodes: int, seq_len: int, depth: int, max_pred: int,
               match: int, mismatch: int, gap: int,
-              banded_only: bool = False, score_dtype: str = "int32"):
+              banded_only: bool = False, score_dtype: str = "int32",
+              device_slice: bool = False):
     """Raw (traceable, un-jitted) whole-window POA builder for one
     (N, L, D, P) shape — `fused_builder` jits it for single-device
     dispatch; FusedPOA's BatchRunner shard_maps it for multi-chip
@@ -520,19 +554,63 @@ def fused_raw(n_nodes: int, seq_len: int, depth: int, max_pred: int,
              rlo.T, rhi.T, band.T, lidx_all))
         return state
 
-    return run
+    def run_sliced(codes, preds, predw, nseq, col_of, colkey, colnodes,
+                   bpos, n_nodes, n_cols, failed, seqs, lens, wts,
+                   begins, ends, bblen, offs, lbase):
+        """The FUSED variant: window slicing runs ON DEVICE. Layers
+        arrive as raw (begin, end) backbone coordinates plus per-row
+        backbone length / spanning offset, and each scan step derives
+        the bpos-range subgraph bounds (rlo/rhi) and the static-band
+        rule exactly as the host packer does (`_pack_chunk`) — integer
+        arithmetic only, so the derived operands are bit-identical to
+        the host-sliced ones and the aligned coordinates never leave
+        the chip between the slicing, alignment and ingest stages."""
+        state = (codes, preds, predw, nseq, col_of, colkey,
+                 colnodes, bpos, n_nodes, n_cols, failed)
+        lidx_all = (lbase[None, :].astype(jnp.int32)
+                    + jnp.arange(D, dtype=jnp.int32)[:, None])
+        bb32 = bblen.astype(jnp.int32)
+        of32 = offs.astype(jnp.int32)
+
+        def sliced(state, xs):
+            seq, slen, w, b, e, lidx = xs
+            b32 = b.astype(jnp.int32)
+            e32 = e.astype(jnp.int32)
+            # the host packer's spanning rule (reference
+            # window.cpp:97-102): offset precomputed per row on host
+            # (int(0.01 * bb_len) — float-truncation-exact)
+            spanning = (b32 < of32) & (e32 > bb32 - of32)
+            span = jnp.where(spanning, bb32, e32 - b32 + 1)
+            rlo = jnp.where(spanning, -32768, b32).astype(jnp.int16)
+            rhi = jnp.where(spanning, 32767, e32).astype(jnp.int16)
+            # the host engine's static-band rule: band 256 when the
+            # layer fits, exact DP otherwise
+            band = jnp.where(jnp.abs(slen - span) < 256 // 2 - 16,
+                             256, 0).astype(jnp.int32)
+            return one_layer(state, (seq, slen, w, rlo, rhi, band,
+                                     lidx))
+
+        state, _ = jax.lax.scan(
+            sliced, state,
+            (seqs.transpose(1, 0, 2), lens.T, wts.transpose(1, 0, 2),
+             begins.T, ends.T, lidx_all))
+        return state
+
+    return run_sliced if device_slice else run
 
 
 @functools.lru_cache(maxsize=None)
 def fused_builder(n_nodes: int, seq_len: int, depth: int, max_pred: int,
                   match: int, mismatch: int, gap: int,
-                  banded_only: bool = False, score_dtype: str = "int32"):
+                  banded_only: bool = False, score_dtype: str = "int32",
+                  device_slice: bool = False):
     """Single-device jitted variant of `fused_raw` (multi-chip dispatch
     goes through BatchRunner.run on the raw function instead)."""
     import jax
 
     run = fused_raw(n_nodes, seq_len, depth, max_pred, match, mismatch,
-                    gap, banded_only=banded_only, score_dtype=score_dtype)
+                    gap, banded_only=banded_only, score_dtype=score_dtype,
+                    device_slice=device_slice)
     # donate the state buffers on accelerators so chained calls mutate in
     # place instead of allocating a second copy of the graph arrays (the
     # CPU test backend can't donate and would warn on every call)
@@ -583,7 +661,8 @@ class FusedPOA:
                  max_nodes: int | None = None, max_len: int = MAX_LEN,
                  max_pred: int = MAX_PRED, batch_rows: int | None = None,
                  depth_buckets=DEPTH_BUCKETS, banded_only: bool = False,
-                 runner=None, scheduler=None):
+                 runner=None, scheduler=None,
+                 use_fused: bool | None = None):
         from ..parallel.mesh import BatchRunner
         from ..sched import BatchScheduler
 
@@ -617,10 +696,19 @@ class FusedPOA:
         #: SAME ladder, or the precompiled programs would be discarded)
         self._depth_k = len(self.depth_buckets)
         self.last_stats = {"chunks": 0, "launches": 0, "pack_s": 0.0,
-                           "device_s": 0.0, "unpack_s": 0.0}
+                           "device_s": 0.0, "unpack_s": 0.0,
+                           "fused_chunks": 0, "fused_fallbacks": 0}
         # -b / banded-only: trust banded DP results (skip the clipped ->
         # full-DP retry), the reference's GPU-only speed/accuracy trade
         self.banded_only = banded_only
+        #: fused single-launch posture (see fused_mode): the constructor
+        #: bool forces it on/off for tests, None defers to
+        #: RACON_TPU_FUSED; per-depth-bucket winner lookups cache here
+        if use_fused is None:
+            self.fused_posture = fused_mode()
+        else:
+            self.fused_posture = "1" if use_fused else "0"
+        self._fused_plans: dict[int, bool] = {}
         # score-dtype plan for this engine's single (N, L) envelope:
         # int16 when the overflow proof holds (ops/dtypes; the third
         # engine dispatcher consulting the autotuner table — the fused
@@ -672,6 +760,73 @@ class FusedPOA:
              self.runner.sharding is not None, self.score_dtype),
             time.perf_counter() - t0)
         return out
+
+    def _call_fused(self, D: int, state, seqs, lens, wts, begins, ends,
+                    bblen, offs):
+        """ONE single-launch fused align→window-slice→POA call covering
+        a chunk's whole chain depth `D`: window slicing (spanning /
+        bpos-range / band rule) runs on device from the raw layer
+        coordinates, and the layer loop is one device-resident scan —
+        no chained Python dispatch, no intermediate state fetch.
+        Bit-identical to the split chained path by construction (the
+        slicing arithmetic is integer-exact; pinned by tests)."""
+        import time
+
+        t0 = time.perf_counter()
+        lbase = np.zeros(self.B, dtype=np.int32)
+        if self.runner.sharding is not None:
+            raw = fused_raw(self.N, self.L, D, self.P, self.match,
+                            self.mismatch, self.gap,
+                            banded_only=self.banded_only,
+                            score_dtype=self.score_dtype,
+                            device_slice=True)
+            out = self.runner.run(raw, *state, seqs, lens, wts, begins,
+                                  ends, bblen, offs, lbase,
+                                  donate_argnums=tuple(range(11)))
+        else:
+            fn = fused_builder(self.N, self.L, D, self.P, self.match,
+                               self.mismatch, self.gap,
+                               banded_only=self.banded_only,
+                               score_dtype=self.score_dtype,
+                               device_slice=True)
+            out = fn(*state, seqs, lens, wts, begins, ends, bblen, offs,
+                     lbase)
+        self.sched.stats.record_compile_once(
+            "fused",
+            (self.N, self.L, D, self.P, self.match, self.mismatch,
+             self.gap, self.banded_only, self.B,
+             self.runner.sharding is not None, self.score_dtype,
+             "loop"),
+            time.perf_counter() - t0)
+        return out
+
+    def _fused_plan(self, plan) -> bool:
+        """Arbitrate FUSED single-launch vs SPLIT chained dispatch for
+        a chunk whose chain plan is `plan` (see fused_mode): forced
+        postures win; `auto` consults the persisted autotuner winner
+        table per depth bucket (engine "fused_loop", keyed by the
+        chunk's leading — largest — chain bucket at this engine's
+        envelope and scoring; a cold table dispatches split, exactly
+        the pre-fusion behavior). Chunks deeper than
+        FUSED_LOOP_MAX_DEPTH always split: one compiled program per
+        distinct total depth must stay bounded."""
+        if not plan or sum(plan) > FUSED_LOOP_MAX_DEPTH:
+            return False
+        if self.fused_posture == "0":
+            return False
+        if self.fused_posture == "1":
+            return True
+        key = plan[0]
+        cached = self._fused_plans.get(key)
+        if cached is None:
+            from ..sched.autotune import get_autotuner
+
+            ent = get_autotuner().winner(
+                "fused_loop", (self.N, self.L, key),
+                (self.match, self.mismatch, self.gap, self.P))
+            cached = self._fused_plans[key] = (
+                (ent or {}).get("kernel") == "fused")
+        return cached
 
     def _eligible(self, win) -> bool:
         bb_len = len(win[0][0])
@@ -735,12 +890,19 @@ class FusedPOA:
         compiled instead of the static one the run would then discard."""
         if windows is not None:
             self.adapt(windows)
+        fused_totals: set[int] = set()
         if max_depth is None:
             needed = set(self.depth_buckets)
+            plans = [self._chain_plan(b) for b in self.depth_buckets]
         else:
             needed = set()
-            for depth in range(1, max(1, max_depth) + 1):
-                needed.update(self._chain_plan(depth))
+            plans = [self._chain_plan(depth)
+                     for depth in range(1, max(1, max_depth) + 1)]
+        for plan in plans:
+            if self._fused_plan(plan):
+                fused_totals.add(sum(plan))
+            needed.update(plan)  # split programs stay warm: they are
+            # the fused program's declared fallback
         for d in sorted(needed):
             state = self._init_state([b"AC"], [np.ones(2, np.int32)])
             seqs = np.full((self.B, d, self.L), 5, np.int8)
@@ -750,6 +912,18 @@ class FusedPOA:
             rhi = np.full((self.B, d), 32767, np.int16)
             band = np.zeros((self.B, d), np.int32)
             out = self._call(d, state, seqs, lens, wts, rlo, rhi, band, 0)
+            np.asarray(out[0])  # block
+        for D in sorted(fused_totals):
+            state = self._init_state([b"AC"], [np.ones(2, np.int32)])
+            seqs = np.full((self.B, D, self.L), 5, np.int8)
+            lens = np.zeros((self.B, D), np.int32)
+            wts = np.zeros((self.B, D, self.L), np.int8)
+            begins = np.zeros((self.B, D), np.int32)
+            ends = np.zeros((self.B, D), np.int32)
+            bblen = np.full(self.B, 2, np.int32)
+            offs = np.zeros(self.B, np.int32)
+            out = self._call_fused(D, state, seqs, lens, wts, begins,
+                                   ends, bblen, offs)
             np.asarray(out[0])  # block
 
     def _init_state(self, backbones, bweights):
@@ -818,8 +992,10 @@ class FusedPOA:
         if self.logger is not None and fused_idx:
             self.logger.bar_total(len(fused_idx))
 
-        self.last_stats = stats = {"chunks": 0, "launches": 0, "pack_s": 0.0,
-                                   "device_s": 0.0, "unpack_s": 0.0}
+        self.last_stats = stats = {"chunks": 0, "launches": 0,
+                                   "pack_s": 0.0, "device_s": 0.0,
+                                   "unpack_s": 0.0, "fused_chunks": 0,
+                                   "fused_fallbacks": 0}
         own_pipeline = pipeline is None
         pl = pipeline if pipeline is not None else DispatchPipeline(depth=1)
 
@@ -839,17 +1015,49 @@ class FusedPOA:
                                       self.match, self.mismatch, self.gap,
                                       n_threads=fb_threads))
 
+        def chunk_plan(chunk):
+            # deterministic in the chunk (env/posture/table stable for
+            # the run), so pack and on_error always agree on which
+            # path a chunk took
+            return self._chain_plan(max(len(windows[i]) - 1
+                                        for i in chunk))
+
         def pack(chunk):
-            return self._pack_chunk(windows, chunk)
+            plan = chunk_plan(chunk)
+            if self._fused_plan(plan):
+                D = sum(plan)
+                return ("fused", D) + self._pack_chunk_fused(
+                    windows, chunk, D)
+            return ("split",) + self._pack_chunk(windows, chunk)
 
         def dispatch(chunk, packed):
-            state, calls = packed
+            from .device_program import shard_useful_split
+
             depths = [len(windows[i]) - 1 for i in chunk]
+            n_dev = self.runner.n_devices
+            if packed[0] == "fused":
+                # the FUSED single-launch program: window slicing +
+                # every chained layer step in ONE device-resident scan
+                # — one launch, one fetch per chunk
+                _, D, state, ops = packed
+                state = self._call_fused(D, state, *ops)
+                row_layers = [min(dep, D) for dep in depths]
+                self.sched.stats.record(
+                    "fused", D, jobs=len(chunk), lanes=self.B,
+                    useful_cells=sum(row_layers),
+                    total_cells=self.B * D,
+                    kernel="fused", dtype=self.score_dtype,
+                    n_devices=n_dev,
+                    shard_useful=shard_useful_split(row_layers, self.B,
+                                                    n_dev),
+                    full_mesh_cells=self.B * D)
+                pl.stats.bump("launches")
+                stats["fused_chunks"] += 1
+                return state
+            _, state, calls = packed
             # state stays on device across chained calls (a fetch here
             # would round-trip ~5 MB of graph arrays per call); only the
             # final state is materialized for the host finalizer
-            n_dev = self.runner.n_devices
-            per = self.B // n_dev
             for d, ops, done in calls:
                 state = self._call(d, state, *ops, done)
                 # occupancy in LAYER units, recorded AFTER the call
@@ -870,8 +1078,8 @@ class FusedPOA:
                     total_cells=self.B * d,
                     kernel="xla", dtype=self.score_dtype,
                     n_devices=n_dev,
-                    shard_useful=[sum(row_layers[s * per:(s + 1) * per])
-                                  for s in range(n_dev)],
+                    shard_useful=shard_useful_split(row_layers, self.B,
+                                                    n_dev),
                     full_mesh_cells=self.B * d)
             pl.stats.bump("launches", len(calls))
             return state
@@ -887,34 +1095,44 @@ class FusedPOA:
 
         def unpack(chunk, np_state):
             self._finalize_chunk(chunk, np_state, results, statuses)
-            streak["n"] = 0
+            breaker.ok()
             _tick(chunk)
 
-        #: consecutive-chunk-failure circuit breaker: one flaky chunk is
-        #: routed to the host fallback, but a device that fails every
-        #: chunk (dead tunnel, OOM) must not burn a pack+dispatch attempt
-        #: per chunk — after MAX_STREAK in a row the whole pass aborts,
-        #: restoring the old first-exception whole-batch fallback
-        streak = {"n": 0}
-        MAX_STREAK = 3
+        # consecutive-chunk-failure circuit breaker — the shared seam
+        # implementation (ops/device_program.ChunkBreaker)
+        from .device_program import ChunkBreaker
+
+        breaker = ChunkBreaker("FusedPOA", pl.stats, "the device pass")
 
         def on_error(chunk, exc):
+            # a FUSED single-launch chunk gets its DECLARED fallback
+            # first: re-run through the split chained path, which is
+            # byte-identical by construction (the host tail is not —
+            # the host engine may resolve topo-order ties differently,
+            # so falling past split would move bytes under a fault)
+            if self._fused_plan(chunk_plan(chunk)):
+                try:
+                    self._split_chunk_inline(windows, chunk, results,
+                                             statuses,
+                                             watchdog=pl.watchdog,
+                                             stats=pl.stats)
+                except Exception as split_exc:  # noqa: BLE001 — both
+                    # paths dead: count the streak on the SPLIT failure
+                    # and leave the windows to the host tail below
+                    exc = split_exc
+                else:
+                    stats["fused_fallbacks"] += 1
+                    breaker.ok()
+                    warn_dedup(
+                        "FusedPOA.fused_chunk_fell_back",
+                        "[racon_tpu::FusedPOA] warning: fused program "
+                        f"failed ({type(exc).__name__}: {exc}); chunk "
+                        "re-ran on the split chained path")
+                    _tick(chunk)
+                    return
             # the chunk's windows stay unbuilt; the fallback tail below
             # polishes every one of them on host
-            streak["n"] += 1
-            warn_dedup(
-                "FusedPOA.device_chunk_failed",
-                f"[racon_tpu::FusedPOA] warning: device chunk failed "
-                f"({type(exc).__name__}: {exc}); {len(chunk)} windows "
-                "to fallback")
-            if streak["n"] >= MAX_STREAK:
-                pl.stats.bump("breaker_trips")
-                err = DeviceError(
-                    "FusedPOA",
-                    f"{streak['n']} consecutive device chunk failures; "
-                    "aborting the device pass")
-                err.__cause__ = exc
-                raise err
+            breaker.failed(exc, f"{len(chunk)} windows to fallback")
             _tick(chunk)
 
         # mesh balance: within each FULL chunk, windows round-robin
@@ -1047,6 +1265,71 @@ class FusedPOA:
             calls.append((d, (seqs, lens, wts, rlo, rhi, band), done))
             done += d
         return state, calls
+
+    def _pack_chunk_fused(self, windows, chunk, D: int):
+        """Host packing for one FUSED single-launch chunk: the init
+        state plus ONE set of layer operands covering the whole chain
+        depth `D` — raw (begin, end) coordinates and the per-row
+        backbone length / spanning offset instead of host-derived
+        rlo/rhi/band (that slicing now runs on device, `_call_fused`).
+        Cheaper than the split packer by construction: no per-layer
+        band/spanning Python work and one operand set instead of one
+        per chained call."""
+        backbones = [windows[i][0][0] for i in chunk]
+        bweights = [_weights_of(windows[i][0][1], len(windows[i][0][0]))
+                    for i in chunk]
+        state = self._init_state(backbones, bweights)
+        seqs = np.full((self.B, D, self.L), 5, np.int8)
+        lens = np.zeros((self.B, D), np.int32)
+        wts = np.zeros((self.B, D, self.L), np.int8)
+        begins = np.zeros((self.B, D), np.int32)
+        ends = np.zeros((self.B, D), np.int32)
+        bblen = np.zeros(self.B, np.int32)
+        offs = np.zeros(self.B, np.int32)
+        for k, i in enumerate(chunk):
+            layers = sorted(windows[i][1:], key=lambda s: s[2])
+            bb_len = len(windows[i][0][0])
+            bblen[k] = bb_len
+            # float truncation kept bit-exact with the split packer
+            offs[k] = int(0.01 * bb_len)
+            for dd, (seq, qual, b, e) in enumerate(layers[:D]):
+                seqs[k, dd, :len(seq)] = self._code_of[
+                    np.frombuffer(seq, np.uint8)]
+                lens[k, dd] = len(seq)
+                wts[k, dd, :len(seq)] = _weights_of(qual, len(seq))
+                begins[k, dd] = b
+                ends[k, dd] = e
+        return state, (seqs, lens, wts, begins, ends, bblen, offs)
+
+    def _split_chunk_inline(self, windows, chunk, results, statuses,
+                            watchdog=None, stats=None) -> None:
+        """The DECLARED fallback of the fused single-launch program: a
+        chunk whose fused dispatch failed (injected fault, watchdog
+        timeout, real device error) is re-run through the SPLIT chained
+        path — byte-identical to the fused program by construction,
+        unlike the host-engine tail (which may resolve topo-order ties
+        differently). Runs synchronously on the calling (pipeline
+        error-handler) thread, with the pipeline's `watchdog` deadline
+        (single attempt, no retry) guarding every device interaction —
+        a chunk whose fused dispatch DeadlineTimed-out on a wedged
+        device must not hang forever in its own fallback. Compile
+        telemetry still flows through `_call`; occupancy is not
+        recorded for the retry (the existing discipline: a faulted
+        chunk is never accounted as clean device work)."""
+        state, calls = self._pack_chunk(windows, chunk)
+        for d, ops, done in calls:
+            dispatch = functools.partial(self._call, d, state, *ops,
+                                         done)
+            state = (watchdog.call(dispatch, stats=stats, retry=False,
+                                   deadline=True)
+                     if watchdog is not None else dispatch())
+
+        def fetch():
+            return tuple(np.asarray(x) for x in state)
+
+        np_state = (watchdog.call(fetch, stats=stats, retry=False)
+                    if watchdog is not None else fetch())
+        self._finalize_chunk(chunk, np_state, results, statuses)
 
     def _finalize_chunk(self, chunk, state, results, statuses):
         from ..native import poa_finish_arrays

@@ -22,6 +22,14 @@ the measurement durable and load-bearing:
     measured winner. A cold run without a table dispatches the XLA
     programs exactly as today.
 
+The same table arbitrates the fused engine's CHUNK DISPATCH under
+RACON_TPU_FUSED=auto (engine "fused_loop", keyed (nodes, len,
+depth-bucket)): `profile_fused_bucket` times the split chained-call
+path against the single-launch fused align→window-slice→POA program
+(ops/poa_fused.py) under the same identity veto, and
+FusedPOA._fused_plan dispatches the measured winner per bucket — a
+cold table dispatches the split path exactly as before.
+
 Profiling is explicit, never ambient: engines only READ the table, so
 the steady-state hot path costs one dict lookup per bucket and a cold
 process never stalls mid-run to benchmark. A bucket already in the
@@ -126,18 +134,22 @@ class Autotuner:
 
     # ------------------------------------------------------- profiling
     @staticmethod
-    def _time(fn, args, reps: int):
+    def _time(fn, args, reps: int, materialize: bool = True):
         """-> (mean milliseconds, last output): one warm call first
-        (absorbs the compile), then `reps` materialized calls."""
+        (absorbs the compile), then `reps` materialized calls.
+        `materialize=False` for candidates that already fetch their
+        device results internally (the fused-loop profile returns
+        plain host data that numpy cannot — and need not — coerce)."""
         import time
 
         def run():
             out = fn(*args)
-            if isinstance(out, tuple):
-                for o in out:
-                    np.asarray(o)
-            else:
-                np.asarray(out)
+            if materialize:
+                if isinstance(out, tuple):
+                    for o in out:
+                        np.asarray(o)
+                else:
+                    np.asarray(out)
             return out
 
         run()
@@ -259,6 +271,71 @@ class Autotuner:
         self.record("aligner", (edge, band), (), entry)
         return entry, True
 
+    def profile_fused_bucket(self, n_nodes: int, seq_len: int,
+                             depth: int, max_pred: int, match: int,
+                             mismatch: int, gap: int, rows: int = 4,
+                             reps: int = 2,
+                             seed: int = 13) -> tuple[dict, bool]:
+        """Time the fused engine's chunk-dispatch candidates for one
+        (nodes, len, depth-bucket) key: the SPLIT chained-call path
+        (host-side window slicing, one launch per chain bucket) vs the
+        FUSED single-launch program (device-side slicing, the whole
+        chain in one jitted scan — ops/poa_fused `device_slice`). The
+        synthetic chunk is 1.5x the bucket deep so the split path
+        genuinely chains (greedy plan [depth, ...]) while the fused
+        candidate runs once; the profiled key is the chunk's LEADING
+        chain bucket — exactly what FusedPOA._fused_plan consults under
+        RACON_TPU_FUSED=auto. The identity veto compares the finalized
+        consensus (bytes + coverages + statuses) bit-for-bit; a fast
+        but diverging candidate is disqualified and flagged."""
+        from ..ops.poa_fused import FusedPOA
+
+        params = (match, mismatch, gap, max_pred)
+        existing = self.winner("fused_loop", (n_nodes, seq_len, depth),
+                               params)
+        if existing is not None:
+            return existing, False
+
+        windows = _fused_windows(n_nodes, seq_len,
+                                 depth + max(1, depth // 2), rows, seed)
+        eng = FusedPOA(match, mismatch, gap, max_nodes=n_nodes,
+                       max_len=seq_len, max_pred=max_pred,
+                       batch_rows=rows)
+        chunk = list(range(len(windows)))
+        plan = eng._chain_plan(max(len(w) - 1 for w in windows))
+        total = sum(plan)
+
+        def finalize(np_state):
+            results: list = [None] * len(windows)
+            statuses = np.ones(len(windows), np.int32)
+            eng._finalize_chunk(chunk, np_state, results, statuses)
+            return ([(r[0], np.asarray(r[1]).tolist())
+                     if r is not None else None for r in results],
+                    statuses.tolist())
+
+        def run_split():
+            state, calls = eng._pack_chunk(windows, chunk)
+            for d, ops, done in calls:
+                state = eng._call(d, state, *ops, done)
+            return finalize(tuple(np.asarray(x) for x in state))
+
+        def run_fused():
+            state, ops = eng._pack_chunk_fused(windows, chunk, total)
+            out = eng._call_fused(total, state, *ops)
+            return finalize(tuple(np.asarray(x) for x in out))
+
+        dt = eng.score_dtype
+        ms: dict[str, float] = {}
+        outs: dict = {}
+        ms[f"split:{dt}"], outs[f"split:{dt}"] = self._time(
+            run_split, (), reps, materialize=False)
+        ms[f"fused:{dt}"], outs[f"fused:{dt}"] = self._time(
+            run_fused, (), reps, materialize=False)
+        entry = self._pick(ms, outs, f"split:{dt}")
+        self.record("fused_loop", (n_nodes, seq_len, depth), params,
+                    entry)
+        return entry, True
+
     @staticmethod
     def _pick(ms: dict, outs: dict, oracle: str) -> dict:
         """Winner selection with the identity veto: any candidate that
@@ -307,6 +384,29 @@ def _session_jobs(n_nodes: int, seq_len: int, max_pred: int, rows: int,
         seqs[k, : len(q)] = q
         lens[k] = len(q)
     return codes, preds, centers, sinks, seqs, lens, band
+
+
+def _fused_windows(n_nodes: int, seq_len: int, depth: int, rows: int,
+                   seed: int):
+    """Spanning synthetic POA windows (backbone + substitution-mutated
+    layers) for the fused-loop profile. Substitutions only — aligned
+    alternates cap the graph at <= 4 nodes per backbone column, so a
+    backbone of n_nodes // 5 can never overflow the (n_nodes) envelope
+    however deep the chunk, and no lane ever falls back mid-profile."""
+    rng = np.random.default_rng(seed)
+    bases = np.frombuffer(b"ACGT", dtype=np.uint8)
+    bb_len = max(16, min(seq_len - 8, n_nodes // 5))
+    windows = []
+    for _ in range(rows):
+        bb = bases[rng.integers(0, 4, bb_len)].tobytes()
+        win = [(bb, None, 0, 0)]
+        for _ in range(depth):
+            arr = np.frombuffer(bb, np.uint8).copy()
+            sub = rng.random(bb_len) < 0.03
+            arr[sub] = bases[rng.integers(0, 4, int(sub.sum()))]
+            win.append((arr.tobytes(), None, 0, bb_len - 1))
+        windows.append(win)
+    return windows
 
 
 def _aligner_pairs(edge: int, rows: int, seed: int):

@@ -55,6 +55,19 @@ failure inside a shared iteration fails every job with windows IN that
 iteration (their remaining pooled windows are withdrawn); jobs in other
 iterations and the feeders themselves survive.
 
+PERSISTENT DISPATCH LOOP: each lane caches ONE (DispatchPipeline,
+BatchPOA) pair per engine-parameter key, built at the first iteration
+that needs it and reused for every later one — per-iteration Python
+dispatch (engine construction, kernel-plan resolution, watchdog/
+pipeline wiring) collapses to a dict lookup, and under the fused
+engine's single-launch mode (RACON_TPU_FUSED, ops/poa_fused.py) an
+iteration's device work is one launch + one fetch per chunk. The
+measured remainder is accounted: `host_s` (iteration wall minus the
+pipeline's device-stage seconds, exact per lane via per-lane
+PipelineStats) accumulates in the counters, rides the
+`serve.iteration` trace span and the `serve.iteration_host` histogram
+— the dispatch-overhead number servebench and the scrape expose.
+
 WORKER LANES (`worker_lanes` / RACON_TPU_WORKER_LANES / `serve
 --worker-lanes`, default 1 = the single-feeder behavior): the device
 list partitions into K contiguous SUB-MESHES (parallel.mesh
@@ -205,23 +218,33 @@ def _trace_ids(tickets) -> list[str]:
 class _Lane:
     """One worker lane: a sub-mesh BatchRunner, its own exec lock (the
     feeder thread and any isolation job routed here serialize on it; two
-    LANES never share it), its own BatchScheduler/OccupancyStats (so a
-    per-iteration compile delta is exact — a shared stats object would
-    charge one lane's concurrent compile into another lane's delta
-    window) and its telemetry counters. Counter fields are guarded by
-    the batcher's `_cond`."""
+    LANES never share it), its own BatchScheduler/OccupancyStats and
+    PipelineStats (so per-iteration compile AND device-seconds deltas
+    are exact — a shared stats object would charge one lane's
+    concurrent work into another lane's delta window), its telemetry
+    counters, and the PERSISTENT dispatch-loop cache: one
+    (DispatchPipeline, BatchPOA) pair per engine-parameter key, built
+    on first use and reused for every later iteration — per-iteration
+    Python dispatch (engine construction, kernel-plan resolution,
+    pipeline/watchdog wiring) collapses to a dict lookup. Counter
+    fields are guarded by the batcher's `_cond`; `engines` is touched
+    only under this lane's exec lock."""
 
-    __slots__ = ("index", "runner", "scheduler", "lock", "busy",
-                 "iterations", "busy_s")
+    __slots__ = ("index", "runner", "scheduler", "pipeline_stats",
+                 "lock", "busy", "iterations", "busy_s", "engines")
 
-    def __init__(self, index: int, runner, scheduler):
+    def __init__(self, index: int, runner, scheduler, pipeline_stats):
         self.index = index
         self.runner = runner
         self.scheduler = scheduler
+        self.pipeline_stats = pipeline_stats
         self.lock = threading.Lock()
         self.busy = False
         self.iterations = 0
         self.busy_s = 0.0
+        #: engine key -> (DispatchPipeline, BatchPOA), the persistent
+        #: dispatch loop (see class docstring)
+        self.engines: dict = {}
 
 
 def _engine_key(p) -> tuple:
@@ -297,7 +320,12 @@ class WindowBatcher:
                          "shared_iterations": 0, "jobs": 0, "windows": 0,
                          "max_jobs_in_iteration": 0,
                          "max_windows_in_iteration": 0,
-                         "max_concurrent_iterations": 0}
+                         "max_concurrent_iterations": 0,
+                         #: cumulative measured per-iteration host
+                         #: overhead (iteration wall − device-stage
+                         #: seconds); solo/isolation iterations run on
+                         #: the job's own pipeline and are not included
+                         "host_s": 0.0}
 
     # ------------------------------------------------------------ entry
     def consensus(self, polisher, on_windows=None) -> None:
@@ -404,11 +432,13 @@ class WindowBatcher:
         K clamps to the device count."""
         if self._lanes is None:
             from ..parallel.mesh import BatchRunner, partition_devices
+            from ..pipeline import PipelineStats
             from ..sched import BatchScheduler, OccupancyStats
 
             base = BatchRunner(devices=self._devices)
             if self.worker_lanes == 1 or base.n_devices == 1:
-                self._lanes = [_Lane(0, base, self.scheduler)]
+                self._lanes = [_Lane(0, base, self.scheduler,
+                                     self.pipeline_stats)]
             else:
                 lanes = []
                 for i, group in enumerate(partition_devices(
@@ -417,8 +447,9 @@ class WindowBatcher:
                         adaptive=self.scheduler.adaptive,
                         stats=OccupancyStats())
                     sched.stats.hists = self.scheduler.stats.hists
-                    lanes.append(_Lane(i, BatchRunner(devices=group),
-                                       sched))
+                    lanes.append(_Lane(
+                        i, BatchRunner(devices=group), sched,
+                        PipelineStats(hists=self.pipeline_stats.hists)))
                 self._lanes = lanes
         return self._lanes
 
@@ -472,6 +503,25 @@ class WindowBatcher:
             if feeder is not None and feeder.is_alive() \
                     and feeder is not threading.current_thread():
                 feeder.join(timeout)
+        # the persistent dispatch loops' fallback executors (one per
+        # cached lane pipeline) shut down with the batcher. The lane
+        # lock is taken per lane so a straggler iteration (a feeder
+        # whose join timed out above) can neither mutate `engines`
+        # mid-iteration nor have its live pipeline closed under it; a
+        # lane that stays wedged past the timeout keeps its pipelines
+        # (daemon-abandoned, like its feeder) rather than breaking the
+        # iteration still using them.
+        with self._cond:
+            lanes = list(self._lanes or ())
+        for lane in lanes:
+            if not lane.lock.acquire(timeout=timeout):
+                continue
+            try:
+                pipelines = [p for p, _ in lane.engines.values()]
+            finally:
+                lane.lock.release()
+            for pipeline in pipelines:
+                pipeline.close()
 
     def _feeder_loop(self, lane: _Lane) -> None:
         while True:
@@ -571,6 +621,22 @@ class WindowBatcher:
             merged.merge_from(p)
         return merged
 
+    def _merged_pipeline(self) -> dict:
+        """One PipelineStats snapshot across every distinct per-lane
+        instance (the single-lane default shares the batcher's own, the
+        multi-lane partition keeps one per lane so per-iteration deltas
+        stay exact under concurrency)."""
+        with self._cond:
+            lanes = list(self._lanes or ())
+        snaps = [self.pipeline_stats.snapshot()] + [
+            lane.pipeline_stats.snapshot() for lane in lanes
+            if lane.pipeline_stats is not self.pipeline_stats]
+        out = snaps[0]
+        for snap in snaps[1:]:
+            for k, v in snap.items():
+                out[k] = out.get(k, 0) + v
+        return out
+
     def _compile_totals(self, stats=None) -> tuple[int, float]:
         """(compiles, compile_s) of `stats` — one lane's instance for
         per-iteration deltas (exact under lane concurrency), or the
@@ -580,11 +646,42 @@ class WindowBatcher:
         return (sum(e.get("compiles", 0) for e in snap.values()),
                 sum(e.get("compile_s", 0.0) for e in snap.values()))
 
-    def _run_iteration(self, batch: list, lane: _Lane) -> None:
+    def _lane_engine(self, lane: _Lane, key: tuple, p0):
+        """The lane's PERSISTENT (pipeline, engine) pair for one engine
+        key — built on the first iteration that needs it, reused for
+        every later one (the persistent dispatch loop: engine
+        construction, kernel-plan resolution and pipeline/watchdog
+        wiring leave the per-iteration hot path; the engines' own
+        device-engine caches then keep jit lookups warm too). Caller
+        holds the lane's exec lock. Every knob that feeds construction
+        is part of `key` (_engine_key), so two jobs sharing an
+        iteration always resolve the same pair."""
         from ..ops.poa import BatchPOA
         from ..pipeline import DispatchPipeline
         from ..resilience import Watchdog
 
+        ent = lane.engines.get(key)
+        if ent is None:
+            pipeline = DispatchPipeline(
+                depth=p0.tpu_pipeline_depth,
+                stats=lane.pipeline_stats,
+                fallback_workers=max(1, min(4, p0.num_threads)),
+                watchdog=Watchdog.from_env(
+                    timeout=p0.tpu_device_timeout or None))
+            engine = BatchPOA(p0.match, p0.mismatch, p0.gap,
+                              p0.window_length,
+                              num_threads=p0.num_threads,
+                              device_batches=p0.tpu_poa_batches,
+                              banded=p0.tpu_banded_alignment,
+                              band_width=p0.tpu_aligner_band_width,
+                              engine=p0.tpu_engine,
+                              pipeline=pipeline,
+                              scheduler=lane.scheduler,
+                              runner=lane.runner)
+            ent = lane.engines[key] = (pipeline, engine)
+        return ent
+
+    def _run_iteration(self, batch: list, lane: _Lane) -> None:
         windows = [e[3] for e in batch]
         per_ticket: dict = {}
         for e in batch:
@@ -597,42 +694,38 @@ class WindowBatcher:
         with lane.lock:
             self._lane_busy(lane, True)
             pre_c, pre_s = self._compile_totals(lane.scheduler.stats)
-            pipeline = DispatchPipeline(
-                depth=p0.tpu_pipeline_depth,
-                stats=self.pipeline_stats,
-                fallback_workers=max(1, min(4, p0.num_threads)),
-                watchdog=Watchdog.from_env(
-                    timeout=p0.tpu_device_timeout or None))
-            engine = BatchPOA(p0.match, p0.mismatch, p0.gap,
-                              p0.window_length,
-                              num_threads=p0.num_threads,
-                              device_batches=p0.tpu_poa_batches,
-                              banded=p0.tpu_banded_alignment,
-                              band_width=p0.tpu_aligner_band_width,
-                              logger=(progress if progress.active
-                                      else None),
-                              engine=p0.tpu_engine,
-                              pipeline=pipeline,
-                              scheduler=lane.scheduler,
-                              runner=lane.runner)
+            pre_dev = lane.pipeline_stats.snapshot()["device_s"]
+            _, engine = self._lane_engine(lane, tickets[0].key, p0)
+            # only the logger varies per iteration; everything else in
+            # the engine's identity is pinned by the key
+            engine.logger = progress if progress.active else None
             t0 = time.perf_counter()
             try:
-                with pipeline:
-                    engine.generate_consensus(windows, p0.trim)
+                engine.generate_consensus(windows, p0.trim)
             finally:
                 t1 = time.perf_counter()
                 self._lane_busy(lane, False, t1 - t0)
             post_c, post_s = self._compile_totals(lane.scheduler.stats)
+            post_dev = lane.pipeline_stats.snapshot()["device_s"]
+        # measured per-iteration host overhead: the wall the lane held
+        # its lock minus the device-stage seconds the iteration's
+        # pipeline charged (dispatch + result wait) — the number the
+        # fused dispatch loop exists to shrink. Exact per lane: the
+        # lane's own PipelineStats sees no concurrent writer.
+        host_s = max(0.0, (t1 - t0) - (post_dev - pre_dev))
         tr = trace.get_tracer()
         if tr is not None:
             tr.complete("serve.iteration", t0, t1,
                         {"iteration": it, "lane": lane.index,
                          "jobs": len(tickets),
                          "windows": len(windows),
+                         "host_s": round(host_s, 4),
                          "trace_ids": _trace_ids(tickets)})
         if self.hists is not None:
             self.hists.observe("serve.iteration", t1 - t0)
-        self._account(len(tickets), len(windows), solo=False)
+            self.hists.observe("serve.iteration_host", host_s)
+        self._account(len(tickets), len(windows), solo=False,
+                      host_s=host_s)
         shared = len(tickets) > 1
         for ticket, ws in per_ticket.items():
             ticket.iterations += 1
@@ -668,11 +761,13 @@ class WindowBatcher:
         for t in tickets:
             t.finish()
 
-    def _account(self, jobs: int, windows: int, solo: bool) -> None:
+    def _account(self, jobs: int, windows: int, solo: bool,
+                 host_s: float = 0.0) -> None:
         with self._cond:
             self.counters["iterations"] += 1
             self.counters["jobs"] += jobs
             self.counters["windows"] += windows
+            self.counters["host_s"] += host_s
             if solo:
                 self.counters["solo_iterations"] += 1
             if jobs > 1:
@@ -698,6 +793,7 @@ class WindowBatcher:
     def snapshot(self) -> dict:
         with self._cond:
             out = dict(self.counters)
+            out["host_s"] = round(out["host_s"], 4)
             out["worker_lanes"] = (len(self._lanes)
                                    if self._lanes is not None
                                    else self.worker_lanes)
@@ -711,5 +807,5 @@ class WindowBatcher:
         out["compiles"] = compiles
         out["compile_s"] = round(compile_s, 3)
         out["occupancy"] = stats.snapshot()
-        out["pipeline"] = self.pipeline_stats.snapshot()
+        out["pipeline"] = self._merged_pipeline()
         return out

@@ -1215,6 +1215,10 @@ class PolishServer:
         counters["serve.batch.shared_iterations"] = \
             b["shared_iterations"]
         counters["serve.batch.windows"] = b["windows"]
+        # measured per-iteration host overhead (iteration wall minus
+        # device-stage seconds), cumulative — the dispatch-loop number
+        counters["serve.batch.host_seconds"] = round(
+            b.get("host_s", 0.0), 4)
         counters["serve.compiles"] = b["compiles"]
         for lane in b.get("lanes") or ():
             counters[f"serve.lane.{lane['lane']}.iterations"] = \

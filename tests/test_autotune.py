@@ -123,6 +123,34 @@ def test_profile_buckets_then_warm_second_process_profiles_nothing(
     assert not fresh and e3 == a_entry
 
 
+def test_profile_fused_bucket_warm_second_process_profiles_nothing(
+        monkeypatch):
+    """The fused-loop plane joins the autotuner contract: a cold
+    profile times split-vs-fused on the live backend under the identity
+    veto; a second process returns the persisted entry without running
+    a candidate."""
+    monkeypatch.setenv("RACON_TPU_MAX_DEVICES", "1")
+    at = get_autotuner()
+    entry, fresh = at.profile_fused_bucket(192, 96, 8, 4, 3, -5, -4,
+                                           rows=2, reps=1)
+    assert fresh
+    assert entry["kernel"] in ("split", "fused")
+    dt = entry["dtype"]
+    assert set(entry["ms"]) == {f"split:{dt}", f"fused:{dt}"}
+    assert entry["identical"] is True
+    at.save()
+
+    _, fresh = at.profile_fused_bucket(192, 96, 8, 4, 3, -5, -4)
+    assert not fresh
+
+    reset_autotuner_cache()
+    monkeypatch.setattr(Autotuner, "_time", staticmethod(
+        lambda *a, **k: pytest.fail("warm profile ran a candidate")))
+    warm = get_autotuner()
+    e2, fresh = warm.profile_fused_bucket(192, 96, 8, 4, 3, -5, -4)
+    assert not fresh and e2 == entry
+
+
 def test_pick_vetoes_non_identical_candidates():
     ms = {"xla:int32": 2.0, "pallas:int16": 0.1}
     outs = {"xla:int32": np.arange(4), "pallas:int16": np.arange(4) + 1}
@@ -198,7 +226,7 @@ def test_tpu_smoke_profile_step_writes_keys_engines_consult(monkeypatch):
     from racon_tpu.ops.align import BatchAligner
     from racon_tpu.ops.poa_graph import BUCKETS, MAX_PRED
 
-    calls = {"session": [], "aligner": []}
+    calls = {"session": [], "aligner": [], "fused_loop": []}
 
     class Rec:
         table = {}
@@ -211,6 +239,11 @@ def test_tpu_smoke_profile_step_writes_keys_engines_consult(monkeypatch):
         def profile_aligner_bucket(self, edge, band, **kw):
             calls["aligner"].append((edge, band))
             return {"kernel": "xla", "dtype": "int32", "ms": {},
+                    "identical": True}, True
+
+        def profile_fused_bucket(self, nb, lb, d, mp, m, x, g, **kw):
+            calls["fused_loop"].append((nb, lb, d, mp, m, x, g))
+            return {"kernel": "split", "dtype": "int32", "ms": {},
                     "identical": True}, True
 
         def save(self):
@@ -233,6 +266,19 @@ def test_tpu_smoke_profile_step_writes_keys_engines_consult(monkeypatch):
             pairs = [(b"A" * length, b"A" * length)]
             assert (edge, ba._band_for(pairs, [0])) in profiled, \
                 f"auto band for len {length} not profiled at edge {edge}"
+    # fused-loop: whatever consult key FusedPOA._fused_plan derives for
+    # ANY chunk depth (N, L, leading chain bucket at the default
+    # scoring/MAX_PRED) must have been profiled — the weld that lets
+    # RACON_TPU_FUSED=auto go warm at production dispatch keys
+    from racon_tpu.ops.poa_fused import FUSED_LOOP_MAX_DEPTH, FusedPOA
+
+    eng = FusedPOA(3, -5, -4)
+    fused_profiled = set(calls["fused_loop"])
+    for depth in range(1, FUSED_LOOP_MAX_DEPTH + 1):
+        plan = eng._chain_plan(depth)
+        assert (eng.N, eng.L, plan[0], eng.P, 3, -5, -4) \
+            in fused_profiled, \
+            f"fused consult key for chunk depth {depth} not profiled"
 
 
 # --------------------------------------- the byte-identity acceptance pin

@@ -267,6 +267,221 @@ def test_fused_backbone_only_windows():
     assert res[1][0] == packed[1][0][0]
 
 
+def test_fused_loop_single_launch_matches_split():
+    """The FUSED single-launch program (device-side window slicing, a
+    chunk's whole chain in one jitted scan) is bit-identical to the
+    split chained path — spanning and non-spanning windows, chained
+    depth — while genuinely collapsing launches."""
+    rng = random.Random(5)
+    windows, _ = _make_windows(rng, 8, length=220, depth=11, rate=0.12)
+    more, _ = _make_windows(random.Random(12), 4, length=110, depth=5,
+                            spanning=False, rate=0.1)
+    packed = [_pack(w) for w in windows] + [_pack(w) for w in more]
+    kw = dict(max_nodes=768, max_len=384, batch_rows=8,
+              depth_buckets=(4, 8))
+
+    split = FusedPOA(3, -5, -4, num_threads=2, use_fused=False, **kw)
+    rs, ss = split.consensus([list(p) for p in packed])
+    fused = FusedPOA(3, -5, -4, num_threads=2, use_fused=True, **kw)
+    rf, sf = fused.consensus([list(p) for p in packed])
+
+    np.testing.assert_array_equal(ss, sf)
+    assert (sf == 0).all(), sf.tolist()
+    _assert_identical(rf, rs, sf, "fused-vs-split")
+    host = poa_batch(packed, 3, -5, -4, n_threads=2)
+    _assert_identical(rf, host, sf, "fused-vs-host")
+    # the fusion receipt: one launch per chunk instead of one per
+    # chained chain bucket
+    assert fused.last_stats["fused_chunks"] >= 1
+    assert fused.last_stats["fused_fallbacks"] == 0
+    assert fused.last_stats["launches"] < split.last_stats["launches"]
+
+
+def test_fused_loop_fault_falls_back_to_split_byte_identically():
+    """A fault injected at ANY stage of a fused single-launch chunk
+    must fall back to the SPLIT chained path — the declared fallback —
+    with byte-identical output (the host tail may resolve topo ties
+    differently, so falling past split would move bytes under a
+    fault)."""
+    from racon_tpu.pipeline import DispatchPipeline
+    from racon_tpu.resilience import FaultPlan
+
+    rng = random.Random(5)
+    windows, _ = _make_windows(rng, 6, length=220, depth=11, rate=0.12)
+    packed = [_pack(w) for w in windows]
+    kw = dict(max_nodes=768, max_len=384, batch_rows=8,
+              depth_buckets=(4, 8))
+    ref = FusedPOA(3, -5, -4, use_fused=True, **kw)
+    rr, sr = ref.consensus([list(p) for p in packed])
+
+    for stage in ("pack", "device", "unpack"):
+        eng = FusedPOA(3, -5, -4, use_fused=True, **kw)
+        pl = DispatchPipeline(
+            depth=0, faults=FaultPlan.parse(f"{stage}:chunk=0:raise"))
+        rf, sf = eng.consensus([list(p) for p in packed], pipeline=pl)
+        assert eng.last_stats["fused_fallbacks"] == 1, \
+            (stage, eng.last_stats)
+        assert pl.stats.snapshot()["faults"] >= 1
+        np.testing.assert_array_equal(sr, sf, err_msg=stage)
+        _assert_identical(rf, rr, sf, f"fault-{stage}")
+
+
+def test_fused_loop_auto_follows_winner_table(tmp_path, monkeypatch):
+    """RACON_TPU_FUSED=auto consults the persisted autotuner winner
+    table per depth bucket (engine "fused_loop"): a cold table
+    dispatches the split path exactly as before; a measured fused
+    winner flips the SAME construction to the single-launch program."""
+    from racon_tpu.sched.autotune import (get_autotuner,
+                                          reset_autotuner_cache)
+
+    monkeypatch.setenv("RACON_TPU_AUTOTUNE_CACHE",
+                       str(tmp_path / "autotune.json"))
+    monkeypatch.setenv("RACON_TPU_FUSED", "auto")
+    reset_autotuner_cache()
+    rng = random.Random(9)
+    windows, _ = _make_windows(rng, 4, length=220, depth=11, rate=0.1)
+    packed = [_pack(w) for w in windows]
+    kw = dict(max_nodes=768, max_len=384, batch_rows=4,
+              depth_buckets=(4,))
+
+    cold = FusedPOA(3, -5, -4, **kw)
+    rs, _ = cold.consensus([list(p) for p in packed])
+    assert cold.last_stats["fused_chunks"] == 0  # cold table: split
+
+    at = get_autotuner()
+    # depth 11 with buckets (4,): plan [4, 4, 4] -> consult key d=4
+    at.record("fused_loop", (768, 384, 4), (3, -5, -4, cold.P),
+              {"kernel": "fused", "dtype": "int32", "ms": {},
+               "identical": True})
+    at.save()
+    reset_autotuner_cache()
+    warm = FusedPOA(3, -5, -4, **kw)
+    rf, sf = warm.consensus([list(p) for p in packed])
+    assert warm.last_stats["fused_chunks"] >= 1
+    _assert_identical(rf, rs, sf, "auto-vs-cold")
+    reset_autotuner_cache()
+
+
+def test_fused_state_buffers_never_reused_after_donation(monkeypatch):
+    """The donation contract (fused_builder donates the 11 state
+    buffers on accelerators, nothing on the CPU test backend — which
+    silently ignores donation and would mask a reuse bug): across
+    chained split calls AND the fused single-launch path, no state
+    tuple is ever handed to a device call twice — a donated-then-reused
+    buffer would read back garbage on chip. Plus the config pin on both
+    backend branches."""
+    import jax
+
+    import racon_tpu.ops.poa_fused as pf
+
+    # ---- config pin: what the builder asks jit to donate, per backend
+    captured = {}
+    real_jit = jax.jit
+
+    def spy_jit(fn, **kw):
+        captured["donate"] = kw.get("donate_argnums", ())
+        return real_jit(fn, **kw)
+
+    monkeypatch.setattr(jax, "jit", spy_jit)
+    # unique shapes so the lru caches cannot serve a pre-spy build
+    pf.fused_builder(48, 24, 2, 2, 1, -1, -1)
+    assert captured["donate"] == ()  # cpu cannot donate (would warn)
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    pf.fused_builder(48, 24, 3, 2, 1, -1, -1, device_slice=True)
+    assert captured["donate"] == tuple(range(11))
+    monkeypatch.undo()
+
+    # ---- behavioral pin: every state tuple enters a device call once
+    donated: list = []  # holds refs so object identity stays unique
+
+    def mark(state):
+        for a in state:
+            assert not any(a is o for o in donated), \
+                "donated state buffer passed to a device call twice"
+        donated.extend(state)
+
+    orig_call = pf.FusedPOA._call
+    orig_fused = pf.FusedPOA._call_fused
+
+    def spy_call(self, d, state, *rest):
+        mark(state)
+        return orig_call(self, d, state, *rest)
+
+    def spy_fused(self, D, state, *rest):
+        mark(state)
+        return orig_fused(self, D, state, *rest)
+
+    monkeypatch.setattr(pf.FusedPOA, "_call", spy_call)
+    monkeypatch.setattr(pf.FusedPOA, "_call_fused", spy_fused)
+
+    rng = random.Random(9)
+    windows, _ = _make_windows(rng, 4, length=220, depth=11, rate=0.1)
+    packed = [_pack(w) for w in windows]
+    host = poa_batch(packed, 3, -5, -4)
+    kw = dict(max_nodes=768, max_len=384, batch_rows=4,
+              depth_buckets=(4,))  # 11 layers -> 3 chained calls
+    for use_fused in (False, True):
+        eng = FusedPOA(3, -5, -4, use_fused=use_fused, **kw)
+        res, st = eng.consensus([list(p) for p in packed])
+        assert (st == 0).all()
+        _assert_identical(res, host, st, f"donation fused={use_fused}")
+    assert len(donated) >= 11 * 2  # both paths actually ran
+
+
+def test_polisher_fasta_identical_across_fused_dispatch_modes(
+        tmp_path, monkeypatch):
+    """THE fused-dispatch acceptance pin: polished FASTA byte-identical
+    across RACON_TPU_FUSED={0,1,auto} x pipeline depth {0,2} x engine
+    {session,fused} x mesh {1,8}. The fused single-launch program may
+    move every perf number; it may not move one output byte. `auto` is
+    covered both cold (no table -> dispatches split) and with a forced
+    all-fused winner table (the most aggressive posture it can take)."""
+    from test_pipeline import _synth_dataset
+
+    from racon_tpu.core.polisher import PolisherType, create_polisher
+    from racon_tpu.sched import autotune
+    from racon_tpu.sched.autotune import (get_autotuner,
+                                          reset_autotuner_cache)
+
+    monkeypatch.setenv("RACON_TPU_AUTOTUNE_CACHE",
+                       str(tmp_path / "autotune.json"))
+    reset_autotuner_cache()
+    paths = [str(x) for x in _synth_dataset(tmp_path, random.Random(23))]
+
+    class _FusedTable:
+        def winner(self, engine, bucket, params=()):
+            if engine == "fused_loop":
+                return {"kernel": "fused", "dtype": "int32", "ms": {},
+                        "identical": True}
+            return None
+
+    def run(engine, fused, depth, mesh, forced_table=False):
+        monkeypatch.setenv("RACON_TPU_MAX_DEVICES", str(mesh))
+        monkeypatch.setenv("RACON_TPU_FUSED", fused)
+        monkeypatch.setattr(
+            autotune, "get_autotuner",
+            (lambda: _FusedTable()) if forced_table else get_autotuner)
+        p = create_polisher(*paths, PolisherType.kC, 500, -1.0, 0.3,
+                            num_threads=2, tpu_poa_batches=1,
+                            tpu_engine=engine, tpu_pipeline_depth=depth)
+        p.initialize()
+        return [(s.name, s.data) for s in p.polish()]
+
+    ref = run("fused", "0", 0, 1)
+    assert ref and all(d for _, d in ref)
+    for fused, depth, mesh, forced in (("1", 0, 1, False),
+                                       ("1", 2, 1, False),
+                                       ("auto", 2, 1, True),
+                                       ("auto", 0, 1, False),
+                                       ("1", 2, 8, False)):
+        assert run("fused", fused, depth, mesh, forced) == ref, \
+            f"FASTA diverged at fused={fused} depth={depth} mesh={mesh}"
+    # the session engine ignores the knob entirely
+    s_ref = run("session", "0", 2, 1)
+    assert run("session", "1", 2, 1) == s_ref
+    reset_autotuner_cache()
+
+
 def test_fused_through_batchpoa_env(monkeypatch):
     """RACON_TPU_ENGINE=fused routes BatchPOA's device path through the
     fused engine end-to-end."""

@@ -264,6 +264,41 @@ def test_windows_per_s_min_mandatory_names_key(tmp_path, capsys):
     assert "synth.windows_per_s" in capsys.readouterr().err
 
 
+def test_fused_host_frac_gated(tmp_path, capsys):
+    """Artifacts carrying a `fused` block gate the measured host-
+    overhead fraction: default limit whenever the key is present,
+    --host-frac-max overriding it; the windows/s floor gates alongside
+    (both checks print, either can fail the run)."""
+    art = dict(synth_artifact(6.0),
+               fused={"mode": "1", "engine": "fused", "launches": 3,
+                      "chunks": 3, "device_s": 4.0, "host_s": 1.0,
+                      "host_frac": 0.2})
+    path = write(tmp_path / "SYNTH.json", art)
+    assert perfgate.main(["--artifact", path,
+                          "--windows-per-s-min", "5.0"]) == 0
+    err = capsys.readouterr().err
+    assert "fused.host_frac" in err
+    # explicit limit below the measured fraction: regression
+    assert perfgate.main(["--artifact", path,
+                          "--windows-per-s-min", "5.0",
+                          "--host-frac-max", "0.1"]) == 1
+    # default gate catches a dispatch loop that went host-bound
+    art["fused"]["host_frac"] = 0.9
+    path = write(tmp_path / "SYNTH.json", art)
+    assert perfgate.main(["--artifact", path,
+                          "--windows-per-s-min", "5.0"]) == 1
+
+
+def test_host_frac_max_mandatory_names_key(tmp_path, capsys):
+    """--host-frac-max over an artifact without a fused block is a
+    BROKEN GATE naming the dotted key (the slo.miss_rate convention)."""
+    art = write(tmp_path / "SYNTH.json", synth_artifact(6.0))
+    assert perfgate.main(["--artifact", art,
+                          "--windows-per-s-min", "5.0",
+                          "--host-frac-max", "0.5"]) == 2
+    assert "fused.host_frac" in capsys.readouterr().err
+
+
 def test_synth_broken_against_stays_broken(tmp_path, capsys):
     """An explicitly requested --against that cannot resolve must stay
     rc 2 even when the absolute floor is also requested — the relative

@@ -475,6 +475,41 @@ def test_batcher_mixed_params_do_not_merge(dataset):
     batcher.close()
 
 
+def test_batcher_persistent_engine_cache_and_host_overhead(dataset):
+    """The persistent dispatch loop: two same-key jobs reuse ONE cached
+    (pipeline, engine) pair on the lane (engine construction leaves the
+    per-iteration hot path), the measured per-iteration host overhead
+    accumulates in the counters, and output stays byte-identical to a
+    solo run."""
+    batcher = WindowBatcher()
+
+    def run_job():
+        p = create_polisher(*dataset, PolisherType.kC, 500, 10.0, 0.3,
+                            num_threads=2)
+        p.initialize()
+        batcher.consensus(p)
+        return b"".join(b">" + s.name.encode() + b"\n" + s.data + b"\n"
+                        for s in p._stitch(True))
+
+    out1 = run_job()
+    out2 = run_job()
+    assert out1 == out2 == polish_solo(dataset)
+    lanes = batcher._lanes
+    assert lanes is not None
+    # one engine key -> ONE cached pair across both iterations
+    assert sum(len(lane.engines) for lane in lanes) == 1
+    snap = batcher.snapshot()
+    assert snap["iterations"] == 2
+    assert snap["host_s"] >= 0.0
+    # the merged pipeline view carries the iterations' stage seconds
+    assert snap["pipeline"]["chunks"] >= 1
+    batcher.close()
+    # close() shut the cached pipelines' fallback executors down
+    for lane in lanes:
+        for pipeline, _ in lane.engines.values():
+            assert pipeline._executor is None
+
+
 def test_deprecated_round_knobs_warn_and_alias():
     """gather_window_s aliases to max_wait_s, min_gather is refused
     loudly — neither is a silent ignore."""

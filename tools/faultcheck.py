@@ -18,6 +18,12 @@ to the per-window pass. Each cell passes when the injected run
     the injected stall, must set the pace),
   - leaving no orphaned racon-tpu worker thread behind.
 
+A depth2+fused column runs device consensus through the FUSED
+single-launch align→window-slice→POA program (RACON_TPU_FUSED=1, fused
+engine): faults injected inside the fused dispatch must fall back to
+the SPLIT chained path byte-identically — the program's declared
+fallback, gated against a split-vs-fused clean-identity check up front.
+
 A 5th SERVE column runs each row's fault as a per-job fault plan against
 a live PolishServer (racon_tpu/serve/): the poisoned job must fail with
 a TYPED error response (DeviceError / DeviceTimeout / ChunkCorrupt — the
@@ -115,11 +121,13 @@ def make_dataset(dirname: str, rng: random.Random):
 
 
 def polish(paths, depth: int, aligner: int, timeout: float,
-           adaptive: bool = False):
+           adaptive: bool = False, poa: int = 0,
+           engine: str | None = None):
     from racon_tpu.core.polisher import PolisherType, create_polisher
 
     p = create_polisher(*paths, PolisherType.kC, 500, -1.0, 0.3,
                         num_threads=2, tpu_aligner_batches=aligner,
+                        tpu_poa_batches=poa, tpu_engine=engine,
                         tpu_pipeline_depth=depth,
                         tpu_device_timeout=timeout,
                         tpu_adaptive_buckets=adaptive)
@@ -173,7 +181,7 @@ def validate_trace(trace_path, stats):
 
 
 def run_cell(paths, clean, depth, aligner, spec, timeout,
-             adaptive=False, trace=False, pallas=False):
+             adaptive=False, trace=False, pallas=False, fused=False):
     trace_path = None
     if trace:
         fd, trace_path = tempfile.mkstemp(suffix=".json",
@@ -181,7 +189,7 @@ def run_cell(paths, clean, depth, aligner, spec, timeout,
         os.close(fd)
     try:
         return _run_cell(paths, clean, depth, aligner, spec, timeout,
-                         adaptive, trace_path, pallas)
+                         adaptive, trace_path, pallas, fused)
     finally:
         if trace_path is not None:
             try:
@@ -191,7 +199,7 @@ def run_cell(paths, clean, depth, aligner, spec, timeout,
 
 
 def _run_cell(paths, clean, depth, aligner, spec, timeout,
-              adaptive, trace_path, pallas=False):
+              adaptive, trace_path, pallas=False, fused=False):
     from racon_tpu.obs import trace as obs_trace
     from racon_tpu.resilience.faults import reset_fault_plan
 
@@ -205,18 +213,27 @@ def _run_cell(paths, clean, depth, aligner, spec, timeout,
         # XLA chunks — the fault hooks live at the pipeline layer, so a
         # Pallas-dispatched chunk routes through the identical ladder
         os.environ["RACON_TPU_PALLAS"] = "1"
+    if fused:
+        # the fused single-launch program (device consensus armed with
+        # the fused engine): a fault inside the fused dispatch must
+        # fall back to the SPLIT chained path byte-identically — the
+        # declared fallback — before anything reaches the host tail
+        os.environ["RACON_TPU_FUSED"] = "1"
     reset_fault_plan()
     if trace:
         obs_trace.configure(trace_path)
     t0 = time.perf_counter()
     try:
-        out, stats = polish(paths, depth, aligner, timeout, adaptive)
+        out, stats = polish(paths, depth, aligner, timeout, adaptive,
+                            poa=1 if fused else 0,
+                            engine="fused" if fused else None)
     except Exception as exc:
         return f"FAIL crashed ({type(exc).__name__}: {exc})"
     finally:
         wall = time.perf_counter() - t0
         os.environ.pop("RACON_TPU_FAULT_PLAN", None)
         os.environ.pop("RACON_TPU_PALLAS", None)
+        os.environ.pop("RACON_TPU_FUSED", None)
         reset_fault_plan()
         if trace:
             try:
@@ -236,7 +253,8 @@ def _run_cell(paths, clean, depth, aligner, spec, timeout,
         if bad is not None:
             return bad
         traced = " traced"
-    if out == clean[depth, aligner]:
+    expect = clean["fused", aligner] if fused else clean[depth, aligner]
+    if out == expect:
         how = "identical"
     elif stats["quarantined"] > 0:
         how = f"quarantined {stats['quarantined']}"
@@ -381,19 +399,47 @@ def main() -> int:
                     return 1
         finally:
             os.environ.pop("RACON_TPU_PALLAS", None)
+        # fused-column clean gate: the fused single-launch program
+        # (device consensus, fused engine, RACON_TPU_FUSED=1) must be
+        # byte-identical to the SPLIT chained path on a clean run —
+        # the identity that makes split the fused program's declared
+        # fault fallback; every fused cell compares against this
+        for aligner in (0, 1):
+            try:
+                os.environ["RACON_TPU_FUSED"] = "0"
+                split_clean = polish(paths, 2, aligner, 0.0, poa=1,
+                                     engine="fused")[0]
+                os.environ["RACON_TPU_FUSED"] = "1"
+                fused_clean = polish(paths, 2, aligner, 0.0, poa=1,
+                                     engine="fused")[0]
+            finally:
+                os.environ.pop("RACON_TPU_FUSED", None)
+            if fused_clean != split_clean:
+                print("[faultcheck] FAIL: fused single-launch clean "
+                      "run diverged from the split path",
+                      file=sys.stderr)
+                return 1
+            clean["fused", aligner] = fused_clean
         width = max(len(m[0]) for m in rows)
         print(f"{'injection point':<{width}}  depth0"
               f"{'':<30}depth2{'':<30}depth2+sched"
               f"{'':<24}depth2+trace{'':<24}depth2+pallas"
-              f"{'':<23}serve{'':<31}serve-lanes2", file=sys.stderr)
+              f"{'':<23}depth2+fused{'':<24}serve{'':<31}serve-lanes2",
+              file=sys.stderr)
         # the 4th column runs with span tracing armed: the injected run
         # must additionally produce a valid Chrome trace whose
         # fault/quarantine instant events match the degradation
         # counters; the 5th runs the Pallas kernel plane (aligner rows
-        # dispatch the resident wavefront kernel in interpret mode)
-        columns = ((0, False, False, False), (2, False, False, False),
-                   (2, True, False, False), (2, False, True, False),
-                   (2, False, False, True))
+        # dispatch the resident wavefront kernel in interpret mode);
+        # the 6th runs device consensus through the FUSED single-launch
+        # program — injected faults must fall back to the split chained
+        # path byte-identically
+        columns = ((0, False, False, False, False),
+                   (2, False, False, False, False),
+                   (2, True, False, False, False),
+                   (2, False, True, False, False),
+                   (2, False, False, True, False),
+                   (2, False, False, False, True))
         # the final (serve) column submits the fault as a per-job plan
         # against ONE live warm server shared by every row — surviving
         # the whole poisoned sequence is itself part of the gate
@@ -417,10 +463,10 @@ def main() -> int:
         try:
             for name, aligner, spec, timeout, _slow in rows:
                 cells = []
-                for depth, adaptive, traced, pallas in columns:
+                for depth, adaptive, traced, pallas, fused in columns:
                     cell = run_cell(paths, clean, depth, aligner, spec,
                                     timeout, adaptive, trace=traced,
-                                    pallas=pallas)
+                                    pallas=pallas, fused=fused)
                     failures += cell.startswith("FAIL")
                     cells.append(f"{cell:<36}")
                 cell = run_serve_cell(client, paths, clean, aligner,

@@ -35,6 +35,13 @@ Metric extraction understands both artifact shapes:
     measure the reference sample, a different workload), so with only
     the floor requested the relative gate is skipped.
 
+  - synthbench artifacts with device consensus armed also carry a
+    `fused` block (the dispatch-loop view): `fused.host_frac` — the
+    measured host-overhead fraction of the polish wall — gates
+    ABSOLUTELY (default 0.75 whenever the block is present;
+    `--host-frac-max` makes it mandatory, rc 2 naming the dotted key
+    when absent). The windows/s floor stays mandatory alongside it.
+
   - synthbench `--scale-curve` artifacts additionally carry a `scale`
     block: gated on byte-identity across mesh sizes, per-shard
     useful-cell balance (`--scale-balance-max`, default 1.5 when the
@@ -167,6 +174,11 @@ def extract(doc: dict, path: str = "<artifact>") -> dict:
         out = {"name": "synthbench windows/s", "value": float(value),
                "unit": "windows/sec", "higher_better": True,
                "kind": "synth"}
+        # dispatch-loop block (fused single-launch era): the measured
+        # host-overhead fraction, gated absolutely via --host-frac-max
+        hf = _lookup(inner, "fused.host_frac")
+        if hf is not None:
+            out["host_frac"] = float(hf)
         if isinstance(inner.get("mesh"), dict):
             out["mesh"] = inner["mesh"]
         return out
@@ -387,6 +399,30 @@ def scale_checks(doc: dict, args,
     return checks
 
 
+def fused_checks(cand: dict, args,
+                 candidate_path: str) -> list[tuple[str, float, float]]:
+    """Host-overhead gate for artifacts carrying a `fused` block
+    (synthbench with device consensus armed): `fused.host_frac` — the
+    measured host-side fraction of the polish wall, the number the
+    fused dispatch loop exists to shrink — gates ABSOLUTELY. Gated at
+    the default limit whenever the artifact carries the key (the
+    slo.miss_rate convention); passing --host-frac-max makes it
+    mandatory — an artifact without the key then exits 2 naming it.
+    The windows/s floor stays mandatory alongside (wps_floor_check):
+    a fused-block artifact gates BOTH the throughput floor and the
+    overhead fraction when both are requested."""
+    explicit = args.host_frac_max is not None
+    if "host_frac" not in cand:
+        if explicit:
+            raise GateError(
+                f"{candidate_path}: artifact lacks gated metric "
+                "'fused.host_frac' (--host-frac-max gates synthbench "
+                "artifacts with a fused block)")
+        return []
+    limit = args.host_frac_max if explicit else 0.75
+    return [("fused.host_frac", cand["host_frac"], limit)]
+
+
 def wps_floor_check(cand: dict, args,
                     candidate_path: str) -> list[tuple[str, float, float]]:
     """Absolute windows/s floor (--windows-per-s-min): mandatory once
@@ -456,6 +492,12 @@ def run(args) -> int:
         print(f"[perfgate] {'PASS' if check_ok else 'FAIL'}: "
               f"{os.path.basename(candidate_path)} {name} = {value:g} "
               f"(min {floor:g})", file=sys.stderr)
+    for name, value, limit in fused_checks(cand, args, candidate_path):
+        check_ok = value <= limit
+        failures += 0 if check_ok else 1
+        print(f"[perfgate] {'PASS' if check_ok else 'FAIL'}: "
+              f"{os.path.basename(candidate_path)} {name} = {value:g} "
+              f"(limit {limit:g})", file=sys.stderr)
     for name, value, limit in slo_checks(doc, cand, args,
                                          candidate_path):
         check_ok = value <= limit
@@ -505,6 +547,15 @@ def main(argv=None) -> int:
                          "For synth artifacts this also makes the "
                          "relative gate optional (no implicit baseline "
                          "exists for synthetic workloads)")
+    ap.add_argument("--host-frac-max", type=float, default=None,
+                    help="absolute bound on the measured host-overhead "
+                         "fraction of the polish wall "
+                         "(fused.host_frac, synthbench artifacts with "
+                         "device consensus armed; default: gate at "
+                         "0.75 whenever the artifact carries the key; "
+                         "passing a value makes the gate mandatory — "
+                         "an artifact without it then exits 2 naming "
+                         "the dotted key)")
     ap.add_argument("--slo-miss-rate", type=float, default=None,
                     help="allowed deadline-miss rate for servebench "
                          "artifacts (default: gate at 0.0 whenever the "

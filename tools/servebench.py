@@ -633,6 +633,14 @@ def main(argv=None) -> int:
           f"{b['max_jobs_in_iteration']} jobs / "
           f"{b['max_windows_in_iteration']} windows per iteration)",
           file=sys.stderr)
+    # measured per-iteration host overhead (iteration wall - the
+    # pipeline's device-stage seconds) — the dispatch-loop number
+    shared_its = b["iterations"] - b.get("solo_iterations", 0)
+    if shared_its > 0 and "host_s" in b:
+        print(f"[servebench] dispatch host overhead: "
+              f"{b['host_s']:.3f}s total, "
+              f"{b['host_s'] / shared_its * 1e3:.1f}ms per feeder "
+              "iteration", file=sys.stderr)
     lanes = b.get("lanes") or []
     if len(lanes) > 1:
         per_lane = ", ".join(
@@ -696,7 +704,7 @@ def main(argv=None) -> int:
                            ("iterations", "shared_iterations", "jobs",
                             "windows", "max_jobs_in_iteration",
                             "max_windows_in_iteration",
-                            "max_concurrent_iterations")},
+                            "max_concurrent_iterations", "host_s")},
             "lanes": b.get("lanes") or [],
             "mesh": _mesh_block(b),
             "occupancy": b.get("occupancy", {}),

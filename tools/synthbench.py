@@ -307,6 +307,21 @@ def main(argv=None):
     ap.add_argument("-t", "--threads", type=int, default=os.cpu_count() or 1)
     ap.add_argument("-c", "--tpupoa-batches", type=int, default=0)
     ap.add_argument("--tpualigner-batches", type=int, default=0)
+    ap.add_argument("--engine", choices=("session", "fused"),
+                    default=None,
+                    help="device consensus engine (with -c > 0); "
+                         "default session — the fused engine is the "
+                         "one the RACON_TPU_FUSED single-launch "
+                         "program applies to")
+    ap.add_argument("--dispatch-overhead", action="store_true",
+                    help="A/B the fused single-launch dispatch "
+                         "(RACON_TPU_FUSED=1) against the split "
+                         "chained path (=0) on the same workload: "
+                         "windows/s, measured host overhead (host_s = "
+                         "polish wall - device-stage seconds) and "
+                         "launch counts per mode, byte-identity "
+                         "asserted; implies --engine fused and "
+                         "requires -c > 0")
     ap.add_argument("--adaptive-buckets", action="store_true",
                     help="arm the occupancy-aware batch scheduler "
                          "(adaptive shape ladders + sorted packing); "
@@ -357,6 +372,13 @@ def main(argv=None):
                          "budget)")
     args = ap.parse_args(argv)
 
+    if args.dispatch_overhead:
+        if args.tpupoa_batches <= 0:
+            print("[synthbench] --dispatch-overhead needs device "
+                  "consensus (-c > 0)", file=sys.stderr)
+            return 2
+        args.engine = "fused"
+
     if args.scale_curve:
         return run_scale_curve(args)
 
@@ -388,6 +410,9 @@ def main(argv=None):
         with gzip.open(draft_path, "wb", compresslevel=1) as f:
             f.write(b">draft\n" + draft + b"\n")
 
+        dispatch_ab = None
+        fused_mode_label = None  # the mode the MEASURED run dispatched
+
         def run_polish(instrument=None):
             t0 = time.perf_counter()
             polisher = create_polisher(
@@ -396,6 +421,7 @@ def main(argv=None):
                 num_threads=args.threads,
                 tpu_poa_batches=args.tpupoa_batches,
                 tpu_aligner_batches=args.tpualigner_batches,
+                tpu_engine=args.engine,
                 tpu_adaptive_buckets=args.adaptive_buckets or None)
             if instrument is not None:
                 instrument(polisher)
@@ -484,6 +510,49 @@ def main(argv=None):
                   f"journaled) "
                   f"[{'OK' if overhead < 2.0 else 'OVER'} 2% target]",
                   file=sys.stderr)
+        elif args.dispatch_overhead:
+            # A/B the two dispatch modes on the SAME workload (the
+            # --trace discipline: a discarded warmup run per mode
+            # absorbs that mode's compiles before its measured run).
+            # Byte-identity across modes is asserted — the fused
+            # program may move every perf number, never a byte.
+            saved_mode = os.environ.get("RACON_TPU_FUSED")
+            dispatch_ab = {}
+            try:
+                for mode, label in (("0", "split"), ("1", "fused")):
+                    os.environ["RACON_TPU_FUSED"] = mode
+                    run_polish()  # warmup, discarded
+                    polisher, polished, n_windows, init_s, polish_s = \
+                        run_polish()
+                    ss = polisher.stage_stats
+                    dispatch_ab[label] = {
+                        "windows_per_s": round(n_windows / polish_s, 3)
+                        if polish_s > 0 else 0.0,
+                        "polish_s": round(polish_s, 3),
+                        "device_s": round(ss["device_s"], 3),
+                        "host_s": round(
+                            max(0.0, polish_s - ss["device_s"]), 3),
+                        "launches": ss["launches"],
+                        "chunks": ss["chunks"],
+                        "_fasta": [(s.name, s.data) for s in polished],
+                    }
+            finally:
+                if saved_mode is None:
+                    os.environ.pop("RACON_TPU_FUSED", None)
+                else:
+                    os.environ["RACON_TPU_FUSED"] = saved_mode
+            fused_mode_label = "1"  # the headline run dispatched fused
+            dispatch_ab["identical"] = (
+                dispatch_ab["split"].pop("_fasta")
+                == dispatch_ab["fused"].pop("_fasta"))
+            sp, fu = dispatch_ab["split"], dispatch_ab["fused"]
+            print(f"[synthbench] dispatch A/B: split "
+                  f"{sp['windows_per_s']} w/s (host {sp['host_s']}s, "
+                  f"{sp['launches']} launches) vs fused "
+                  f"{fu['windows_per_s']} w/s (host {fu['host_s']}s, "
+                  f"{fu['launches']} launches), FASTA "
+                  f"{'identical' if dispatch_ab['identical'] else 'DIVERGED'}",
+                  file=sys.stderr)
         else:
             polisher, polished, n_windows, init_s, polish_s = run_polish()
         # occupancy report: the per-bucket padding-waste metric the
@@ -511,6 +580,33 @@ def main(argv=None):
             for seq in polished:
                 fh.write(b">" + seq.name.encode() + b"\n" + seq.data + b"\n")
         print(f"[synthbench] wrote golden {args.golden_out}", file=sys.stderr)
+
+    # measured dispatch overhead: host_s = polish wall minus the
+    # device-stage seconds (dispatch + result wait; clamped at 0 when
+    # deep pipelining makes the stage sums exceed the wall) — the
+    # number the fused single-launch program exists to shrink,
+    # published in the artifact's `fused` block for perfgate
+    fused_block = None
+    if args.tpupoa_batches > 0:
+        ss = polisher.stage_stats
+        host_s = max(0.0, polish_s - ss["device_s"])
+        fused_block = {
+            "mode": (fused_mode_label
+                     or os.environ.get("RACON_TPU_FUSED") or "auto"),
+            "engine": args.engine or "session",
+            "launches": ss["launches"],
+            "chunks": ss["chunks"],
+            "device_s": round(ss["device_s"], 3),
+            "host_s": round(host_s, 3),
+            "host_frac": round(host_s / polish_s, 4)
+            if polish_s > 0 else 0.0,
+        }
+        print(f"[synthbench] dispatch: {fused_block['launches']} "
+              f"launches / {fused_block['chunks']} chunks "
+              f"(mode {fused_block['mode']}), host overhead "
+              f"{fused_block['host_s']}s "
+              f"({fused_block['host_frac'] * 100:.1f}% of polish wall)",
+              file=sys.stderr)
 
     # throughput first: the identity metric below costs O(genome^2/64)
     # Myers time at multi-Mb scale, and the perf number must survive a
@@ -550,6 +646,13 @@ def main(argv=None):
             # different machine, not a regression)
             "mesh": mesh_info(),
         }
+        if fused_block is not None:
+            # measured dispatch-loop numbers (host overhead fraction,
+            # launch counts) — perfgate gates fused.host_frac whenever
+            # this block is present
+            artifact["fused"] = fused_block
+        if dispatch_ab is not None:
+            artifact["dispatch_overhead"] = dispatch_ab
         with open(args.json, "w") as fh:
             json.dump(artifact, fh, indent=1, sort_keys=True)
         print(f"[synthbench] wrote artifact {args.json}", file=sys.stderr)
@@ -561,6 +664,8 @@ def main(argv=None):
               file=sys.stderr)
     except Exception:
         pass
+    if dispatch_ab is not None and not dispatch_ab["identical"]:
+        return 1  # the fused program moved a byte: that is a bug
     return 0
 
 

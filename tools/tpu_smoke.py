@@ -92,6 +92,24 @@ for edge in (512, 1024, 2048, 4096):
               f"identical={ent['identical']} "
               f"fresh={'yes' if fresh else 'no'} ms={ent['ms']}",
               flush=True)
+# the fused-loop plane: split chained dispatch vs the single-launch
+# fused align->window-slice->POA program, per depth bucket at the
+# PRODUCTION consult key — FusedPOA._fused_plan looks winners up by
+# (env_max_nodes(), MAX_LEN, leading chain bucket) with the CLI
+# default scoring and the engine's MAX_PRED, so these entries are
+# exactly what RACON_TPU_FUSED=auto dispatches from.
+from racon_tpu.ops.poa_fused import DEPTH_BUCKETS
+from racon_tpu.ops.poa_graph import MAX_LEN, env_max_nodes
+
+N = env_max_nodes()
+for d in DEPTH_BUCKETS:
+    ent, fresh = at.profile_fused_bucket(N, MAX_LEN, d, MAX_PRED,
+                                         3, -5, -4)
+    print(f"fused_loop ({N},{MAX_LEN},{d}): winner "
+          f"{ent['kernel']}:{ent['dtype']} "
+          f"identical={ent['identical']} "
+          f"fresh={'yes' if fresh else 'no'} ms={ent['ms']}",
+          flush=True)
 path = at.save()
 print(f"winner table ({len(at.table)} entries) -> {path}", flush=True)
 """
