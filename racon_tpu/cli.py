@@ -18,6 +18,7 @@ HELP = """\
 usage: racon_tpu [options ...] <sequences> <overlaps> <target sequences>
        racon_tpu serve [serve options ...]
        racon_tpu submit [submit options ...] <sequences> <overlaps> <target>
+       racon_tpu fleet [fleet options ...]
 
     subcommands (see `racon_tpu serve --help` / `racon_tpu submit --help`
     and the README "Serving" section):
@@ -35,6 +36,15 @@ usage: racon_tpu [options ...] <sequences> <overlaps> <target sequences>
                 `--tenant` names the fair-scheduling bucket, and
                 `--trace-out t.json` writes one merged client+server
                 Chrome trace of the request
+        fleet   federate N replicas' metrics and health into one view:
+                polls every endpoint in --endpoints /
+                RACON_TPU_FLEET_ENDPOINTS, merges counters and latency
+                histograms (exact bucket pooling, exemplars preserved),
+                and serves the merged /metrics + /healthz on --port —
+                healthy only while EVERY replica is reachable and not
+                draining; `--json` prints one machine-readable fleet
+                snapshot instead (README "Fleet view"; the live
+                console is tools/servetop.py)
 
     #default output is stdout
     <sequences>
@@ -404,6 +414,10 @@ def main(argv: list[str] | None = None) -> int:
         from .serve.client import submit_main
 
         return submit_main(argv[1:])
+    if argv and argv[0] == "fleet":
+        from .obs.fleet import fleet_main
+
+        return fleet_main(argv[1:])
     opts = parse_args(argv)
     if opts is None:
         return 0

@@ -48,11 +48,21 @@ DEFAULT_MAX_BYTES = 8 << 20
 #: finished-late job (it still terminates via `finished`), not terminal.
 TERMINAL_EVENTS = frozenset((
     "finished", "failed", "expired", "rejected-full",
-    "rejected-draining"))
+    "rejected-quota", "rejected-draining"))
 
 #: terminal states that imply the job actually ran (must pair with a
 #: `started` event)
 RAN_EVENTS = frozenset(("finished", "failed"))
+
+#: every event type the lifecycle checker UNDERSTANDS. Anything outside
+#: this set — `alert` lines from the SLO burn tracker, and whatever
+#: event types future PRs add — is an annotation, not a lifecycle
+#: transition: the consistency check must ignore it, never fail on it
+#: (an old obsreport binary reading a newer server's journal would
+#: otherwise turn every new event type into a red CI).
+LIFECYCLE_EVENTS = TERMINAL_EVENTS | RAN_EVENTS | frozenset((
+    "received", "admitted", "started", "deadline-miss", "iterations",
+    "part-streamed"))
 
 
 def journal_max_bytes() -> int:
@@ -222,6 +232,10 @@ def check_consistency(entries: list[dict]) -> list[str]:
         life — `received` — is inside the journal window; rotation may
         have cut older jobs' early events, which is not an error);
       - a `started` job never also terminates as expired/rejected.
+
+    Events outside LIFECYCLE_EVENTS (e.g. `alert`) are annotations and
+    are ignored; a job id that appears ONLY on annotation lines is
+    skipped entirely — unknown event types must never fail the check.
     """
     jobs: dict[str, list[str]] = {}
     for e in entries:
@@ -229,7 +243,10 @@ def check_consistency(entries: list[dict]) -> list[str]:
         if job:
             jobs.setdefault(str(job), []).append(str(e.get("event")))
     problems: list[str] = []
-    for job, events in sorted(jobs.items()):
+    for job, all_events in sorted(jobs.items()):
+        events = [e for e in all_events if e in LIFECYCLE_EVENTS]
+        if not events:
+            continue  # annotation-only job id (see docstring)
         terminal = [e for e in events if e in TERMINAL_EVENTS]
         if not terminal:
             problems.append(f"job {job}: no terminal state ({events})")

@@ -43,6 +43,7 @@ from __future__ import annotations
 import json
 import os
 import tempfile
+import threading
 
 import numpy as np
 
@@ -85,6 +86,13 @@ class Autotuner:
     def __init__(self, path: str | None = None):
         self.path = path or default_table_path()
         self.table: dict[str, dict] = {}
+        #: per-decision consult counters: (engine, kernel, dtype) ->
+        #: times `winner()` handed that decision to a dispatcher
+        #: (kernel "none" = a cold bucket, the XLA-default path). The
+        #: serve scrape exports them as labeled counters so a fleet
+        #: view can tell which buckets run which kernel plane.
+        self.consults: dict[tuple[str, str, str], int] = {}
+        self._consult_lock = threading.Lock()
         try:
             with open(self.path) as fh:
                 doc = json.load(fh)
@@ -107,8 +115,22 @@ class Autotuner:
 
     def winner(self, engine: str, bucket, params=()) -> dict | None:
         """The measured entry for one bucket on THIS backend, or None
-        (cold — the dispatcher keeps today's XLA default)."""
-        return self.table.get(self.key(engine, bucket, params))
+        (cold — the dispatcher keeps today's XLA default). Every call
+        bumps the per-decision consult counter the scrape exports."""
+        ent = self.table.get(self.key(engine, bucket, params))
+        decision = (engine, str((ent or {}).get("kernel") or "none"),
+                    str((ent or {}).get("dtype") or ""))
+        with self._consult_lock:
+            self.consults[decision] = self.consults.get(decision, 0) + 1
+        return ent
+
+    def consult_counts(self) -> list[tuple[dict, int]]:
+        """Labeled samples for the scrape: ({engine, decision, dtype},
+        count) per distinct decision handed out so far."""
+        with self._consult_lock:
+            items = sorted(self.consults.items())
+        return [({"engine": eng, "decision": kern, "dtype": dt}, n)
+                for (eng, kern, dt), n in items]
 
     def record(self, engine: str, bucket, params, entry: dict) -> None:
         self.table[self.key(engine, bucket, params)] = entry

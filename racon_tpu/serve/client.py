@@ -326,6 +326,12 @@ class PolishClient:
     def stats(self) -> dict:
         return self.request({"type": "stats"})
 
+    def healthz(self) -> dict:
+        """The replica health body ({ok, draining, queue_depth, ...})
+        — `ok` false once the server started draining, mirroring the
+        HTTP endpoint's 503."""
+        return self.request({"type": "healthz"})
+
     def scrape(self) -> str:
         """Live Prometheus text exposition (the same body the optional
         `--metrics-port` HTTP endpoint serves) — counters, gauges and

@@ -23,9 +23,11 @@ Malformed-input discipline (the server must outlive every bad client):
   - EOF mid-frame -> `FrameTruncated`; the peer is gone, nothing can be
     answered — the handler cleans up the connection quietly.
 
-Request types: ``submit`` / ``ping`` / ``stats`` / ``scrape`` /
-``debug`` / ``shutdown``. Response types: ``result`` / ``pong`` /
-``stats`` / ``metrics`` (Prometheus text in ``text``) / ``debug``
+Request types: ``submit`` / ``ping`` / ``stats`` / ``healthz`` /
+``scrape`` / ``debug`` / ``shutdown``. Response types: ``result`` /
+``pong`` / ``stats`` / ``healthz`` (``ok`` false while draining — the
+RPC twin of the HTTP endpoint's 503) / ``metrics`` (Prometheus text in
+``text``) / ``debug``
 (flight-recorder events + dump paths) / ``ok`` / ``error`` (with a
 machine-readable ``code``; ``queue-full`` errors carry ``retry_after``
 seconds, ``job-failed`` errors carry ``error_type`` from the errors.py

@@ -307,6 +307,12 @@ class JobQueue:
         #: per-job disk write off the hot lock. Exceptions are
         #: swallowed — accounting must never strand a job.
         self.on_event = None
+        #: optional callable(job, hit_total, miss_total) fired OUTSIDE
+        #: the lock after each deadline-carrying job is accounted — the
+        #: server's SLO burn-rate tracker samples the cumulative
+        #: counters here (obs/fleet.py). Exceptions are swallowed:
+        #: alerting must never strand a job.
+        self.on_slo = None
         self.counters = {"submitted": 0, "admitted": 0, "rejected_full": 0,
                          "rejected_draining": 0, "rejected_quota": 0,
                          "expired": 0, "completed": 0, "failed": 0,
@@ -498,10 +504,14 @@ class JobQueue:
                          popped.started_t - popped.enqueued_t, 4))
         return popped
 
-    def task_done(self, job: Job, ok: bool, service_s: float) -> bool:
+    def task_done(self, job: Job, ok: bool, service_s: float,
+                  exemplar: dict | None = None) -> bool:
         """Account a finished job. Returns True when the job carried a
         deadline and finished PAST it (the SLO miss the server's flight
-        recorder dumps on) — expired-in-queue jobs never reach here."""
+        recorder dumps on) — expired-in-queue jobs never reach here.
+        `exemplar` (trace id / flight-dump path, built by the serve
+        worker) rides the job-latency observation so the scrape's
+        latency buckets name a representative job."""
         missed = (job.deadline is not None
                   and time.perf_counter() > job.deadline)
         with self._lock:
@@ -511,6 +521,10 @@ class JobQueue:
             if job.deadline is not None:
                 self.counters["deadline_miss" if missed
                               else "deadline_hit"] += 1
+                hit = self.counters["deadline_hit"]
+                miss = self.counters["deadline_miss"]
+            else:
+                hit = None
             # EMA over the last ~8 jobs: adapts to workload shifts
             # without a rejection spike swinging the hint wildly
             self._ema_service_s += (service_s - self._ema_service_s) / 8.0
@@ -518,7 +532,13 @@ class JobQueue:
         if self.hists is not None:
             self.hists.observe("job.service", service_s)
             self.hists.observe("job.latency",
-                               time.perf_counter() - job.enqueued_t)
+                               time.perf_counter() - job.enqueued_t,
+                               exemplar=exemplar)
+        if hit is not None and self.on_slo is not None:
+            try:
+                self.on_slo(job, hit, miss)
+            except Exception:  # noqa: BLE001 — see on_slo contract
+                pass
         return missed
 
     def _notify(self, event: str, job: Job, **fields) -> None:
@@ -606,6 +626,14 @@ class JobQueue:
                                "weight": self.weight(j.tenant),
                                "queued": 0})
                 tenants[j.tenant]["queued"] += 1
+            # live DRR credit (accrued deficit across priority classes)
+            # — the fairness dial servetop renders per tenant
+            credit: dict[str, float] = {}
+            for cls in self._classes.values():
+                for t, d in cls.deficit.items():
+                    credit[t] = credit.get(t, 0.0) + d
+            for t, tc in tenants.items():
+                tc["credit"] = round(credit.get(t, 0.0), 3)
             out = dict(self.counters, depth=self._count,
                        maxsize=self.maxsize,
                        draining=self._draining,

@@ -44,6 +44,7 @@ from __future__ import annotations
 
 import contextlib
 import gzip
+import json
 import os
 import random
 import socket
@@ -55,6 +56,7 @@ import time
 from collections import deque
 
 from ..errors import RaconError
+from ..obs import fleet as obs_fleet
 from ..obs import flight as obs_flight
 from ..obs import prom as obs_prom
 from ..obs import trace as obs_trace
@@ -410,6 +412,24 @@ class PolishServer:
         self._t_wall_start = time.time()
         self.journal: Journal | None = None
         self._warm: dict | None = None
+        #: SLO burn-rate tracker (obs/fleet.py): sampled on every
+        #: deadline-carrying job via the queue's on_slo hook; state
+        #: transitions journal typed `alert` events and flip the
+        #: racon_tpu_slo_burn_alert gauge. seed_zero: this process's
+        #: counters were born with the tracker, so the very first miss
+        #: counts against a zero baseline.
+        self.burn = obs_fleet.BurnRateTracker(seed_zero=True)
+        self.queue.on_slo = self._on_slo
+        #: latency exemplars (obs/hist.py): on by default, disabled by
+        #: RACON_TPU_SERVE_EXEMPLARS=0 — the byte-identity A/B knob
+        self.exemplars_enabled = (
+            os.environ.get("RACON_TPU_SERVE_EXEMPLARS", "1") != "0")
+        #: self-metered exposition cost: seconds this process spent
+        #: RENDERING scrape bodies (not wire or aggregator time) — the
+        #: number servebench --fleet holds to the <2% budget
+        self._scrape_count = 0
+        self._scrape_render_s = 0.0
+        self._scrape_lock = threading.Lock()
 
     # ---------------------------------------------------------- lifecycle
     def start(self) -> "PolishServer":
@@ -524,6 +544,42 @@ class PolishServer:
                 self.journal.record(event, job=job.id,
                                     trace=job.trace_id, **fields)
 
+    def _on_slo(self, job: Job, hit: int, miss: int) -> None:
+        """JobQueue.on_slo sink: sample the burn-rate tracker with the
+        cumulative deadline counters; a state transition journals a
+        typed `alert` event carrying the job that tripped (or cleared)
+        it, so obsreport's per-job timeline shows the alert next to
+        the deadline-miss that caused it."""
+        res = self.burn.sample(hit, miss)
+        if res["changed"] and self.journal is not None:
+            self.journal.record(
+                "alert", job=job.id, trace=job.trace_id,
+                kind="slo-burn",
+                state="firing" if res["firing"] else "clear",
+                burn_fast=res["fast"], burn_slow=res["slow"],
+                threshold=res["threshold"],
+                deadline_hit=hit, deadline_miss=miss)
+        if res["changed"]:
+            log_info(
+                f"[racon_tpu::serve] SLO burn alert "
+                f"{'FIRING' if res['firing'] else 'clear'}: "
+                f"fast {res['fast']:g}x / slow {res['slow']:g}x of "
+                f"budget (threshold {res['threshold']:g}x, "
+                f"{miss} deadline misses)")
+
+    def healthz_snapshot(self) -> dict:
+        """The health body both transports serve (`/healthz` HTTP —
+        503 while draining — and the `healthz` RPC): ok + draining +
+        enough context for a fleet view's per-replica detail."""
+        draining = self._draining.is_set()
+        return {"ok": not draining,
+                "draining": draining,
+                "warm": self._warm is not None,
+                "uptime_s": round(
+                    time.perf_counter() - self._t_start, 3),
+                "queue_depth": len(self.queue),
+                "inflight": self._inflight_count()}
+
     def _start_metrics_http(self) -> None:
         """Serve Prometheus text on localhost HTTP (stdlib only). Bind
         failure raises at start() — an operator asked for a port they
@@ -546,10 +602,15 @@ class PolishServer:
                         self.end_headers()
                         self.wfile.write(body)
                     elif path == "/healthz":
-                        body = (b"draining\n" if polish_server._draining
-                                .is_set() else b"ok\n")
-                        self.send_response(200)
-                        self.send_header("Content-Type", "text/plain")
+                        # a draining replica answers 503 so a load
+                        # balancer stops routing to it — the JSON body
+                        # says WHY, for the operator behind the LB
+                        doc = polish_server.healthz_snapshot()
+                        body = (json.dumps(doc, sort_keys=True)
+                                + "\n").encode()
+                        self.send_response(200 if doc["ok"] else 503)
+                        self.send_header("Content-Type",
+                                         "application/json")
                         self.send_header("Content-Length", str(len(body)))
                         self.end_headers()
                         self.wfile.write(body)
@@ -691,8 +752,6 @@ class PolishServer:
                  f"{b['compiles']} compiles {b['compile_s']:.2f}s")
         metrics_path = os.environ.get("RACON_TPU_METRICS")
         if metrics_path:
-            import json
-
             try:
                 with open(metrics_path, "w") as fh:
                     json.dump(snap, fh, indent=2, sort_keys=True)
@@ -779,6 +838,11 @@ class PolishServer:
                     "mono_s": time.perf_counter()}
         if rtype == "stats":
             return dict(self.stats_snapshot(), type="stats")
+        if rtype == "healthz":
+            # the RPC twin of the HTTP /healthz: same body, same
+            # draining semantics, for unix/TCP-only deployments and
+            # the fleet aggregator's replica probe
+            return dict(self.healthz_snapshot(), type="healthz")
         if rtype == "scrape":
             return {"type": "metrics",
                     "content_type": obs_prom.CONTENT_TYPE,
@@ -994,7 +1058,27 @@ class PolishServer:
                         and job.stats_ref.hists is not None:
                     self.hists.merge(job.stats_ref.hists)
                 service_s = time.perf_counter() - t0
-                missed = self.queue.task_done(job, ok, service_s)
+                # latency exemplar: the job-latency bucket this job
+                # lands in remembers WHO it was (trace id) and — for a
+                # failed / deadline-missed job — the flight dump the
+                # worker is about to write, so a fleet p99 bucket
+                # clicks through to the exact job's Chrome trace. The
+                # dump path is deterministic (_flight_dump names it
+                # identically below).
+                exemplar = None
+                if self.exemplars_enabled:
+                    exemplar = {"trace_id": job.trace_id or job.id,
+                                "job": job.id}
+                    will_miss = (job.deadline is not None
+                                 and time.perf_counter() > job.deadline)
+                    if (not ok or will_miss) and self.config.flight_dir:
+                        reason = ("job-failed" if not ok
+                                  else "deadline-miss")
+                        exemplar["flight"] = os.path.join(
+                            self.config.flight_dir,
+                            f"flight_{job.id}_{reason}.json")
+                missed = self.queue.task_done(job, ok, service_s,
+                                              exemplar=exemplar)
                 if self.journal is not None:
                     batch = ((resp.get("serve") or {}).get("batch")
                              if ok else None) or {}
@@ -1205,6 +1289,7 @@ class PolishServer:
         """One Prometheus scrape body (obs/prom.py): lifetime counters,
         live gauges and every latency histogram — refreshed at call
         time, safe to call at any lifecycle point including drain."""
+        t_render = time.perf_counter()
         q = self.queue.snapshot()
         b = self.batcher.snapshot()
         counters = {f"serve.jobs.{k}": q[k] for k in (
@@ -1239,6 +1324,16 @@ class PolishServer:
         if self.journal is not None:
             counters["serve.journal.events"] = self.journal.events
             counters["serve.journal.dropped"] = self.journal.dropped
+        # autotuner decision receipts: which (engine, kernel, dtype)
+        # decision the persisted winner tables handed each dispatcher —
+        # the fleet view of which buckets run which kernel plane
+        from ..sched.autotune import get_autotuner
+
+        consults = get_autotuner().consult_counts()
+        if consults:
+            counters["sched.autotune.consults"] = obs_prom.Labeled(
+                consults, "winner-table consults by decision "
+                "(decision 'none' = cold bucket, XLA default)")
         gauges = {
             "serve.uptime_seconds": (
                 round(time.perf_counter() - self._t_start, 3),
@@ -1266,7 +1361,42 @@ class PolishServer:
             if "occupancy_pct" in e:
                 gauges[f"sched.{engine}.occupancy_pct"] = \
                     e["occupancy_pct"]
-        return obs_prom.render(counters, gauges, self.hists)
+        # per-tenant live view as PROPERLY LABELED series (tenant ids
+        # are label VALUES here, escaped — any validated id survives,
+        # unlike the name-embedded lifetime counters above): queue
+        # depth per tenant is what makes the fleet per-tenant view
+        # possible at all, credit is the live DRR fairness dial
+        tenants = q.get("tenants") or {}
+        if tenants:
+            gauges["serve.tenant_queue_depth"] = obs_prom.Labeled(
+                [({"tenant": t}, tc.get("queued", 0))
+                 for t, tc in sorted(tenants.items())],
+                "live queued jobs per tenant")
+            gauges["serve.tenant_credit"] = obs_prom.Labeled(
+                [({"tenant": t}, tc.get("credit", 0.0))
+                 for t, tc in sorted(tenants.items())],
+                "accrued DRR credit per tenant (spent one per pop)")
+        # SLO burn-rate view (obs/fleet.py tracker, fed by the queue's
+        # on_slo hook)
+        burn = self.burn.state()
+        gauges["slo.burn_rate"] = (
+            burn["fast"], "fast-window SLO burn rate: deadline-miss "
+            "rate over the window as a multiple of the error budget")
+        gauges["slo.burn_rate_slow"] = burn["slow"]
+        gauges["slo.burn_alert"] = (
+            burn["firing"],
+            "1 while both burn windows exceed the threshold")
+        # self-metered scrape cost (PRIOR renders — this body reports
+        # the totals as they stood when it started rendering)
+        with self._scrape_lock:
+            counters["serve.scrapes"] = self._scrape_count
+            counters["serve.scrape_seconds"] = round(
+                self._scrape_render_s, 6)
+        body = obs_prom.render(counters, gauges, self.hists)
+        with self._scrape_lock:
+            self._scrape_count += 1
+            self._scrape_render_s += time.perf_counter() - t_render
+        return body
 
     # -------------------------------------------------------------- misc
     def _inflight_count(self) -> int:
@@ -1291,6 +1421,7 @@ class PolishServer:
                 "slo": {"deadline_hit": q["deadline_hit"],
                         "deadline_miss": q["deadline_miss"],
                         "expired": q["expired"],
+                        "burn": self.burn.state(),
                         "miss_rate": round(
                             q["deadline_miss"] / deadlined, 4)
                         if deadlined else 0.0,

@@ -1,0 +1,681 @@
+"""Fleet observability plane: scrape round-trip, merge, alerts.
+
+Pins the PR-12 contracts:
+
+  - the Prometheus text round trip is EXACT: `parse(render(registry))`
+    reproduces every counter, gauge, labeled family and histogram
+    bucket (plus the exact min/max sidecars and OpenMetrics exemplars),
+    and the strict parser rejects drifted bodies;
+  - histogram `merge()` is associative and order-independent — the
+    property fleet aggregation silently depends on — and a 3-replica
+    in-process fleet's merged quantiles EQUAL the quantiles of the
+    pooled raw observations;
+  - `/healthz` answers 503 `{"draining": true}` (HTTP) / `ok: false`
+    (RPC) once a replica starts draining, on BOTH transports;
+  - an injected deadline-miss flood trips the SLO burn-rate alert
+    (typed `alert` journal event + `racon_tpu_slo_burn_alert` gauge
+    flip) and the latency exemplar names the flight dump of an
+    actually-missed job;
+  - per-tenant queue-depth/credit gauges and autotuner consult
+    counters ride the scrape as properly labeled series;
+  - obsreport `--check` tolerates `alert` (and unknown) event types
+    and renders alerts in the per-job timeline; perfgate gates the
+    servebench `--fleet` scrape-overhead column at the <2% budget.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import random
+import socket
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "tools"))
+
+from racon_tpu.obs import prom
+from racon_tpu.obs.fleet import (BurnRateTracker, Endpoint,
+                                 FleetAggregator)
+from racon_tpu.obs.hist import Histogram, HistogramSet
+from racon_tpu.obs.journal import check_consistency
+from racon_tpu.serve.protocol import recv_frame, send_frame
+from racon_tpu.serve.server import PolishServer, make_synth_dataset
+
+
+# ----------------------------------------------------------- prom round trip
+def _hist_state(h: Histogram) -> tuple:
+    buckets, count, total = h.export()
+    return (tuple(buckets), count, total, h.min, h.max)
+
+
+def test_prom_roundtrip_exact():
+    """parse(render(registry)) reproduces every counter, gauge and
+    histogram bucket exactly — the property federation rests on."""
+    rng = random.Random(7)
+    hs = HistogramSet()
+    for _ in range(500):
+        hs.observe("job.latency", rng.lognormvariate(-1.5, 1.5))
+    hs.observe("job.latency", 0.42,
+               exemplar={"trace_id": "t-1", "flight": "/tmp/f.json"})
+    for _ in range(50):
+        hs.observe("serve.iteration", rng.uniform(0, 2))
+    counters = {"serve.jobs.completed": 421,
+                "serve.compiles": (7, "engine compiles"),
+                "sched.autotune.consults": prom.Labeled(
+                    [({"engine": "aligner", "decision": "pallas",
+                       "dtype": "int16"}, 12),
+                     ({"engine": "fused_loop", "decision": "none",
+                       "dtype": ""}, 3)], "consults")}
+    gauges = {"serve.queue_depth": 5,
+              "serve.draining": False,
+              "serve.tenant_queue_depth": prom.Labeled(
+                  [({"tenant": "gold"}, 3), ({"tenant": ""}, 1)])}
+    text = prom.render(counters, gauges, hs)
+    s = prom.parse(text)
+    assert s.counters["racon_tpu_serve_jobs_completed_total"] == 421
+    assert s.counters["racon_tpu_serve_compiles_total"] == 7
+    assert s.gauges["racon_tpu_serve_queue_depth"] == 5
+    assert s.gauges["racon_tpu_serve_draining"] == 0
+    consults = s.counter_series[
+        "racon_tpu_sched_autotune_consults_total"]
+    by_engine = {lbl["engine"]: (lbl["decision"], lbl["dtype"], v)
+                 for _, (lbl, v) in consults.items()}
+    assert by_engine == {"aligner": ("pallas", "int16", 12.0),
+                         "fused_loop": ("none", "", 3.0)}
+    tenants = s.gauge_series["racon_tpu_serve_tenant_queue_depth"]
+    assert {lbl["tenant"]: v for _, (lbl, v) in tenants.items()} == \
+        {"gold": 3.0, "": 1.0}
+    for name in ("job.latency", "serve.iteration"):
+        orig = hs.get(name)
+        back = s.histogram(prom.metric_name(name) + "_seconds")
+        assert _hist_state(back) == _hist_state(orig)
+    # the exemplar survived, on the same bucket, with its labels
+    orig = hs.get("job.latency")
+    back = s.histogram("racon_tpu_job_latency_seconds")
+    oex, bex = orig.bucket_exemplars(), back.bucket_exemplars()
+    assert oex.keys() == bex.keys()
+    (le,) = [le for le, ex in bex.items()
+             if ex.get("trace_id") == "t-1"]
+    assert bex[le]["flight"] == "/tmp/f.json"
+    assert bex[le]["value"] == oex[le]["value"]
+    # a re-render of the parsed view parses again (idempotent format)
+    prom.parse(prom.render(hists=s.histogram_set()))
+
+
+def test_prom_parse_strict():
+    with pytest.raises(prom.PromParseError):
+        prom.parse("this is not prometheus\n")
+    with pytest.raises(prom.PromParseError):
+        prom.parse("racon_tpu_x 1\n")  # sample without a TYPE line
+    with pytest.raises(prom.PromParseError):
+        prom.parse("# TYPE racon_tpu_x gauge\n"
+                   "racon_tpu_x{tenant=unquoted} 1\n")
+
+
+# ------------------------------------------------------------- hist merging
+def _fill(values) -> Histogram:
+    h = Histogram()
+    for v in values:
+        h.observe(v)
+    return h
+
+
+def test_hist_merge_associative_and_order_independent():
+    """merge(a, merge(b, c)) == merge(merge(a, b), c), and every
+    permutation pools to the same exact state — count/sum/min/max and
+    every bucket, not approximately."""
+    rng = random.Random(11)
+    # dyadic values (k/1024): every partial sum is exactly
+    # representable, so float addition is genuinely associative and
+    # the `sum` comparison below is EXACT, not approximately-equal
+    parts = [[rng.randrange(1, 1 << 14) / 1024.0 for _ in range(n)]
+             for n in (137, 1, 55)]
+
+    def state(h):
+        return (tuple(h.counts), h.count, h.sum, h.min, h.max)
+
+    # associativity
+    left = _fill(parts[0])
+    bc = _fill(parts[1])
+    bc.merge(_fill(parts[2]))
+    left.merge(bc)
+    right = _fill(parts[0])
+    right.merge(_fill(parts[1]))
+    right.merge(_fill(parts[2]))
+    assert state(left) == state(right)
+    # order independence, vs the pooled ground truth
+    pooled = _fill([v for p in parts for v in p])
+    import itertools
+
+    for perm in itertools.permutations(range(3)):
+        acc = Histogram()
+        for i in perm:
+            acc.merge(_fill(parts[i]))
+        assert state(acc) == state(pooled), f"order {perm} diverged"
+    # an empty histogram is the identity
+    ident = Histogram()
+    ident.merge(pooled)
+    assert state(ident) == state(pooled)
+
+
+def test_hist_from_export_roundtrip():
+    h = _fill([0.001, 0.5, 0.5, 700.0, 50000.0])  # incl. overflow
+    h.observe(0.2, exemplar={"trace_id": "x"})
+    buckets, count, total = h.export()
+    back = Histogram.from_export(buckets, count, total, h.min, h.max,
+                                 h.bucket_exemplars())
+    assert back.counts == h.counts
+    assert (back.count, back.sum, back.min, back.max) == \
+        (h.count, h.sum, h.min, h.max)
+    assert back.bucket_exemplars().keys() == \
+        h.bucket_exemplars().keys()
+    for q in (0.5, 0.9, 0.99):
+        assert back.quantile(q) == h.quantile(q)
+
+
+def test_hist_from_export_without_sidecars_stays_usable():
+    """A pre-sidecar replica's scrape (no _min/_max): reconstruction
+    falls back to bucket-derived bounds — quantile/snapshot/re-render
+    must work, never TypeError on None."""
+    h = _fill([0.05, 0.3, 2.0])
+    buckets, count, total = h.export()
+    back = Histogram.from_export(buckets, count, total)  # no min/max
+    assert back.min is not None and back.max is not None
+    assert back.min <= 0.05 and back.max >= 2.0 * (2 ** -0.25)
+    assert back.quantile(0.5) > 0
+    assert back.snapshot()["count"] == 3
+    prom.parse(prom.render(
+        hists=HistogramSet()) + "\n".join(
+        prom.histogram_lines("x", back)) + "\n")
+
+
+# -------------------------------------------------------------- fake fleet
+def _fake_replica(sock_path: str, hists: HistogramSet,
+                  counters: dict, draining: bool = False,
+                  gauges: dict | None = None):
+    """A minimal frame-protocol replica answering scrape/healthz —
+    enough surface for the aggregator, without a polishing engine."""
+    lst = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    lst.bind(sock_path)
+    lst.listen(8)
+    lst.settimeout(0.2)
+    stop = threading.Event()
+
+    def handle(conn):
+        try:
+            while True:
+                req = recv_frame(conn)
+                if req is None:
+                    return
+                if req.get("type") == "scrape":
+                    send_frame(conn, {
+                        "type": "metrics",
+                        "text": prom.render(counters=counters,
+                                            gauges=gauges,
+                                            hists=hists)})
+                elif req.get("type") == "healthz":
+                    send_frame(conn, {"type": "healthz",
+                                      "ok": not draining,
+                                      "draining": draining})
+                else:
+                    send_frame(conn, {"type": "error",
+                                      "message": "bad request"})
+        except OSError:
+            pass
+        finally:
+            with contextlib.suppress(OSError):
+                conn.close()
+
+    def loop():
+        while not stop.is_set():
+            try:
+                conn, _ = lst.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            threading.Thread(target=handle, args=(conn,),
+                             daemon=True).start()
+
+    threading.Thread(target=loop, daemon=True).start()
+
+    def close():
+        stop.set()
+        with contextlib.suppress(OSError):
+            lst.close()
+
+    return close
+
+
+def test_fleet_merged_quantiles_equal_pooled(tmp_path):
+    """The acceptance pin: a 3-replica fleet's merged quantiles equal
+    the quantiles of the pooled raw observations — exactly, because
+    buckets, count and the min/max sidecars all round-trip exactly."""
+    rng = random.Random(3)
+    obs = [[rng.lognormvariate(-1, 1.6) for _ in range(n)]
+           for n in (200, 31, 77)]
+    closers = []
+    endpoints = []
+    try:
+        for i, values in enumerate(obs):
+            hs = HistogramSet()
+            for v in values:
+                hs.observe("job.latency", v)
+            path = str(tmp_path / f"r{i}.sock")
+            closers.append(_fake_replica(
+                path, hs,
+                {"serve.jobs.deadline_hit": 10 * (i + 1),
+                 "serve.jobs.deadline_miss": i},
+                # replicas export their OWN burn gauges (the live
+                # server does) — federation must replace them with the
+                # fleet tracker's, never duplicate the family
+                gauges={"slo.burn_rate": 0.5 * i,
+                        "slo.burn_rate_slow": 0.1,
+                        "slo.burn_alert": False}))
+            endpoints.append(path)
+        agg = FleetAggregator(endpoints)
+        snap = agg.poll()
+        assert snap.healthy
+        assert all(r.ok and not r.error for r in snap.replicas)
+        merged = snap.hists.get("racon_tpu_job_latency_seconds")
+        pooled = _fill([v for part in obs for v in part])
+        assert merged.count == pooled.count == sum(map(len, obs))
+        assert merged.counts == pooled.counts
+        assert (merged.min, merged.max) == (pooled.min, pooled.max)
+        for q in (0.5, 0.9, 0.95, 0.99, 1.0):
+            assert merged.quantile(q) == pooled.quantile(q), q
+        # counters summed across replicas
+        assert snap.counters[
+            "racon_tpu_serve_jobs_deadline_hit_total"] == 60
+        assert snap.counters[
+            "racon_tpu_serve_jobs_deadline_miss_total"] == 3
+        # federated HTTP endpoint: /metrics parses, /healthz is 200
+        port = agg.start_http(0)
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics") as resp:
+            fed_text = resp.read().decode()
+        # no duplicated metric family (a real Prometheus server
+        # rejects the whole body otherwise) — one TYPE line per name
+        type_lines = [ln for ln in fed_text.splitlines()
+                      if ln.startswith("# TYPE ")]
+        assert len(type_lines) == len(set(type_lines))
+        fed = prom.parse(fed_text)
+        assert fed.gauges["racon_tpu_fleet_replicas"] == 3
+        assert "racon_tpu_slo_burn_rate" in fed.gauges
+        refed = fed.histogram("racon_tpu_job_latency_seconds")
+        assert refed.counts == pooled.counts
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz") as resp:
+            body = json.loads(resp.read())
+        assert body["ok"] is True and len(body["replicas"]) == 3
+        # machine-readable snapshot
+        doc = agg.to_json()
+        assert doc["healthy"] is True
+        assert doc["latency"]["racon_tpu_job_latency_seconds"][
+            "count"] == pooled.count
+        agg.close()
+    finally:
+        for close in closers:
+            close()
+
+
+def test_fleet_unreachable_and_draining_replicas(tmp_path):
+    """healthz contract: ONE draining or unreachable replica makes the
+    fleet unhealthy, with per-replica detail saying which and why."""
+    hs = HistogramSet()
+    hs.observe("job.latency", 0.1)
+    up = str(tmp_path / "up.sock")
+    drn = str(tmp_path / "drn.sock")
+    closers = [_fake_replica(up, hs, {}),
+               _fake_replica(drn, hs, {}, draining=True)]
+    try:
+        agg = FleetAggregator([up, drn, str(tmp_path / "gone.sock")])
+        snap = agg.poll()
+        assert not snap.healthy
+        by_ep = {r.endpoint: r for r in snap.replicas}
+        assert by_ep[up].ok and not by_ep[up].draining
+        assert by_ep[drn].draining and not by_ep[drn].ok
+        assert by_ep[str(tmp_path / "gone.sock")].error
+        port = agg.start_http(0)
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(f"http://127.0.0.1:{port}/healthz")
+        assert exc.value.code == 503
+        detail = json.loads(exc.value.read())
+        assert detail["ok"] is False
+        agg.close()
+    finally:
+        for close in closers:
+            close()
+
+
+def test_endpoint_spellings():
+    assert Endpoint("/tmp/x.sock").kind == "unix"
+    assert Endpoint("127.0.0.1:7788").kind == "tcp"
+    assert Endpoint("http://127.0.0.1:9090/metrics").kind == "http"
+    assert Endpoint("http://127.0.0.1:9090/metrics").base == \
+        "http://127.0.0.1:9090"
+    with pytest.raises(ValueError):
+        Endpoint("not a port")
+
+
+# ---------------------------------------------------------------- burn rate
+def test_burn_rate_tracker_dual_window():
+    tr = BurnRateTracker(budget=0.01, fast_s=60, slow_s=600,
+                         threshold=2.0, seed_zero=True)
+    t0 = 1000.0
+    # healthy stream: hits only, never fires
+    for i in range(5):
+        res = tr.sample(hit=i + 1, miss=0, t=t0 + i)
+        assert res["fast"] == 0.0 and not res["firing"]
+    # miss flood: both windows blow the budget -> firing, once
+    res = tr.sample(hit=5, miss=5, t=t0 + 10)
+    assert res["firing"] and res["changed"]
+    assert res["fast"] >= 2.0 and res["slow"] >= 2.0
+    res = tr.sample(hit=5, miss=6, t=t0 + 11)
+    assert res["firing"] and not res["changed"]  # edge fired already
+    # recovery: a long quiet stretch ages the misses out of both
+    # windows -> one clear edge, then steady clear
+    res = tr.sample(hit=500, miss=6, t=t0 + 700)
+    assert not res["firing"] and res["changed"]
+    res = tr.sample(hit=1000, miss=6, t=t0 + 1400)
+    assert not res["firing"] and not res["changed"]
+
+
+def test_burn_rate_counter_reset_rebases():
+    """A summed-counter DECREASE (replica restart) rebases the sample
+    history instead of masking an ongoing breach with negative
+    deltas: continuing misses re-fire promptly."""
+    tr = BurnRateTracker(budget=0.01, fast_s=60, slow_s=600,
+                         threshold=2.0, seed_zero=True)
+    tr.sample(hit=10, miss=10, t=1000.0)
+    assert tr.firing
+    # a replica restarts: merged totals drop
+    res = tr.sample(hit=4, miss=4, t=1001.0)
+    assert not res["firing"]  # history rebased, honest unknown
+    # the flood continues on the rebased baseline -> fires again
+    res = tr.sample(hit=4, miss=8, t=1002.0)
+    assert res["firing"] and res["changed"]
+
+
+def test_burn_rate_single_window_does_not_fire():
+    """The dual-window property: a breach the slow window has already
+    absorbed (old misses, quiet since) must not page."""
+    tr = BurnRateTracker(budget=0.01, fast_s=10, slow_s=600,
+                         threshold=2.0, seed_zero=True)
+    tr.sample(hit=0, miss=5, t=1000.0)
+    # fast window sees only clean traffic now; slow still remembers
+    res = tr.sample(hit=300, miss=5, t=1300.0)
+    assert res["fast"] == 0.0
+    assert not res["firing"]
+
+
+# ----------------------------------------------------- live-server contracts
+@pytest.fixture(scope="module")
+def fleet_dataset(tmp_path_factory):
+    return make_synth_dataset(
+        str(tmp_path_factory.mktemp("fleet_data")))
+
+
+def test_healthz_draining_both_transports(fleet_dataset, tmp_path):
+    """Satellite pin: a draining replica answers `ok: false` on the
+    RPC and 503 `{"draining": true}` on HTTP, so load balancers stop
+    routing to it."""
+    from racon_tpu.serve.client import PolishClient
+
+    sock = str(tmp_path / "hz.sock")
+    srv = PolishServer(socket_path=sock, warmup=False,
+                       metrics_port=0).start()
+    try:
+        cl = PolishClient(socket_path=sock)
+        port = srv.config.metrics_port
+        hz = cl.healthz()
+        assert hz["ok"] is True and hz["draining"] is False
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz") as resp:
+            assert resp.status == 200
+            assert json.loads(resp.read())["ok"] is True
+        # flip the drain flag (the exact bit graceful drain sets first)
+        srv._draining.set()
+        hz = cl.healthz()
+        assert hz["ok"] is False and hz["draining"] is True
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(f"http://127.0.0.1:{port}/healthz")
+        assert exc.value.code == 503
+        body = json.loads(exc.value.read())
+        assert body["draining"] is True and body["ok"] is False
+    finally:
+        srv._draining.clear()
+        srv.drain(timeout=10)
+
+
+def test_deadline_miss_flood_trips_alert_and_exemplar(fleet_dataset,
+                                                     tmp_path):
+    """Acceptance pin: a deadline-miss flood trips the burn-rate alert
+    (journal `alert` event + gauge flip) and the latency exemplar
+    names the flight dump of an actually-missed job."""
+    from racon_tpu.obs.journal import read_journal
+    from racon_tpu.serve.client import PolishClient
+
+    sock = str(tmp_path / "burn.sock")
+    journal = str(tmp_path / "burn_journal.jsonl")
+    flight_dir = str(tmp_path / "flight")
+    srv = PolishServer(socket_path=sock, warmup=False, journal=journal,
+                       flight_dir=flight_dir, workers=3).start()
+    try:
+        cl = PolishClient(socket_path=sock)
+        # every job pops instantly (3 idle workers) but the held
+        # feeder pins its service time past the deadline ->
+        # deadline_miss for all three, deterministically (the same
+        # hold()/release() seam the preemption tests use)
+        srv.batcher.hold()
+        errs = []
+
+        def flood(i):
+            try:
+                cl.submit(*fleet_dataset, deadline_s=0.1,
+                          trace_id=f"flood-{i}")
+            except Exception as exc:  # noqa: BLE001
+                errs.append(exc)
+
+        threads = [threading.Thread(target=flood, args=(i,))
+                   for i in range(3)]
+        for t in threads:
+            t.start()
+        time.sleep(0.4)  # all popped, all deadlines now past
+        srv.batcher.release()
+        for t in threads:
+            t.join()
+        assert not errs, errs
+        text = cl.scrape()
+        s = prom.parse(text)
+        assert s.counters[
+            "racon_tpu_serve_jobs_deadline_miss_total"] == 3
+        assert s.gauges["racon_tpu_slo_burn_alert"] == 1
+        assert s.gauges["racon_tpu_slo_burn_rate"] >= \
+            srv.burn.threshold
+        # typed alert in the journal, carrying the tripping job's id
+        alerts = [e for e in read_journal(journal)
+                  if e.get("event") == "alert"]
+        assert alerts and alerts[0]["state"] == "firing"
+        assert alerts[0]["kind"] == "slo-burn"
+        assert alerts[0].get("job")
+        # the p99 bucket's exemplar names a real missed job's dump
+        h = s.histogram("racon_tpu_job_latency_seconds")
+        p99 = h.quantile(0.99)
+        ex = [e for le, e in h.bucket_exemplars().items()
+              if le >= p99 and "flight" in e]
+        assert ex, "no exemplar at/above the p99 bucket"
+        assert os.path.isfile(ex[-1]["flight"])
+        assert "deadline-miss" in ex[-1]["flight"]
+        assert ex[-1]["trace_id"].startswith("flood-")
+        with open(ex[-1]["flight"]) as fh:
+            dump = json.load(fh)
+        assert dump["flight"]["reason"] == "deadline-miss"
+    finally:
+        srv.drain(timeout=10)
+
+
+def test_exemplars_disabled_keeps_scrape_clean(fleet_dataset, tmp_path,
+                                               monkeypatch):
+    """RACON_TPU_SERVE_EXEMPLARS=0: the A/B knob removes every
+    exemplar from the exposition (the disabled half of the overhead
+    acceptance)."""
+    from racon_tpu.serve.client import PolishClient
+
+    monkeypatch.setenv("RACON_TPU_SERVE_EXEMPLARS", "0")
+    sock = str(tmp_path / "noex.sock")
+    srv = PolishServer(socket_path=sock, warmup=False).start()
+    try:
+        cl = PolishClient(socket_path=sock)
+        cl.submit(*fleet_dataset)
+        text = cl.scrape()
+        assert " # {" not in text
+        h = prom.parse(text).histogram("racon_tpu_job_latency_seconds")
+        assert h.count >= 1 and not h.bucket_exemplars()
+    finally:
+        srv.drain(timeout=10)
+
+
+def test_tenant_and_autotune_series_in_scrape(tmp_path):
+    """Satellite pin: per-tenant queue-depth/credit gauges and
+    autotuner consult counters ride the scrape as labeled series."""
+    from racon_tpu.sched.autotune import (get_autotuner,
+                                          reset_autotuner_cache)
+    from racon_tpu.serve.queue import Job
+
+    os.environ["RACON_TPU_AUTOTUNE_CACHE"] = str(
+        tmp_path / "autotune.json")
+    reset_autotuner_cache()
+    try:
+        at = get_autotuner()
+        at.record("aligner", (128, 64), (), {"kernel": "pallas",
+                                             "dtype": "int16"})
+        assert at.winner("aligner", (128, 64)) is not None
+        at.winner("session", (64, 100))  # cold consult
+        srv = PolishServer(socket_path=str(tmp_path / "t.sock"),
+                           warmup=False, tenant_quota=0)
+        for i, tenant in enumerate(("gold", "gold", "free")):
+            srv.queue.submit(Job(f"j{i}", "s", "o", "t", {},
+                                 tenant=tenant))
+        s = prom.parse(srv.prometheus_text())
+        depths = {lbl["tenant"]: v for _, (lbl, v) in s.gauge_series[
+            "racon_tpu_serve_tenant_queue_depth"].items()}
+        assert depths == {"gold": 2.0, "free": 1.0}
+        assert "racon_tpu_serve_tenant_credit" in s.gauge_series
+        consults = {(lbl["engine"], lbl["decision"]): v
+                    for _, (lbl, v) in s.counter_series[
+                        "racon_tpu_sched_autotune_consults_total"
+                    ].items()}
+        assert consults[("aligner", "pallas")] >= 1
+        assert consults[("session", "none")] >= 1
+    finally:
+        del os.environ["RACON_TPU_AUTOTUNE_CACHE"]
+        reset_autotuner_cache()
+
+
+def test_servetop_once_renders_fleet(fleet_dataset, tmp_path, capsys):
+    """servetop --once against a live replica: the non-TTY one-shot
+    screen carries the fleet line, the replica row and exit 0."""
+    import servetop
+
+    from racon_tpu.serve.client import PolishClient
+
+    sock = str(tmp_path / "top.sock")
+    srv = PolishServer(socket_path=sock, warmup=False).start()
+    try:
+        # a completed tenant-tagged job, so the tenant table and the
+        # completed counters actually render (a bare server hid a
+        # first-sample KeyError in the tenant rows once)
+        PolishClient(socket_path=sock).submit(*fleet_dataset,
+                                              tenant="gold")
+        rc = servetop.main(["--once", "--endpoints", sock])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "fleet" in out and sock in out
+        assert "queue" in out
+        assert "gold" in out  # tenant table rendered
+    finally:
+        srv.drain(timeout=10)
+
+
+# ----------------------------------------------------- obsreport + perfgate
+def test_obsreport_check_tolerates_alert_and_unknown_events(tmp_path,
+                                                            capsys):
+    """Satellite pin: `alert` (and any unknown) journal event types
+    must not fail `--check`; alerts render in the job timeline; a
+    quota-rejected job is a consistent terminal state."""
+    import obsreport
+
+    t = time.time()
+    entries = [
+        {"t": t, "event": "received", "job": "j1", "trace": "tr"},
+        {"t": t, "event": "admitted", "job": "j1"},
+        {"t": t + 0.1, "event": "started", "job": "j1"},
+        {"t": t + 0.4, "event": "part-streamed", "job": "j1",
+         "contig": "c", "part": 1, "bytes": 10},
+        {"t": t + 0.5, "event": "alert", "job": "j1",
+         "kind": "slo-burn", "state": "firing", "burn_fast": 40.0},
+        {"t": t + 0.5, "event": "deadline-miss", "job": "j1"},
+        {"t": t + 0.5, "event": "finished", "job": "j1",
+         "sequences": 1, "service_s": 0.4},
+        # a quota-rejected job: received + rejected-quota is complete
+        {"t": t + 1, "event": "received", "job": "j2"},
+        {"t": t + 1, "event": "rejected-quota", "job": "j2",
+         "retry_after": 0.5},
+        # an event type this tool has never heard of, on its own job
+        {"t": t + 2, "event": "frobnicated", "job": "j999"},
+        {"t": t + 2, "event": "alert", "kind": "slo-burn",
+         "state": "clear"},  # fleet-scope alert, no job id
+    ]
+    path = tmp_path / "journal.jsonl"
+    with open(path, "w") as fh:
+        for e in entries:
+            fh.write(json.dumps(e) + "\n")
+    assert check_consistency(entries) == []
+    rc = obsreport.main(["--journal", str(path), "--check",
+                         "--flight-dir", str(tmp_path / "none")])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "alert" in out and "slo-burn" in out  # rendered in timeline
+    assert "consistency: OK" in out
+
+
+def test_perfgate_fleet_scrape_overhead_gate(tmp_path):
+    """Satellite pin: perfgate gates fleet.scrape_overhead_pct at the
+    2% budget, and an explicit --scrape-overhead-max over an artifact
+    without the block exits 2 naming the dotted key."""
+    import perfgate
+
+    def artifact(**extra):
+        doc = {"mode": "serve",
+               "warm": {"seq_p50_s": 1.0, "p50_s": 1.2},
+               "cold": {"p50_s": 9.0}}
+        doc.update(extra)
+        return doc
+
+    ok = tmp_path / "ok.json"
+    ok.write_text(json.dumps(artifact(
+        fleet={"replicas": 3, "scrape_overhead_pct": 0.8})))
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps(artifact(
+        fleet={"replicas": 3, "scrape_overhead_pct": 4.5})))
+    plain = tmp_path / "plain.json"
+    plain.write_text(json.dumps(artifact()))
+    base = ["--ref-value", "1.0", "--tolerance-pct", "50"]
+    assert perfgate.main(["--artifact", str(ok)] + base) == 0
+    assert perfgate.main(["--artifact", str(bad)] + base) == 1
+    # explicit limit over a block-less artifact: broken gate, rc 2
+    assert perfgate.main(["--artifact", str(plain),
+                          "--scrape-overhead-max", "2.0"] + base) == 2
+    # tighter explicit limit is honored
+    assert perfgate.main(["--artifact", str(ok),
+                          "--scrape-overhead-max", "0.5"] + base) == 1

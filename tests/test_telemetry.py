@@ -144,7 +144,8 @@ def parse_prom(text: str) -> dict:
     [(full_name, labels_dict, value)]}}. Asserts line-level syntax."""
     families: dict = {}
     line_re = re.compile(
-        r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^}]*\})?\s+(\S+)$")
+        r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^}]*\})?\s+(\S+)"
+        r"(?:\s+#\s+\{[^}]*\}.*)?$")  # optional OpenMetrics exemplar
     cur = None
     for line in text.splitlines():
         if not line.strip():
@@ -395,9 +396,9 @@ def test_scrape_rpc_matches_http(dataset, tmp_path):
         fams_http = parse_prom(body)
         fams_rpc = parse_prom(cl.scrape())
         assert set(fams_http) == set(fams_rpc)
-        health = urllib.request.urlopen(f"{url}/healthz",
-                                        timeout=10).read()
-        assert health == b"ok\n"
+        health = json.loads(urllib.request.urlopen(
+            f"{url}/healthz", timeout=10).read())
+        assert health["ok"] is True and health["draining"] is False
         with pytest.raises(urllib.error.HTTPError):
             urllib.request.urlopen(f"{url}/nope", timeout=10)
         # the polish server is untouched by HTTP traffic

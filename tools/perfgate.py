@@ -27,6 +27,13 @@ Metric extraction understands both artifact shapes:
     RELATIVELY (tolerance-pct) against the `--against` reference
     whenever both artifacts carry the key.
 
+  - servebench `--fleet` artifacts additionally carry a `fleet` block:
+    `fleet.scrape_overhead_pct` — replica time spent answering the
+    aggregator's scrape+healthz polls as a percentage of the wave —
+    gates ABSOLUTELY at the established observability budget (default
+    2.0 whenever the block is present; `--scrape-overhead-max` makes
+    it mandatory, rc 2 naming the dotted key when absent).
+
   - synthbench `--json` artifacts (`"mode": "synth"`):
     `synth.windows_per_s`, HIGHER is better — gated ABSOLUTELY against
     `--windows-per-s-min` (the kernel-plane regression floor) and
@@ -149,6 +156,11 @@ def extract(doc: dict, path: str = "<artifact>") -> dict:
         miss = _lookup(inner, "slo.miss_rate")
         if miss is not None:
             out["slo_miss_rate"] = float(miss)
+        # fleet-mode observability overhead (servebench --fleet): the
+        # replicas' scrape-answering time as a % of the wave
+        overhead = _lookup(inner, "fleet.scrape_overhead_pct")
+        if overhead is not None:
+            out["scrape_overhead_pct"] = float(overhead)
         # latency-tail metrics (continuous-batching era): gated
         # absolutely via --p99-max / --ttfb-p50-max and relatively
         # against the --against reference when both artifacts carry them
@@ -423,6 +435,29 @@ def fused_checks(cand: dict, args,
     return [("fused.host_frac", cand["host_frac"], limit)]
 
 
+def fleet_checks(cand: dict, args,
+                 candidate_path: str) -> list[tuple[str, float, float]]:
+    """Scrape/exemplar overhead gate for servebench --fleet artifacts:
+    `fleet.scrape_overhead_pct` — the replicas' time answering the
+    aggregator as a percentage of the measured wave — gates ABSOLUTELY
+    at the established observability budget (<2%, the same bound the
+    flight recorder and journal were held to). Gated at the default
+    whenever the artifact carries the key (the slo.miss_rate
+    convention); `--scrape-overhead-max` makes it mandatory — an
+    artifact without the key then exits 2 naming it."""
+    explicit = args.scrape_overhead_max is not None
+    if "scrape_overhead_pct" not in cand:
+        if explicit:
+            raise GateError(
+                f"{candidate_path}: artifact lacks gated metric "
+                "'fleet.scrape_overhead_pct' (--scrape-overhead-max "
+                "gates servebench --fleet artifacts)")
+        return []
+    limit = args.scrape_overhead_max if explicit else 2.0
+    return [("fleet.scrape_overhead_pct", cand["scrape_overhead_pct"],
+             limit)]
+
+
 def wps_floor_check(cand: dict, args,
                     candidate_path: str) -> list[tuple[str, float, float]]:
     """Absolute windows/s floor (--windows-per-s-min): mandatory once
@@ -498,6 +533,12 @@ def run(args) -> int:
         print(f"[perfgate] {'PASS' if check_ok else 'FAIL'}: "
               f"{os.path.basename(candidate_path)} {name} = {value:g} "
               f"(limit {limit:g})", file=sys.stderr)
+    for name, value, limit in fleet_checks(cand, args, candidate_path):
+        check_ok = value <= limit
+        failures += 0 if check_ok else 1
+        print(f"[perfgate] {'PASS' if check_ok else 'FAIL'}: "
+              f"{os.path.basename(candidate_path)} {name} = {value:g}% "
+              f"(limit {limit:g}%)", file=sys.stderr)
     for name, value, limit in slo_checks(doc, cand, args,
                                          candidate_path):
         check_ok = value <= limit
@@ -574,6 +615,15 @@ def main(argv=None) -> int:
                          "time-to-first-byte p50 (warm.ttfb_p50_s); "
                          "same mandatory/relative semantics as "
                          "--p99-max")
+    ap.add_argument("--scrape-overhead-max", type=float, default=None,
+                    help="absolute bound in PERCENT on the fleet "
+                         "observability overhead "
+                         "(fleet.scrape_overhead_pct, servebench "
+                         "--fleet artifacts; default: gate at 2.0 "
+                         "whenever the artifact carries the key; "
+                         "passing a value makes the gate mandatory — "
+                         "an artifact without it then exits 2 naming "
+                         "the dotted key)")
     ap.add_argument("--scale-balance-max", type=float, default=None,
                     help="per-shard useful-cell balance bound (max/min) "
                          "for synthbench --scale-curve artifacts "

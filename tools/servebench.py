@@ -31,6 +31,13 @@ fields alongside the serve numbers (the same field names bench.py
 publishes; tools/perfgate.py gates warm p50, p99, ttfb_p50 and
 slo.miss_rate from it).
 
+FLEET MODE (`--fleet N`): run N in-process server replicas, round-robin
+the warm wave across them, and let the fleet aggregator (obs/fleet.py)
+poll every replica's scrape+healthz MID-WAVE. The artifact gains a
+`fleet` block — aggregator lag (poll wall) percentiles and the
+scrape-overhead percentage — which tools/perfgate.py gates at the
+established <2% observability budget.
+
 OPEN-LOOP ARRIVAL MODE (`--qps`, optionally a `--qps-curve` sweep):
 instead of firing the whole wave at once (closed-loop, back-pressure
 hides the queueing), jobs arrive by a Poisson process at the target
@@ -112,6 +119,47 @@ def simulate_contig(rng, genome_len, coverage, read_len):
     from synthbench import simulate
 
     return simulate(rng, genome_len, coverage, read_len, 0.12, 0.10)
+
+
+def merge_fleet_snaps(snaps: list[dict]) -> dict:
+    """Aggregate N replicas' stats snapshots into one artifact view:
+    queue/SLO counters SUM (the gated slo.miss_rate must see every
+    replica's deadlines, not replica 0's), batcher activity counters
+    sum, high-water marks take the max, and lane rows concatenate
+    tagged with their replica. Non-additive detail (occupancy,
+    latency percentiles, tenants) stays replica 0's."""
+    if len(snaps) == 1:
+        return snaps[0]
+    out = json.loads(json.dumps(snaps[0]))  # deep copy, JSON-shaped
+    q, b, slo = out["queue"], out["batcher"], out["slo"]
+    q_sum = ("submitted", "admitted", "rejected_full",
+             "rejected_draining", "rejected_quota", "expired",
+             "completed", "failed", "deadline_hit", "deadline_miss",
+             "depth")
+    b_sum = ("iterations", "shared_iterations", "solo_iterations",
+             "jobs", "windows", "host_s", "compiles", "compile_s")
+    for i, lane in enumerate(b.get("lanes") or []):
+        lane["replica"] = 0
+    for r, s in enumerate(snaps[1:], start=1):
+        for k in q_sum:
+            if k in s["queue"]:
+                q[k] = q.get(k, 0) + s["queue"][k]
+        for k in ("deadline_hit", "deadline_miss", "expired"):
+            slo[k] += s["slo"][k]
+        sb = s["batcher"]
+        for k in b_sum:
+            if k in sb:
+                b[k] = b.get(k, 0) + sb[k]
+        for k, v in sb.items():
+            if k.startswith("max_"):
+                b[k] = max(b.get(k, 0), v)
+        b["lanes"] = (b.get("lanes") or []) + [
+            dict(lane, replica=r) for lane in (sb.get("lanes") or [])]
+        out["inflight"] += s.get("inflight", 0)
+    deadlined = slo["deadline_hit"] + slo["deadline_miss"]
+    slo["miss_rate"] = (round(slo["deadline_miss"] / deadlined, 4)
+                        if deadlined else 0.0)
+    return out
 
 
 def _mesh_block(batcher_snap: dict) -> dict:
@@ -356,6 +404,17 @@ def main(argv=None) -> int:
                          ">= 2)")
     ap.add_argument("--json", default=None,
                     help="write the bench-style JSON artifact here")
+    ap.add_argument("--fleet", type=int, default=None,
+                    help="fleet mode: run this many in-process server "
+                         "replicas, round-robin the warm submissions "
+                         "across them, and poll the fleet aggregator "
+                         "(obs/fleet.py) mid-wave — the artifact gains "
+                         "a `fleet` block with aggregator-lag and "
+                         "scrape-overhead columns that "
+                         "tools/perfgate.py gates at the <2% budget")
+    ap.add_argument("--fleet-poll-s", type=float, default=0.25,
+                    help="fleet mode: aggregator poll interval during "
+                         "the wave (default 0.25s)")
     ap.add_argument("--qps", type=float, default=None,
                     help="open-loop arrival mode: Poisson arrivals at "
                          "this rate (jobs/s) instead of an all-at-once "
@@ -428,27 +487,34 @@ def main(argv=None) -> int:
         # journal rides the measured run (its <2% overhead is part of
         # the warm numbers, not hidden from them) and is consistency-
         # checked after drain as part of the gate
-        sock = os.path.join(tmp, "serve.sock")
-        journal_path = os.path.join(tmp, "journal.jsonl")
+        n_replicas = max(1, args.fleet or 1)
         server_kw = {}
         if args.iteration_windows is not None:
             server_kw["iteration_windows"] = args.iteration_windows
         if args.worker_lanes is not None:
             server_kw["worker_lanes"] = args.worker_lanes
-        server = PolishServer(
-            socket_path=sock, workers=args.workers, warmup=False,
-            job_threads=args.threads, journal=journal_path,
-            tpu_poa_batches=args.tpupoa_batches,
-            tpu_aligner_batches=args.tpualigner_batches, **server_kw)
+        servers, clients, journal_paths = [], [], []
         t0 = time.perf_counter()
-        server.warmup(paths=paths)  # warm on the SAME shapes jobs use
-        server.start()
+        for k in range(n_replicas):
+            sock = os.path.join(tmp, f"serve{k}.sock")
+            journal_path = os.path.join(tmp, f"journal{k}.jsonl")
+            journal_paths.append(journal_path)
+            srv = PolishServer(
+                socket_path=sock, workers=args.workers, warmup=False,
+                job_threads=args.threads, journal=journal_path,
+                tpu_poa_batches=args.tpupoa_batches,
+                tpu_aligner_batches=args.tpualigner_batches,
+                **server_kw)
+            srv.warmup(paths=paths)  # warm on the SAME shapes jobs use
+            srv.start()
+            servers.append(srv)
+            clients.append(PolishClient(socket_path=sock))
+        server, client = servers[0], clients[0]
         warm_ready_s = time.perf_counter() - t0
-        print(f"[servebench] server warm in {warm_ready_s:.2f}s "
+        print(f"[servebench] {n_replicas} server(s) warm in "
+              f"{warm_ready_s:.2f}s "
               f"({server._warm['compiles']} compiles "
               f"{server._warm['compile_s']:.2f}s)", file=sys.stderr)
-
-        client = PolishClient(socket_path=sock)
 
         # ---- warm sequential: like-for-like vs the cold runs
         seq_s: list[float] = []
@@ -481,19 +547,59 @@ def main(argv=None) -> int:
                 if first_byte[_i] is None:
                     first_byte[_i] = time.perf_counter() - _t
 
-            results[i] = client.submit(*paths, retries=5,
-                                       on_progress=on_progress,
-                                       on_part=on_part)
+            results[i] = clients[i % n_replicas].submit(
+                *paths, retries=5, on_progress=on_progress,
+                on_part=on_part)
             latencies[i] = time.perf_counter() - t
+
+        # ---- fleet mode: the aggregator polls every replica's scrape
+        # + healthz MID-WAVE (the overhead must be measured under
+        # load, not on an idle server); each poll records its own
+        # wall (aggregator lag) and the per-replica scrape times
+        fleet_polls: list[dict] = []
+        agg = None
+        stop_polling = threading.Event()
+
+        def poll_fleet():
+            while not stop_polling.is_set():
+                try:
+                    snap = agg.poll()
+                    fleet_polls.append(
+                        {"poll_s": snap.poll_s,
+                         "healthy": snap.healthy,
+                         "scrape_s": sum(r.scrape_s
+                                         for r in snap.replicas)})
+                except Exception as exc:  # noqa: BLE001
+                    fleet_polls.append({"error": str(exc)})
+                stop_polling.wait(args.fleet_poll_s)
+
+        poller = None
+        if args.fleet:
+            from racon_tpu.obs.fleet import FleetAggregator
+
+            agg = FleetAggregator([s.config.socket_path
+                                   for s in servers])
+            poller = threading.Thread(target=poll_fleet, daemon=True)
 
         threads = [threading.Thread(target=submit, args=(i,))
                    for i in range(args.jobs)]
+        # replica-side scrape cost baseline: the servers self-meter
+        # their exposition-render seconds (wire and aggregator-side
+        # parse time are the aggregator's cost, not the replicas')
+        scrape_render_pre = sum(s._scrape_render_s for s in servers)
         t_wave = time.perf_counter()
+        if poller is not None:
+            poller.start()
         for t in threads:
             t.start()
         for t in threads:
             t.join()
         wave_s = time.perf_counter() - t_wave
+        if poller is not None:
+            stop_polling.set()
+            poller.join(timeout=5)
+        scrape_render_s = (sum(s._scrape_render_s for s in servers)
+                           - scrape_render_pre)
 
         # ---- open-loop arrival sweep (--qps): Poisson arrivals on the
         # SAME warm server — the saturation-knee curve
@@ -516,20 +622,29 @@ def main(argv=None) -> int:
                       f"achieved {pt['achieved_qps']:g}/{rate:g}",
                       file=sys.stderr)
 
-        snap = server.stats_snapshot()
-        server.drain(timeout=30)
+        # every replica's numbers reach the artifact: the gated SLO
+        # counters and batcher activity aggregate across the fleet
+        snap = merge_fleet_snaps([s.stats_snapshot() for s in servers])
+        for srv in servers:
+            srv.drain(timeout=30)
 
         # ---- journal consistency: every journaled job reaches exactly
-        # one terminal state, started/terminal pairs balance
+        # one terminal state, started/terminal pairs balance — per
+        # replica journal (job ids restart per server, so the files
+        # must be checked separately, not concatenated)
         from obsreport import check_parts_streamed
         from racon_tpu.obs.journal import check_consistency, read_journal
 
-        journal_entries = read_journal(journal_path)
-        # lifecycle invariants PLUS the streamed-results receipt (one
-        # part-streamed line per output contig) — the same pair
-        # obsreport --check enforces
-        journal_problems = (check_consistency(journal_entries)
-                            + check_parts_streamed(journal_entries))
+        journal_entries = []
+        journal_problems = []
+        for jp in journal_paths:
+            entries = read_journal(jp)
+            journal_entries += entries
+            # lifecycle invariants PLUS the streamed-results receipt
+            # (one part-streamed line per output contig) — the same
+            # pair obsreport --check enforces
+            journal_problems += (check_consistency(entries)
+                                 + check_parts_streamed(entries))
 
     # ---- analysis
     from racon_tpu.serve.queue import nearest_rank
@@ -568,6 +683,40 @@ def main(argv=None) -> int:
     ttfb_p50 = nearest_rank(sorted(ttfb), 0.50) if ttfb else None
     for p in journal_problems:
         fail.append(f"journal inconsistency: {p}")
+    # ---- fleet columns: aggregator lag (one poll's scrape+parse+merge
+    # wall) and scrape overhead (replica time spent answering the
+    # aggregator as a fraction of the wave — the <2% budget perfgate
+    # holds the observability plane to)
+    fleet_block = None
+    if args.fleet:
+        good = [p for p in fleet_polls if "poll_s" in p]
+        poll_errors = [p["error"] for p in fleet_polls if "error" in p]
+        if not good:
+            fail.append("fleet aggregator never completed a poll "
+                        f"mid-wave ({poll_errors[:3]})")
+        else:
+            lags = sorted(p["poll_s"] for p in good)
+            # overhead = the replicas' OWN exposition-render seconds
+            # (self-metered) over the replica-seconds of wave wall —
+            # what answering the aggregator actually cost the fleet
+            overhead_pct = (scrape_render_s / max(wave_s, 1e-9)
+                            / n_replicas * 100.0)
+            unhealthy = sum(1 for p in good if not p["healthy"])
+            fleet_block = {
+                "replicas": n_replicas,
+                "polls": len(good),
+                "poll_errors": len(poll_errors),
+                "agg_lag_p50_s": round(nearest_rank(lags, 0.50), 5),
+                "agg_lag_max_s": round(lags[-1], 5),
+                "scrape_render_s": round(scrape_render_s, 4),
+                "scrape_overhead_pct": round(overhead_pct, 3),
+                "unhealthy_polls": unhealthy,
+            }
+            if unhealthy or poll_errors:
+                fail.append(
+                    f"fleet aggregator saw {unhealthy} unhealthy and "
+                    f"{len(poll_errors)} failed polls mid-wave — every "
+                    "replica must answer scrape+healthz under load")
     baseline = None
     if args.baseline:
         try:
@@ -621,6 +770,15 @@ def main(argv=None) -> int:
                  f"{baseline['ttfb_p50_s']:.2f}s"
                  if cand_ttfb and baseline.get("ttfb_p50_s")
                  else ""), file=sys.stderr)
+    if fleet_block:
+        print(f"[servebench] fleet: {n_replicas} replicas, "
+              f"{fleet_block['polls']} aggregator polls mid-wave — "
+              f"lag p50 {fleet_block['agg_lag_p50_s'] * 1e3:.1f}ms "
+              f"max {fleet_block['agg_lag_max_s'] * 1e3:.1f}ms, "
+              f"scrape overhead "
+              f"{fleet_block['scrape_overhead_pct']:.2f}% "
+              f"[{'OK' if fleet_block['scrape_overhead_pct'] < 2.0 else 'FAIL'} "
+              "budget 2%]", file=sys.stderr)
     n_journal_jobs = len({e.get('job') for e in journal_entries
                           if e.get('job')})
     print(f"[servebench] journal: {len(journal_entries)} events / "
@@ -642,7 +800,14 @@ def main(argv=None) -> int:
               f"{b['host_s'] / shared_its * 1e3:.1f}ms per feeder "
               "iteration", file=sys.stderr)
     lanes = b.get("lanes") or []
-    if len(lanes) > 1:
+    # fleet mode concatenates per-replica lane rows: the multi-lane
+    # overlap gate applies only when some single replica actually
+    # partitioned its mesh (N single-lane replicas are not "2 lanes")
+    lanes_per_replica: dict = {}
+    for ln in lanes:
+        rep = ln.get("replica", 0)
+        lanes_per_replica[rep] = lanes_per_replica.get(rep, 0) + 1
+    if max(lanes_per_replica.values(), default=0) > 1:
         per_lane = ", ".join(
             f"lane {ln['lane']} ({ln['n_devices']} dev): "
             f"{ln['iterations']} its / {ln['busy_s']:.2f}s busy"
@@ -661,8 +826,10 @@ def main(argv=None) -> int:
         # pinning a 1-device mesh): the promised overlap gate cannot
         # run — that must FAIL loudly, not silently pass
         fail.append(f"--worker-lanes {args.worker_lanes} requested but "
-                    f"the server ran {max(len(lanes), 1)} lane(s) — "
-                    "the device mesh was too small to partition")
+                    f"the server ran "
+                    f"{max(lanes_per_replica.values(), default=1)} "
+                    "lane(s) — the device mesh was too small to "
+                    "partition")
     for engine, e in (b.get("occupancy") or {}).items():
         if e.get("buckets"):
             print(f"[servebench] {engine} occupancy "
@@ -713,6 +880,8 @@ def main(argv=None) -> int:
                                     if k != "occupancy"}},
             "pass": not fail,
         }
+        if fleet_block:
+            artifact["fleet"] = fleet_block
         if openloop:
             artifact["openloop"] = {"curve": openloop,
                                     "jobs_per_rate": args.qps_jobs,

@@ -1,0 +1,228 @@
+"""servetop: a live operator console over one or many serve replicas.
+
+`top` for the polishing fleet: polls every replica endpoint (the same
+spellings the fleet aggregator takes — unix socket / host:port RPC /
+http:// metrics base, default RACON_TPU_FLEET_ENDPOINTS), merges the
+scrapes through obs/fleet.py, and redraws one screen per poll:
+
+  - the FLEET line: queue depth vs capacity, in-flight jobs, lifetime
+    completed/failed, SLO hits/misses with the live burn-rate (fast/
+    slow window multiples of budget, [FIRING] when the dual-window
+    alert is up), device iterations with the fleet-wide rate;
+  - one ROW PER REPLICA: reachability, draining flag, queue/in-flight,
+    iteration rate since the last poll, busy worker lanes, compiles
+    (compile activity after warmup is the "something is recompiling"
+    smell), scrape round-trip;
+  - PER-TENANT rows: live queued jobs and accrued DRR credit (the
+    fairness dial) from the labeled scrape series;
+  - AUTOTUNER activity: winner-table consult counts by (engine,
+    decision, dtype) — which kernel plane the fleet is actually
+    dispatching.
+
+On a TTY the screen redraws in place; on a pipe it degrades to one
+summary line per poll (greppable, CI-friendly). `--once` polls once
+and exits — the smoke-test shape.
+
+    python tools/servetop.py --endpoints /tmp/a.sock,127.0.0.1:7788
+    python tools/servetop.py --once   # RACON_TPU_FLEET_ENDPOINTS
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+G = "racon_tpu_serve_"
+
+
+def _g(parsed, name, default=0.0):
+    return (parsed.gauges if parsed else {}).get(name, default)
+
+
+def _c(parsed, name, default=0.0):
+    return (parsed.counters if parsed else {}).get(name, default)
+
+
+def _series(parsed, name) -> dict:
+    """{labels_dict_key_value: value} for one labeled family."""
+    if parsed is None:
+        return {}
+    series = dict(parsed.gauge_series.get(name, {}))
+    series.update(parsed.counter_series.get(name, {}))
+    return series
+
+
+def replica_row(rs, prev: dict, dt: float) -> dict:
+    """One replica's console row, with rates from the previous poll."""
+    p = rs.parsed
+    iters = _c(p, G + "batch_iterations_total")
+    rate = ((iters - prev.get("iterations", iters)) / dt
+            if dt > 0 else 0.0)
+    lanes_busy = lanes_total = 0
+    if p is not None:
+        for name, v in p.gauges.items():
+            if name.startswith(G + "lane_") and name.endswith("_busy"):
+                lanes_total += 1
+                lanes_busy += int(v)
+        if not lanes_total:
+            lanes_total = int(_g(p, G + "worker_lanes", 1))
+    return {"endpoint": rs.endpoint, "ok": rs.ok,
+            "draining": rs.draining, "error": rs.error,
+            "queue": int(_g(p, G + "queue_depth")),
+            "inflight": int(_g(p, G + "inflight")),
+            "iterations": iters, "iter_rate": rate,
+            "lanes_busy": lanes_busy, "lanes": lanes_total,
+            "compiles": int(_c(p, G + "compiles_total")),
+            "scrape_ms": rs.scrape_s * 1e3}
+
+
+def tenant_rows(snap) -> list[dict]:
+    """Merged per-tenant queued/credit across the fleet."""
+    tenants: dict[str, dict] = {}
+    for name, key in ((G + "tenant_queue_depth", "queued"),
+                      (G + "tenant_credit", "credit")):
+        for labels, v in snap.gauge_series.get(name, {}).values():
+            t = labels.get("tenant", "")
+            row = tenants.setdefault(t, {"queued": 0, "credit": 0.0})
+            row[key] = row.get(key, 0) + v
+    return [dict(row, tenant=t or "<anon>")
+            for t, row in sorted(tenants.items())]
+
+
+def autotune_rows(snap) -> list[tuple[str, int]]:
+    out = []
+    for labels, v in snap.counter_series.get(
+            "racon_tpu_sched_autotune_consults_total", {}).values():
+        tag = "/".join(x for x in (labels.get("engine", "?"),
+                                   labels.get("decision", "?"),
+                                   labels.get("dtype", "")) if x)
+        out.append((tag, int(v)))
+    return sorted(out)
+
+
+def fleet_line(snap, burn: dict, prev: dict, dt: float) -> str:
+    iters = snap.counters.get(G + "batch_iterations_total", 0)
+    rate = ((iters - prev.get("iterations", iters)) / dt
+            if dt > 0 else 0.0)
+    hit = int(snap.counters.get(G + "jobs_deadline_hit_total", 0))
+    miss = int(snap.counters.get(G + "jobs_deadline_miss_total", 0))
+    return (f"fleet  queue {int(snap.gauges.get(G + 'queue_depth', 0))}"
+            f"/{int(snap.gauges.get(G + 'queue_capacity', 0))}"
+            f"  inflight {int(snap.gauges.get(G + 'inflight', 0))}"
+            f"  completed {int(snap.counters.get(G + 'jobs_completed_total', 0))}"
+            f" ({int(snap.counters.get(G + 'jobs_failed_total', 0))} failed)"
+            f"  slo {hit}+/{miss}-"
+            f"  burn {burn.get('fast', 0):g}x/{burn.get('slow', 0):g}x"
+            f"{' [FIRING]' if burn.get('firing') else ''}"
+            f"  iters {int(iters)} ({rate:.1f}/s)"
+            f"  compiles {int(snap.counters.get(G + 'compiles_total', 0))}")
+
+
+def render_screen(snap, burn: dict, rows: list[dict], prev: dict,
+                  dt: float) -> str:
+    up = sum(1 for r in snap.replicas if r.ok)
+    lines = [f"racon-tpu servetop — {len(snap.replicas)} replica(s), "
+             f"{up} up · {time.strftime('%H:%M:%S')} · poll "
+             f"{snap.poll_s * 1e3:.0f}ms",
+             fleet_line(snap, burn, prev, dt), ""]
+    lines.append(f"{'replica':<36} {'up':>2} {'drn':>3} {'queue':>5} "
+                 f"{'infl':>4} {'it/s':>6} {'lanes':>5} {'cmpl':>4} "
+                 f"{'ms':>5}")
+    for row in rows:
+        if row["error"]:
+            lines.append(f"{row['endpoint']:<36}  -  DOWN  "
+                         f"{row['error']}")
+            continue
+        lines.append(
+            f"{row['endpoint']:<36} {'y' if row['ok'] else 'n':>2} "
+            f"{'y' if row['draining'] else '-':>3} "
+            f"{row['queue']:>5} {row['inflight']:>4} "
+            f"{row['iter_rate']:>6.1f} "
+            f"{row['lanes_busy']}/{row['lanes']:<3} "
+            f"{row['compiles']:>4} {row['scrape_ms']:>5.1f}")
+    tenants = tenant_rows(snap)
+    if tenants:
+        lines.append("")
+        lines.append(f"{'tenant':<20} {'queued':>6} {'credit':>8}")
+        for t in tenants:
+            lines.append(f"{t['tenant']:<20} {int(t['queued']):>6} "
+                         f"{t['credit']:>8.2f}")
+    tunes = autotune_rows(snap)
+    if tunes:
+        lines.append("")
+        lines.append("autotune  " + "  ".join(
+            f"{tag}={n}" for tag, n in tunes))
+    return "\n".join(lines)
+
+
+def render_line(snap, burn: dict, prev: dict, dt: float) -> str:
+    """The one-line-per-poll pipe mode."""
+    up = sum(1 for r in snap.replicas if r.ok)
+    return (f"[servetop] up={up}/{len(snap.replicas)} "
+            + fleet_line(snap, burn, prev, dt))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="live serve-fleet console (see module docstring)")
+    ap.add_argument("--endpoints", default=None,
+                    help="comma-separated replica endpoints (default: "
+                         "RACON_TPU_FLEET_ENDPOINTS)")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="poll interval seconds (default 2)")
+    ap.add_argument("--timeout", type=float, default=2.0,
+                    help="per-replica scrape timeout seconds")
+    ap.add_argument("--once", action="store_true",
+                    help="poll once, print, exit (0 = all replicas "
+                         "healthy)")
+    ap.add_argument("--no-tty", action="store_true",
+                    help="force the one-line-per-poll pipe mode")
+    args = ap.parse_args(argv)
+
+    from racon_tpu.obs.fleet import FleetAggregator
+
+    endpoints = ([e.strip() for e in args.endpoints.split(",")
+                  if e.strip()] if args.endpoints else None)
+    try:
+        agg = FleetAggregator(endpoints, timeout_s=args.timeout)
+    except ValueError as exc:
+        print(f"[servetop] error: {exc}", file=sys.stderr)
+        return 2
+
+    tty = sys.stdout.isatty() and not args.no_tty and not args.once
+    prev: dict = {}
+    prev_rows: dict = {}
+    t_prev = None
+    try:
+        while True:
+            snap = agg.poll()
+            now = time.monotonic()
+            dt = (now - t_prev) if t_prev is not None else 0.0
+            t_prev = now
+            burn = agg.burn.state()
+            rows = [replica_row(r, prev_rows.get(r.endpoint, {}), dt)
+                    for r in snap.replicas]
+            if tty:
+                sys.stdout.write("\x1b[H\x1b[2J")
+                print(render_screen(snap, burn, rows, prev, dt))
+            elif args.once:
+                print(render_screen(snap, burn, rows, prev, dt))
+            else:
+                print(render_line(snap, burn, prev, dt), flush=True)
+            prev = {"iterations": snap.counters.get(
+                G + "batch_iterations_total", 0)}
+            prev_rows = {row["endpoint"]: row for row in rows}
+            if args.once:
+                return 0 if snap.healthy else 1
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
