@@ -1,0 +1,446 @@
+"""Online identity-audit sentinel: sampled shadow re-execution + SDC
+detection for the live serve plane.
+
+Every identity guarantee so far is a TEST-TIME artifact: byte-identity
+pins across kernels/dtypes/meshes run in CI, the autotuner's veto runs
+at profile time. Nothing checks identity WHILE SERVING — a chip that
+starts silently corrupting int16 Pallas scores, a stale winner-table
+entry, or one bad worker lane would ship wrong consensus bytes to every
+client undetected, because wrong-but-well-formed FASTA trips no error
+path. `WindowAuditor` closes that hole the way fleet-scale inference
+services do, with a continuous sampled audit:
+
+  - SAMPLING is content-keyed, not random: a window is audited iff the
+    first 8 bytes of the SHA-256 over its content (backbone + layers +
+    qualities + layer positions) fall under `rate` * 2^64. The decision
+    is a pure function of the window bytes — reproducible across
+    processes, replicas and reruns, test-pinnable, and un-gameable by
+    scheduling (no RNG, no per-process seed).
+  - SHADOW RE-EXECUTION runs the sampled windows through the ORACLE
+    path (ops/oracle.py: XLA, int32, unpacked operands, split-chain —
+    the same reference every identity pin and the profile-time veto
+    compare against) on its own engines with its own telemetry, off the
+    device hot path: the feeder audits AFTER releasing the lane's exec
+    lock, so other lanes and the device never wait on an audit.
+  - A MISMATCH is a confirmed silent-data-corruption event, and every
+    consequence fires inside the same iteration:
+      * the `racon_tpu_audit_mismatches{engine,kernel,dtype,bucket,
+        lane}` labeled counter increments;
+      * a flight artifact carrying BOTH byte streams (produced vs
+        oracle) lands in the flight-dump directory, and the
+        `audit.shadow` histogram's bucket exemplar names it — a fleet
+        dashboard's mismatch click-through;
+      * a typed `audit-mismatch` journal line lands in the owning job's
+        timeline (an annotation event: obsreport renders it, `--check`
+        ignores it);
+      * the persisted autotuner winner entries for the implicated
+        engine are ONLINE-DEMOTED to the oracle candidate (the same
+        veto semantics as profile time, atomic table rewrite — a stale
+        fast-but-wrong winner stops dispatching NOW and stays stopped
+        across restarts);
+      * the lane's health score drops and the lane is QUARANTINED
+        (serve/batcher.py): it drains, solo re-probes with the
+        known-good window (the mismatched content with its
+        oracle-verified bytes), and either rejoins or stays quarantined
+        — `racon_tpu_lane_health{lane}` is the scrape view;
+      * the production window is REPAIRED with the oracle bytes before
+        delivery, so the job's FASTA stays byte-identical to a clean
+        run — detection protects the caught output, not just the
+        dashboard;
+      * the `racon_tpu_audit_alert` gauge flips (and a typed `alert`
+        journal line fires); it stays up until an operator acknowledges
+        via the debug RPC's `audit_ack` (serve/client.py
+        `PolishClient.audit_ack()`).
+
+  Telemetry isolation: the oracle executor keeps its own
+  PipelineStats/OccupancyStats and never consults the winner table, so
+  shadow executions surface ONLY under the `audit.*` scrape namespace —
+  a sampled run's production `pipeline.*`/`sched.*` counters are
+  identical to an unsampled one's (test-pinned).
+
+Env knobs: RACON_TPU_AUDIT_RATE (sampled fraction, default 0 = off —
+and with it off every serve surface is byte-identical to the pre-audit
+code), RACON_TPU_AUDIT_DEMOTE (0 disables online demotion),
+RACON_TPU_LANE_QUARANTINE (0 disables lane quarantine/re-probe)."""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import struct
+import threading
+import time
+
+from ..utils.logger import log_info
+
+#: 2^64, the denominator of the content-hash sampling fraction
+_HASH_SPACE = float(1 << 64)
+
+
+def window_sample_fraction(w) -> float:
+    """The window's deterministic sample coordinate in [0, 1): the
+    first 8 bytes of SHA-256 over its full content. A window is audited
+    at rate R iff this fraction < R — so raising R only ADDS windows to
+    the audited set (the R=1.0 set contains every smaller set)."""
+    h = hashlib.sha256()
+    for seq, qual, (begin, end) in zip(w.sequences, w.qualities,
+                                       w.positions):
+        h.update(struct.pack("<Iii", len(seq), begin, end))
+        h.update(seq)
+        if qual:
+            h.update(qual)
+    return int.from_bytes(h.digest()[:8], "big") / _HASH_SPACE
+
+
+def _engine_label(p) -> str:
+    """Which consensus engine produced the audited bytes: 'host' (the
+    native C++ engine) or the device engine name."""
+    if not p.tpu_poa_batches:
+        return "host"
+    return (p.tpu_engine or os.environ.get("RACON_TPU_ENGINE")
+            or "session")
+
+
+#: autotuner engines implicated per production engine label — the set
+#: `demote()` sweeps on a mismatch. A host-engine mismatch implicates
+#: no device winner (there is nothing to demote, only a lane to blame).
+_DEMOTE_ENGINES = {"session": ("session",),
+                   "fused": ("fused_loop", "fused", "session")}
+
+#: the polisher attributes the lane re-probe needs (the batcher's
+#: engine-key fields plus trim); the probe snapshots EXACTLY these so
+#: it never pins the mismatched job's Polisher — and with it the job's
+#: whole dataset — in memory for the rest of the server's life
+_PARAM_FIELDS = ("match", "mismatch", "gap", "window_length", "trim",
+                 "num_threads", "tpu_poa_batches",
+                 "tpu_banded_alignment", "tpu_aligner_band_width",
+                 "tpu_engine", "tpu_pipeline_depth",
+                 "tpu_device_timeout")
+
+
+def _slim_params(p):
+    import types
+
+    return types.SimpleNamespace(
+        **{k: getattr(p, k) for k in _PARAM_FIELDS})
+
+
+class AuditMismatch:
+    """One confirmed silent-corruption event (diagnostics record)."""
+
+    __slots__ = ("job", "trace", "lane", "iteration", "window_id",
+                 "rank", "labels", "flight", "demoted", "t")
+
+    def __init__(self, **kw):
+        for k in self.__slots__:
+            setattr(self, k, kw.get(k))
+
+    def as_dict(self) -> dict:
+        return {k: getattr(self, k) for k in self.__slots__}
+
+
+class WindowAuditor:
+    """The sampling auditor (module docstring). One per PolishServer;
+    the serve batcher calls `audit_windows` after every iteration
+    (shared and solo) once the lane lock is released."""
+
+    def __init__(self, rate: float, demote: bool = True,
+                 quarantine: bool = True, hists=None,
+                 flight_dir: str | None = None, journal=None,
+                 on_alert=None):
+        from ..ops.oracle import OracleExecutor
+
+        self.rate = min(1.0, max(0.0, float(rate)))
+        self.demote_enabled = bool(demote)
+        self.quarantine_enabled = bool(quarantine)
+        #: the server's lifetime HistogramSet: shadow durations observe
+        #: as `audit.shadow`, whose mismatch-bucket exemplar names the
+        #: dual-stream flight artifact
+        self.hists = hists
+        self.flight_dir = flight_dir
+        #: obs.journal.Journal (or None): typed `audit-mismatch` /
+        #: `audit-lane` / `alert` annotation lines
+        self.journal = journal
+        #: callable(state: str, detail: dict) — the server journals the
+        #: typed alert and logs; state transitions only
+        self.on_alert = on_alert
+        self.oracle = OracleExecutor()
+        self._lock = threading.Lock()
+        self.counters = {"windows": 0, "sampled": 0, "audited": 0,
+                         "clean": 0, "mismatches": 0, "repaired": 0,
+                         "demotions": 0, "shadow_s": 0.0}
+        #: labeled mismatch series: (engine, kernel, dtype, bucket,
+        #: lane) -> count — the scrape's audit_mismatches family
+        self.mismatch_series: dict[tuple, int] = {}
+        self.recent: list[AuditMismatch] = []
+        #: the lane re-probe's known-good input: the latest mismatched
+        #: window's content with its ORACLE-verified bytes (always set
+        #: by the time a quarantine exists)
+        self._probe = None
+        self._alert_firing = False
+        self._acked = 0
+        self._flight_seq = 0
+
+    # ---------------------------------------------------------- sampling
+    @property
+    def armed(self) -> bool:
+        return self.rate > 0.0
+
+    def set_rate(self, rate: float) -> None:
+        """Live re-rate (servebench's A/B uses it); sampling stays a
+        pure function of (content, rate)."""
+        self.rate = min(1.0, max(0.0, float(rate)))
+
+    def sampled(self, w) -> bool:
+        return window_sample_fraction(w) < self.rate
+
+    # ------------------------------------------------------------- audit
+    def audit_windows(self, pairs, lane_index: int, iteration: int,
+                      batcher=None) -> int:
+        """Audit one finished iteration: `pairs` is [(window, polisher)]
+        for every window the iteration completed. Samples by content
+        hash, shadow re-executes the sampled set through the oracle,
+        byte-compares, and fires the full mismatch consequence chain
+        (module docstring) — including REPAIRING the production window
+        — before the caller delivers the windows to their jobs. Returns
+        the number of mismatches. Never raises: the batcher wraps it,
+        and an audit bug must not fail production."""
+        from ..ops.oracle import snapshot_window
+
+        rate = self.rate
+        chosen = [(w, p) for w, p in pairs
+                  if window_sample_fraction(w) < rate]
+        with self._lock:
+            self.counters["windows"] += len(pairs)
+            self.counters["sampled"] += len(chosen)
+        if not chosen:
+            return 0
+        mismatches = 0
+        exemplar = None
+        t0 = time.perf_counter()
+        # group by polisher: one oracle pass per job's parameter set
+        by_polisher: dict[int, tuple] = {}
+        for w, p in chosen:
+            by_polisher.setdefault(id(p), (p, []))[1].append(w)
+        for p, windows in by_polisher.values():
+            snaps = [snapshot_window(w) for w in windows]
+            clones = self.oracle.consensus(p, snaps)
+            for w, snap, clone in zip(windows, snaps, clones):
+                ok = (w.consensus == clone.consensus
+                      and w.polished == clone.polished)
+                with self._lock:
+                    self.counters["audited"] += 1
+                    if ok:
+                        self.counters["clean"] += 1
+                if not ok:
+                    mismatches += 1
+                    exemplar = self._on_mismatch(w, snap, clone, p,
+                                                 lane_index, iteration,
+                                                 batcher)
+        shadow_s = time.perf_counter() - t0
+        with self._lock:
+            self.counters["shadow_s"] += shadow_s
+        if self.hists is not None:
+            # ONE real observation per shadow pass; when the pass caught
+            # a mismatch, ITS bucket carries the exemplar naming the
+            # dual-stream artifact (no phantom zero-duration samples)
+            self.hists.observe("audit.shadow", shadow_s,
+                               exemplar=exemplar)
+        return mismatches
+
+    def _on_mismatch(self, w, snap, clone, p, lane_index: int,
+                     iteration: int, batcher) -> dict | None:
+        """The full consequence chain for one confirmed mismatch;
+        returns the exemplar labels the caller attaches to this shadow
+        pass's `audit.shadow` observation."""
+        from ..ops.poa_pallas import pallas_mode
+
+        engine = _engine_label(p)
+        labels = {"engine": engine,
+                  "kernel": pallas_mode(),
+                  "dtype": _dtype_label(),
+                  "bucket": f"{len(w.sequences)}x{len(w.sequences[0])}",
+                  "lane": str(lane_index)}
+        job = getattr(p, "serve_job_id", None)
+        trace = getattr(p, "serve_trace_id", None)
+        flight = self._dump_streams(w, clone, labels, job, iteration)
+        demoted: list[str] = []
+        if self.demote_enabled:
+            demoted = self._demote(engine)
+        with self._lock:
+            self.counters["mismatches"] += 1
+            key = tuple(sorted(labels.items()))
+            self.mismatch_series[key] = self.mismatch_series.get(key,
+                                                                 0) + 1
+            self.counters["demotions"] += len(demoted)
+            # known-good probe for the lane re-probe: this window's
+            # content with its oracle-verified bytes (parameters
+            # snapshotted slim — never the job's whole Polisher)
+            self._probe = (_slim_params(p), snap, clone.consensus,
+                           clone.polished)
+            ev = AuditMismatch(job=job, trace=trace, lane=lane_index,
+                               iteration=iteration, window_id=w.id,
+                               rank=w.rank, labels=labels,
+                               flight=flight, demoted=demoted,
+                               t=round(time.time(), 6))
+            self.recent.append(ev)
+            del self.recent[:-16]
+        if self.journal is not None:
+            fields = dict(labels)  # carries the lane label already
+            fields.update(iteration=iteration,
+                          window=f"{w.id}:{w.rank}", flight=flight,
+                          demoted=demoted or None)
+            self.journal.record("audit-mismatch", job=job, trace=trace,
+                                **fields)
+        log_info(f"[racon_tpu::audit] MISMATCH lane {lane_index} "
+                 f"iteration {iteration} window {w.id}:{w.rank} "
+                 f"({labels['engine']}/{labels['kernel']}/"
+                 f"{labels['dtype']} {labels['bucket']}): production "
+                 f"bytes diverge from the oracle"
+                 + (f"; demoted {len(demoted)} winner entr"
+                    f"{'y' if len(demoted) == 1 else 'ies'}"
+                    if demoted else "")
+                 + (f"; dual-stream dump {flight}" if flight else ""))
+        # REPAIR: the caught window ships the oracle bytes — detection
+        # protects this job's output, not just the dashboards
+        w.consensus = clone.consensus
+        w.polished = clone.polished
+        with self._lock:
+            self.counters["repaired"] += 1
+        self._update_alert()
+        if demoted and batcher is not None:
+            # a demotion must take effect on EVERY lane now: the
+            # engines' per-bucket plan caches resolved the old winner,
+            # so flag them all stale (rebuilt at each lane's next
+            # iteration), not just the quarantined lane's
+            batcher.flush_lane_engines()
+        if (self.quarantine_enabled and batcher is not None):
+            batcher.quarantine_lane(lane_index)
+        return {k: v for k, v in
+                (("trace_id", trace or job), ("job", job),
+                 ("flight", flight)) if v} or None
+
+    def _demote(self, engine: str) -> list[str]:
+        from ..sched.autotune import get_autotuner
+
+        demoted: list[str] = []
+        try:
+            at = get_autotuner()
+            for eng in _DEMOTE_ENGINES.get(engine, ()):
+                demoted += at.demote(engine=eng)
+        except Exception as exc:  # noqa: BLE001 — demotion is a
+            # consequence, never a second failure
+            log_info(f"[racon_tpu::audit] warning: winner-table "
+                     f"demotion failed ({type(exc).__name__}: {exc})")
+        return demoted
+
+    def _dump_streams(self, w, clone, labels: dict, job,
+                      iteration: int) -> str | None:
+        """The dual-stream flight artifact: a Chrome-trace-shaped JSON
+        (indexable by tools/obsreport.py alongside the job dumps) whose
+        `flight` object carries BOTH byte streams. Best-effort: a full
+        disk loses the artifact, never the audit verdict."""
+        if not self.flight_dir:
+            return None
+        try:
+            os.makedirs(self.flight_dir, exist_ok=True)
+            with self._lock:
+                self._flight_seq += 1
+                seq = self._flight_seq
+            path = os.path.join(
+                self.flight_dir,
+                f"flight_{job or 'audit'}_audit-mismatch_{seq}.json")
+            doc = {"traceEvents": [],
+                   "displayTimeUnit": "ms",
+                   "flight": {
+                       "reason": "audit-mismatch",
+                       "job_id": job, "iteration": iteration,
+                       "window": {"id": w.id, "rank": w.rank},
+                       "labels": labels,
+                       "produced": w.consensus.decode("latin-1"),
+                       "produced_polished": w.polished,
+                       "oracle": clone.consensus.decode("latin-1"),
+                       "oracle_polished": clone.polished}}
+            with open(path, "w") as fh:
+                json.dump(doc, fh)
+            return path
+        except Exception as exc:  # noqa: BLE001 — see docstring
+            log_info(f"[racon_tpu::audit] warning: could not write "
+                     f"dual-stream dump ({type(exc).__name__}: {exc})")
+            return None
+
+    # ---------------------------------------------------------- reprobe
+    def probe(self):
+        """The known-good re-probe input for a quarantined lane:
+        (polisher_params, window_snapshot, expected_consensus,
+        expected_polished) — the latest mismatched window with its
+        oracle-verified bytes. None before any mismatch."""
+        with self._lock:
+            return self._probe
+
+    def lane_event(self, lane_index: int, state: str, **fields) -> None:
+        """Journal + log one lane health transition (the batcher calls
+        this on quarantine / rejoin / degraded-rejoin)."""
+        if self.journal is not None:
+            self.journal.record("audit-lane", lane=lane_index,
+                                state=state, **fields)
+        log_info(f"[racon_tpu::audit] lane {lane_index} {state}"
+                 + (f" ({', '.join(f'{k}={v}' for k, v in fields.items())})"
+                    if fields else ""))
+
+    # ------------------------------------------------------------ alert
+    def _update_alert(self) -> None:
+        with self._lock:
+            firing = self.counters["mismatches"] > self._acked
+            changed = firing != self._alert_firing
+            self._alert_firing = firing
+            detail = {"mismatches": self.counters["mismatches"],
+                      "acked": self._acked}
+        if changed and self.on_alert is not None:
+            try:
+                self.on_alert("firing" if firing else "clear", detail)
+            except Exception:  # noqa: BLE001 — alerting is decoration
+                pass
+
+    @property
+    def alert_firing(self) -> bool:
+        with self._lock:
+            return self._alert_firing
+
+    def ack(self) -> dict:
+        """Operator acknowledgement (the debug RPC's `audit_ack`): the
+        alert clears and stays clear until the NEXT mismatch."""
+        with self._lock:
+            self._acked = self.counters["mismatches"]
+        self._update_alert()
+        with self._lock:
+            return {"acked": self._acked,
+                    "firing": self._alert_firing}
+
+    # --------------------------------------------------------- exposure
+    def mismatch_samples(self) -> list[tuple[dict, int]]:
+        """Labeled samples for the scrape's audit_mismatches family."""
+        with self._lock:
+            items = sorted(self.mismatch_series.items())
+        return [(dict(key), n) for key, n in items]
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            out = dict(self.counters)
+            out["shadow_s"] = round(out["shadow_s"], 4)
+            out["rate"] = self.rate
+            out["alert_firing"] = self._alert_firing
+            out["acked"] = self._acked
+            out["recent"] = [m.as_dict() for m in self.recent[-4:]]
+        out["shadow"] = self.oracle.stats()
+        return out
+
+    def close(self) -> None:
+        self.oracle.close()
+
+
+def _dtype_label() -> str:
+    from ..ops.dtypes import dtype_mode
+
+    return dtype_mode()

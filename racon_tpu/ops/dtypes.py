@@ -51,7 +51,13 @@ _I16_MAX = (1 << 15) - 1
 
 def dtype_mode() -> str:
     """RACON_TPU_DTYPE posture: 'auto' | 'int32' | 'int16'. Invalid
-    values fall back to auto (never crash a run over a typo'd knob)."""
+    values fall back to auto (never crash a run over a typo'd knob).
+    Inside an audit oracle_scope (ops/oracle.py) the posture is pinned
+    'int32' on that thread — the shadow oracle always runs wide."""
+    from .oracle import oracle_active
+
+    if oracle_active():
+        return "int32"
     raw = (os.environ.get("RACON_TPU_DTYPE") or "auto").strip().lower()
     return raw if raw in ("auto", "int32", "int16") else "auto"
 

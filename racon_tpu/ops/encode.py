@@ -87,9 +87,15 @@ def unpack_2bit_jax(packed, length: int, lens=None, pad: int = PAD):
 def pack_bases_enabled() -> bool:
     """2-bit operand packing posture: on unless RACON_TPU_PACK_BASES=0
     (the bisection knob — packing is byte-identical by construction,
-    this exists to A/B the transfer win and to pin identity in tests)."""
+    this exists to A/B the transfer win and to pin identity in tests).
+    Inside an audit oracle_scope (ops/oracle.py) packing is pinned OFF
+    on that thread — the shadow oracle ships unpacked operands."""
     import os
 
+    from .oracle import oracle_active
+
+    if oracle_active():
+        return False
     return os.environ.get("RACON_TPU_PACK_BASES", "auto") not in ("0",)
 
 

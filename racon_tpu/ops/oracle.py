@@ -1,0 +1,169 @@
+"""Oracle re-execution: ground-truth consensus for the audit sentinel.
+
+Every kernel plane in this repo is pinned byte-identical to one ORACLE
+configuration: the XLA programs at int32 with unpacked operands, and —
+for the fused engine — the SPLIT chained-call path (the declared
+fallback of the single-launch program, PR-11). The autotuner's identity
+veto already compares against exactly that configuration at PROFILE
+time (sched/autotune.py `_pick`); this module makes the same oracle
+available at SERVE time, so the online auditor (obs/audit.py) can
+shadow re-execute a sampled production window and byte-compare.
+
+Two pieces:
+
+  - `oracle_scope()` — a THREAD-LOCAL posture override consulted by the
+    four kernel-plane posture functions (`pallas_mode`, `dtype_mode`,
+    `pack_bases_enabled`, `fused_mode`). Inside the scope, on the
+    entering thread only, every dispatch decision resolves to the
+    oracle: XLA, int32, unpacked, split-chain. Thread-local (not
+    os.environ) because the auditor runs INSIDE a live server whose
+    feeder threads are concurrently resolving the production posture —
+    a process-wide env flip would corrupt their dispatch mid-iteration.
+  - `OracleExecutor` — cached per-engine-parameter oracle engines
+    (BatchPOA at pipeline depth 0, every stage inline on the calling
+    thread so the scope override is seen everywhere) with their OWN
+    PipelineStats/OccupancyStats: shadow executions never pollute the
+    production `pipeline.*`/`sched.*` telemetry (they surface as the
+    `audit.*` namespace instead, test-pinned) and never consult the
+    autotuner (forced postures skip the winner table entirely), so a
+    poisoned winner entry cannot poison its own audit. Fault injection
+    is disabled on the oracle pipeline (`faults=False`) — the oracle
+    must reproduce ground truth, not re-fire the injected corruption it
+    exists to detect.
+
+The oracle is deliberately NOT pinned to the production lane's
+sub-mesh: a bad lane is exactly what the comparison must be independent
+of (lane-level blame is the re-probe's job, serve/batcher.py)."""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+
+_tls = threading.local()
+
+
+def oracle_active() -> bool:
+    """True on a thread currently inside `oracle_scope()` — consulted
+    by the kernel-plane posture functions (one thread-local attribute
+    read; the production hot path pays only that)."""
+    return getattr(_tls, "depth", 0) > 0
+
+
+@contextlib.contextmanager
+def oracle_scope():
+    """Enter the oracle posture on THIS thread: XLA kernels, int32
+    scores, unpacked operands, split-chain fused dispatch. Reentrant."""
+    _tls.depth = getattr(_tls, "depth", 0) + 1
+    try:
+        yield
+    finally:
+        _tls.depth -= 1
+
+
+# ------------------------------------------------------------ snapshots
+def snapshot_window(w) -> tuple:
+    """An immutable content snapshot of one production window — the
+    bytes the consensus is a pure function of. The sequences/qualities
+    are immutable `bytes`, so this is reference-copying, not data
+    copying; safe to hold across iterations and processes."""
+    return (w.id, w.rank, w.type, tuple(w.sequences),
+            tuple(w.qualities), tuple(w.positions))
+
+
+def rebuild_window(snap):
+    """A fresh Window carrying exactly the snapshot's content, with no
+    consensus yet — the oracle's (and the lane re-probe's) input."""
+    from ..core.window import Window
+
+    wid, rank, wtype, seqs, quals, positions = snap
+    w = Window(wid, rank, wtype, seqs[0], quals[0])
+    w.sequences = list(seqs)
+    w.qualities = list(quals)
+    w.positions = list(positions)
+    return w
+
+
+def engine_params_key(p) -> tuple:
+    """The consensus-engine identity of a polisher's parameters — every
+    knob that can influence a window's consensus bytes (the serve
+    batcher's iteration-sharing key minus the job-only fields)."""
+    import os
+
+    return (p.match, p.mismatch, p.gap, p.window_length,
+            p.tpu_poa_batches, p.tpu_banded_alignment,
+            p.tpu_aligner_band_width,
+            p.tpu_engine or os.environ.get("RACON_TPU_ENGINE")
+            or "session")
+
+
+class OracleExecutor:
+    """Cached oracle engines, one per engine-parameter key (see module
+    docstring). `consensus()` serializes on one lock — the auditor is a
+    sampling sidecar, not a second serving plane — and runs everything
+    inline (pipeline depth 0) on the calling thread so `oracle_scope`
+    covers every posture read."""
+
+    def __init__(self):
+        from ..pipeline import PipelineStats
+        from ..sched import BatchScheduler, OccupancyStats
+
+        #: audit-namespace telemetry: the oracle's own stage counters
+        #: and compile/occupancy stats, never mixed into production
+        self.pipeline_stats = PipelineStats()
+        self.scheduler = BatchScheduler(adaptive=False,
+                                        stats=OccupancyStats())
+        self._engines: dict = {}
+        self._lock = threading.Lock()
+
+    def _engine(self, key: tuple, p):
+        from ..pipeline import DispatchPipeline
+        from .poa import BatchPOA
+
+        ent = self._engines.get(key)
+        if ent is None:
+            pipeline = DispatchPipeline(depth=0,
+                                        stats=self.pipeline_stats,
+                                        faults=False)
+            ent = self._engines[key] = BatchPOA(
+                p.match, p.mismatch, p.gap, p.window_length,
+                num_threads=1,
+                device_batches=p.tpu_poa_batches,
+                banded=p.tpu_banded_alignment,
+                band_width=p.tpu_aligner_band_width,
+                engine=p.tpu_engine,
+                pipeline=pipeline,
+                scheduler=self.scheduler)
+        return ent
+
+    def consensus(self, p, snaps: list) -> list:
+        """Re-execute the snapshotted windows through the oracle path
+        for polisher-parameters `p`; returns the rebuilt windows, each
+        carrying the ground-truth `consensus`/`polished`."""
+        key = engine_params_key(p)
+        clones = [rebuild_window(s) for s in snaps]
+        with self._lock, oracle_scope():
+            engine = self._engine(key, p)
+            engine.logger = None
+            engine.generate_consensus(clones, p.trim)
+        return clones
+
+    def stats(self) -> dict:
+        """The audit.* telemetry view: the oracle's own stage counters
+        plus its compile totals."""
+        snap = self.pipeline_stats.snapshot()
+        occ = self.scheduler.stats.snapshot()
+        return {"launches": snap["launches"],
+                "chunks": snap["chunks"],
+                "device_s": round(snap["device_s"], 4),
+                "compiles": sum(e.get("compiles", 0)
+                                for e in occ.values()),
+                "compile_s": round(sum(e.get("compile_s", 0.0)
+                                       for e in occ.values()), 3)}
+
+    def close(self) -> None:
+        with self._lock:
+            engines, self._engines = self._engines, {}
+        for engine in engines.values():
+            if engine.pipeline is not None:
+                engine.pipeline.close()

@@ -100,7 +100,23 @@ class BatchPOA:
     HOST_CHUNK = 4096
 
     def generate_consensus(self, windows, trim: bool) -> None:
-        """Fill `window.consensus` / `window.polished` for every window."""
+        """Fill `window.consensus` / `window.polished` for every window.
+
+        After the pass, any armed `sdc` fault (resilience/faults.py) is
+        consumed against the finished consensus — the silent-corruption
+        injection the audit sentinel (obs/audit.py) exists to catch. A
+        plan-less run (the universal default) pays one None check."""
+        from ..resilience import get_fault_plan
+
+        self._generate_consensus(windows, trim)
+        plan = (self.pipeline.faults if self.pipeline is not None
+                else get_fault_plan())
+        if plan is not None:
+            plan.corrupt_consensus(
+                windows, stats=(self.pipeline.stats
+                                if self.pipeline is not None else None))
+
+    def _generate_consensus(self, windows, trim: bool) -> None:
         todo = []
         for w in windows:
             if len(w.sequences) < 3:

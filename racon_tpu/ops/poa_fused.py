@@ -117,7 +117,14 @@ def fused_mode() -> str:
     (the pre-fusion behavior), 'auto' (default) = per-bucket via the
     persisted autotuner winner table (sched/autotune engine
     "fused_loop"; a cold table dispatches split). Invalid values fall
-    back to auto — never crash a run over a typo'd knob."""
+    back to auto — never crash a run over a typo'd knob. Inside an
+    audit oracle_scope (ops/oracle.py) the posture is pinned '0' on
+    that thread — the shadow oracle runs the split chained path, the
+    fused program's declared byte-identical fallback."""
+    from .oracle import oracle_active
+
+    if oracle_active():
+        return "0"
     raw = (os.environ.get("RACON_TPU_FUSED") or "auto").strip().lower()
     return raw if raw in ("auto", "0", "1") else "auto"
 

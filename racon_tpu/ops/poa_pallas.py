@@ -50,7 +50,14 @@ def pallas_mode() -> str:
     'off' (unset/0 — XLA programs only, today's default), 'on' (`1` —
     the Pallas kernel whenever the VMEM envelope fits), or 'auto'
     (consult the persisted per-bucket winner table, sched/autotune;
-    buckets without a measured entry dispatch XLA exactly as off)."""
+    buckets without a measured entry dispatch XLA exactly as off).
+    Inside an audit oracle_scope (ops/oracle.py) the posture is pinned
+    'off' on that thread — the shadow re-execution's ground truth is
+    the XLA program whatever the environment says."""
+    from .oracle import oracle_active
+
+    if oracle_active():
+        return "off"
     raw = (os.environ.get("RACON_TPU_PALLAS") or "").strip().lower()
     if not raw or raw == "0":
         return "off"

@@ -148,10 +148,15 @@ class DispatchPipeline:
         # resilience hooks: explicit objects win (the polisher threads its
         # CLI knobs through); otherwise the env posture applies so every
         # pipeline in the process is injectable/guarded. Both are None —
-        # zero-overhead — when nothing is configured.
+        # zero-overhead — when nothing is configured. `faults=False`
+        # DISABLES injection entirely, ignoring even the env plan — the
+        # audit sentinel's oracle re-execution must reproduce ground
+        # truth, never re-fire the fault it is trying to detect.
         self.watchdog = watchdog if watchdog is not None \
             else Watchdog.from_env()
-        self.faults = faults if faults is not None else get_fault_plan()
+        self.faults = (None if faults is False
+                       else faults if faults is not None
+                       else get_fault_plan())
         self._fb_counter = itertools.count()
         self._executor: ThreadPoolExecutor | None = None
         self._futures: list[Future] = []

@@ -26,6 +26,17 @@ a DeviceTimeout; without one the run just finishes late, never deadlocks
 (`cancel_hangs`) so a watchdog-abandoned thread exits promptly instead
 of lingering past the run.
 
+`sdc` is the SILENT-data-corruption model, the one failure the whole
+detected-error taxonomy above cannot represent: a device that computed
+WRONG BYTES without tripping any check. A `device:chunk=<N>:sdc` fault
+never raises — `fire()` skips it; instead the consensus engine consumes
+it at the end of its pass (`corrupt_consensus`), flipping one base of
+the N-th polished window's consensus. Nothing in the resilience ladder
+can catch it by design: only the identity-audit sentinel
+(racon_tpu/obs/audit.py), which shadow re-executes sampled windows
+through the oracle path and byte-compares, detects it — faultcheck's
+audit cells gate exactly that.
+
 The plan armed from RACON_TPU_FAULT_PLAN is process-cached per spec
 string (`get_fault_plan`) so the polisher's alignment- and consensus-
 phase pipelines share ONE set of one-shot faults; tests re-arm with
@@ -41,7 +52,12 @@ import time
 from ..errors import ChunkCorrupt, DeviceError, RaconError
 
 STAGES = ("pack", "device", "unpack", "fallback")
-ACTIONS = ("raise", "corrupt", "hang")
+ACTIONS = ("raise", "corrupt", "hang", "sdc")
+
+#: the base substituted in by an `sdc` flip: deterministic (same plan,
+#: same bytes) and always a REAL base, so the corruption is plausible
+#: biological output — invisible to any format-level check
+_SDC_FLIP = {65: 67, 67: 71, 71: 84, 84: 65}  # A->C->G->T->A
 
 #: granularity of the cancellable hang sleep
 _HANG_SLICE = 0.05
@@ -138,9 +154,12 @@ class FaultPlan:
         """Hook called by the pipeline as `stage` starts its `chunk`-th
         item: consumes and enacts the first matching unfired fault."""
         with self._lock:
+            # sdc faults are NOT stage hooks: they model corruption the
+            # stages never see, consumed by corrupt_consensus() instead
             fault = next((f for f in self._faults
                           if not f.fired and f.stage == stage
-                          and f.chunk == chunk), None)
+                          and f.chunk == chunk
+                          and f.action != "sdc"), None)
             if fault is None:
                 return
             fault.fired = True
@@ -164,6 +183,36 @@ class FaultPlan:
             if self._hang_abort.wait(_HANG_SLICE):
                 self._hang_abort.clear()
                 return
+
+    def corrupt_consensus(self, windows, stats=None) -> int:
+        """Consume armed `sdc` faults against a finished consensus pass:
+        for each unfired `device:chunk=N:sdc`, flip one base in the N-th
+        POLISHED window's consensus (submission order) — wrong bytes,
+        no exception, exactly the silent-corruption shape a bad chip
+        produces. Returns the number of windows corrupted. Called by
+        BatchPOA at the end of every generate_consensus; a plan with no
+        sdc faults costs one lock-free scan."""
+        with self._lock:
+            armed = [f for f in self._faults
+                     if not f.fired and f.action == "sdc"]
+            if not armed:
+                return 0
+            polished = [w for w in windows if w.polished and w.consensus]
+            hit = 0
+            for fault in armed:
+                if fault.chunk >= len(polished):
+                    continue  # stays armed for a later, larger pass
+                fault.fired = True
+                w = polished[fault.chunk]
+                cons = bytearray(w.consensus)
+                i = len(cons) // 2
+                cons[i] = _SDC_FLIP.get(cons[i], 65)
+                w.consensus = bytes(cons)
+                hit += 1
+        if stats is not None:
+            for _ in range(hit):
+                stats.bump("faults")
+        return hit
 
     def cancel_hangs(self) -> None:
         """Wake any in-progress hang sleep — the watchdog calls this on a

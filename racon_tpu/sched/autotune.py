@@ -135,6 +135,61 @@ class Autotuner:
     def record(self, engine: str, bucket, params, entry: dict) -> None:
         self.table[self.key(engine, bucket, params)] = entry
 
+    #: per-engine oracle candidate a demoted entry falls back to (the
+    #: same candidate `_pick` uses as its identity reference)
+    _ORACLE_KERNEL = {"fused_loop": "split"}
+
+    def demote(self, engine: str | None = None, bucket=None, params=None,
+               backend: str | None = None) -> list[str]:
+        """ONLINE identity veto: rewrite matching winner entries to the
+        oracle candidate (`xla`/`split` at int32) with `identical` False
+        and `demoted` True, then atomically persist the table — the
+        serve-time twin of the profile-time veto in `_pick`, invoked by
+        the audit sentinel (obs/audit.py) when a shadow re-execution
+        catches a production mismatch. `engine`/`bucket`/`params` narrow
+        the match (None = every entry of this backend / engine); entries
+        already dispatching the oracle are left alone. Returns the
+        demoted keys (empty = nothing matched, nothing written).
+
+        In-process dispatchers see the demotion IMMEDIATELY (`winner()`
+        reads the same dict); the atomic rewrite makes it durable, so a
+        restarted replica — or a sibling process sharing the cache —
+        never re-dispatches the vetoed candidate."""
+        b = backend if backend is not None else _backend()
+        want_key = (self.key(engine, bucket, params or (), backend=b)
+                    if engine is not None and bucket is not None
+                    else None)
+        demoted: list[str] = []
+        for key, ent in list(self.table.items()):
+            if want_key is not None:
+                if key != want_key:
+                    continue
+            else:
+                parts = key.split("|", 2)
+                if len(parts) < 3 or parts[0] != b:
+                    continue
+                if engine is not None and parts[1] != engine:
+                    continue
+            if not isinstance(ent, dict):
+                continue
+            oracle = self._ORACLE_KERNEL.get(
+                key.split("|", 2)[1], "xla")
+            if (ent.get("kernel") == oracle
+                    and ent.get("dtype") == "int32"):
+                continue  # already the oracle candidate
+            self.table[key] = {"kernel": oracle, "dtype": "int32",
+                               "ms": ent.get("ms", {}),
+                               "identical": False, "demoted": True}
+            demoted.append(key)
+        if demoted:
+            try:
+                self.save()
+            except OSError:
+                # the in-process veto stands even when the table file
+                # is unwritable; durability is best-effort here
+                pass
+        return demoted
+
     def save(self) -> str:
         """Atomic write (tmp + rename) so a concurrent reader never sees
         a torn table; returns the path."""

@@ -231,7 +231,8 @@ class _Lane:
     only under this lane's exec lock."""
 
     __slots__ = ("index", "runner", "scheduler", "pipeline_stats",
-                 "lock", "busy", "iterations", "busy_s", "engines")
+                 "lock", "busy", "iterations", "busy_s", "engines",
+                 "health", "quarantined", "reprobes", "flush_engines")
 
     def __init__(self, index: int, runner, scheduler, pipeline_stats):
         self.index = index
@@ -245,6 +246,15 @@ class _Lane:
         #: engine key -> (DispatchPipeline, BatchPOA), the persistent
         #: dispatch loop (see class docstring)
         self.engines: dict = {}
+        #: audit-sentinel lane health (obs/audit.py): 1.0 healthy, 0.0
+        #: quarantined, 0.5 degraded (failed its re-probe but is the
+        #: last serving lane). The scrape's racon_tpu_lane_health gauge.
+        self.health = 1.0
+        self.quarantined = False
+        self.reprobes = 0
+        #: set on quarantine: the next re-probe rebuilds the cached
+        #: engines so a just-demoted winner table takes effect
+        self.flush_engines = False
 
 
 def _engine_key(p) -> tuple:
@@ -316,6 +326,12 @@ class WindowBatcher:
         self._feeders: list[threading.Thread | None] = []
         self._stop = False
         self._held = False
+        #: the identity-audit sentinel (obs/audit.WindowAuditor) or
+        #: None; the server wires it when RACON_TPU_AUDIT_RATE > 0.
+        #: Audits run on the feeder thread AFTER the lane lock is
+        #: released and BEFORE windows are delivered — off the device
+        #: hot path, but in time to repair a caught corruption
+        self.auditor = None
         self.counters = {"iterations": 0, "solo_iterations": 0,
                          "shared_iterations": 0, "jobs": 0, "windows": 0,
                          "max_jobs_in_iteration": 0,
@@ -325,7 +341,13 @@ class WindowBatcher:
                          #: overhead (iteration wall − device-stage
                          #: seconds); solo/isolation iterations run on
                          #: the job's own pipeline and are not included
-                         "host_s": 0.0}
+                         "host_s": 0.0,
+                         #: queue-side audit overhead accounting: wall
+                         #: seconds feeders spent in the sentinel's
+                         #: sample+shadow+compare, and lane health flow
+                         "audit_s": 0.0,
+                         "lane_quarantines": 0, "lane_rejoins": 0,
+                         "lane_reprobes": 0}
 
     # ------------------------------------------------------------ entry
     def consensus(self, polisher, on_windows=None) -> None:
@@ -347,7 +369,11 @@ class WindowBatcher:
             # lanes' iterations keep flowing underneath a poisoned job
             with self._cond:
                 lanes = self._lanes_locked()
-                lane = min(lanes, key=lambda l: (l.busy, l.index))
+                # a quarantined lane takes no new work while healthy
+                # siblings exist (it is busy re-probing anyway)
+                healthy = [l for l in lanes if not l.quarantined]
+                lane = min(healthy or lanes,
+                           key=lambda l: (l.busy, l.index))
             it = next(self._iter_seq)
             polisher.device_runner = lane.runner
             with lane.lock:
@@ -361,6 +387,11 @@ class WindowBatcher:
                 finally:
                     t1 = time.perf_counter()
                     self._lane_busy(lane, False, t1 - t0)
+            # the sentinel audits SOLO iterations too: a per-job fault
+            # plan is exactly where injected silent corruption lives,
+            # and a caught window is repaired before delivery
+            self._audit([(w, polisher) for w in polisher.windows],
+                        lane, it)
             if self.hists is not None:
                 self.hists.observe("serve.iteration", t1 - t0)
             self._account(1, len(polisher.windows), solo=True)
@@ -525,9 +556,26 @@ class WindowBatcher:
 
     def _feeder_loop(self, lane: _Lane) -> None:
         while True:
+            with self._cond:
+                quarantined = lane.quarantined
+                stop = self._stop
+            if quarantined:
+                # suspect lane: drain (no extraction) and solo re-probe
+                # with the auditor's known-good window; a failed probe
+                # backs off and retries while healthy siblings serve
+                if not self._reprobe_lane(lane):
+                    if stop:
+                        return
+                    with self._cond:
+                        if lane.quarantined:
+                            self._cond.wait(
+                                min(5.0, 0.25 * max(1, lane.reprobes)))
+                    continue
             batch = None
             with self._cond:
                 while True:
+                    if lane.quarantined:
+                        break
                     if self._held and not self._stop:
                         self._cond.wait(0.1)
                         continue
@@ -693,6 +741,10 @@ class WindowBatcher:
             [(t, len(ws)) for t, ws in per_ticket.items()], it)
         with lane.lock:
             self._lane_busy(lane, True)
+            # a winner-table demotion flags every lane's engines stale:
+            # rebuild here so the vetoed kernel stops dispatching at
+            # the very next iteration, quarantined or not
+            self._fresh_engines_locked(lane)
             pre_c, pre_s = self._compile_totals(lane.scheduler.stats)
             pre_dev = lane.pipeline_stats.snapshot()["device_s"]
             _, engine = self._lane_engine(lane, tickets[0].key, p0)
@@ -726,6 +778,12 @@ class WindowBatcher:
             self.hists.observe("serve.iteration_host", host_s)
         self._account(len(tickets), len(windows), solo=False,
                       host_s=host_s)
+        # identity audit (obs/audit.py): sampled shadow re-execution off
+        # the lane lock, BEFORE delivery so a caught corruption is
+        # repaired before any job stitches it
+        self._audit([(w, t.polisher)
+                     for t, ws in per_ticket.items() for w in ws],
+                    lane, it)
         shared = len(tickets) > 1
         for ticket, ws in per_ticket.items():
             ticket.iterations += 1
@@ -749,6 +807,136 @@ class WindowBatcher:
             ticket.deliver(ws)
             if ticket.remaining <= 0:
                 ticket.finish()
+
+    # ------------------------------------------------------------- audit
+    def _audit(self, pairs, lane: _Lane, iteration: int) -> None:
+        """Run the armed identity auditor over one iteration's finished
+        windows (shared or solo). Never fails production: an audit bug
+        is logged, the iteration's delivery proceeds untouched. The
+        wall spent here is accounted as `audit_s` — the queue-side
+        overhead number servebench measures and perfgate gates."""
+        auditor = self.auditor
+        if auditor is None or not auditor.armed or not pairs:
+            return
+        t0 = time.perf_counter()
+        try:
+            auditor.audit_windows(pairs, lane_index=lane.index,
+                                  iteration=iteration, batcher=self)
+        except Exception as exc:  # noqa: BLE001 — see docstring
+            from ..utils.logger import log_info
+
+            log_info(f"[racon_tpu::audit] warning: audit pass failed "
+                     f"({type(exc).__name__}: {exc})")
+        with self._cond:
+            self.counters["audit_s"] += time.perf_counter() - t0
+
+    def flush_lane_engines(self) -> None:
+        """Mark EVERY lane's cached (pipeline, engine) pairs stale —
+        rebuilt lazily at each lane's next iteration (or re-probe). The
+        auditor calls this after an online winner-table demotion: the
+        engines' per-bucket plan caches resolved the OLD winner, so
+        without a flush a demoted kernel would keep dispatching on
+        every lane that already built its engines."""
+        with self._cond:
+            for lane in (self._lanes or ()):
+                lane.flush_engines = True
+
+    def _fresh_engines_locked(self, lane: _Lane) -> None:
+        """Drop the lane's cached engines if flagged stale (caller
+        holds the LANE lock; the flag is _cond-guarded)."""
+        with self._cond:
+            flush, lane.flush_engines = lane.flush_engines, False
+        if flush:
+            for pipeline, _e in lane.engines.values():
+                pipeline.close()
+            lane.engines.clear()
+
+    def quarantine_lane(self, index: int) -> None:
+        """Mark a lane suspect (the auditor calls this on a mismatch):
+        its health gauge drops to 0, it stops extracting iterations,
+        its cached engines are flushed (so a just-demoted winner table
+        takes effect on rebuild), and its feeder re-probes it with the
+        auditor's known-good window — rejoining on a clean probe,
+        staying quarantined otherwise (unless it is the last serving
+        lane, which rejoins DEGRADED at health 0.5 rather than wedging
+        the service)."""
+        with self._cond:
+            lanes = self._lanes or []
+            if index >= len(lanes):
+                return
+            lane = lanes[index]
+            if lane.quarantined:
+                return
+            lane.quarantined = True
+            lane.health = 0.0
+            lane.flush_engines = True
+            self.counters["lane_quarantines"] += 1
+            self._cond.notify_all()
+        if self.auditor is not None:
+            self.auditor.lane_event(index, "quarantined")
+
+    def _reprobe_lane(self, lane: _Lane) -> bool:
+        """One solo re-probe of a quarantined lane: run the auditor's
+        known-good window through THIS lane's (rebuilt) engine and
+        byte-compare against the oracle-verified bytes. Returns True
+        when the lane rejoined (clean probe, or degraded last-lane
+        fallback), False when it stays quarantined."""
+        from ..ops.oracle import rebuild_window
+
+        auditor = self.auditor
+        probe = auditor.probe() if auditor is not None else None
+        ok = None
+        if probe is not None:
+            p0, snap, expect_cons, expect_pol = probe
+            try:
+                w = rebuild_window(snap)
+                key = _engine_key(p0)
+                with lane.lock:
+                    self._fresh_engines_locked(lane)
+                    _, engine = self._lane_engine(lane, key, p0)
+                    engine.logger = None
+                    engine.generate_consensus([w], p0.trim)
+                ok = (w.consensus == expect_cons
+                      and w.polished == expect_pol)
+            except Exception:  # noqa: BLE001 — a raising probe is a
+                # failing probe
+                ok = False
+        with self._cond:
+            lane.reprobes += 1
+            self.counters["lane_reprobes"] += 1
+            reprobes = lane.reprobes
+        if ok:
+            with self._cond:
+                lane.quarantined = False
+                lane.health = 1.0
+                self.counters["lane_rejoins"] += 1
+                self._cond.notify_all()
+            if auditor is not None:
+                auditor.lane_event(lane.index, "rejoined",
+                                   reprobes=reprobes)
+            return True
+        # failed (or no probe material): stay quarantined while any
+        # healthy sibling serves; the LAST lane rejoins degraded — a
+        # loudly-flagged lane beats a wedged service, and the sentinel
+        # keeps repairing whatever it samples
+        with self._cond:
+            others = any(l is not lane and not l.quarantined
+                         for l in (self._lanes or ()))
+            if not others:
+                lane.quarantined = False
+                lane.health = 0.5
+                self._cond.notify_all()
+        if not others:
+            if auditor is not None:
+                auditor.lane_event(
+                    lane.index, "degraded",
+                    reason=("re-probe failed with no healthy sibling"
+                            if ok is False else "no known-good probe"))
+            return True
+        if auditor is not None and ok is False:
+            auditor.lane_event(lane.index, "reprobe-failed",
+                               reprobes=reprobes)
+        return False
 
     def _fail_tickets(self, tickets, exc: BaseException) -> None:
         """An iteration died (strict-off degradation happens INSIDE
@@ -794,13 +982,17 @@ class WindowBatcher:
         with self._cond:
             out = dict(self.counters)
             out["host_s"] = round(out["host_s"], 4)
+            out["audit_s"] = round(out["audit_s"], 4)
             out["worker_lanes"] = (len(self._lanes)
                                    if self._lanes is not None
                                    else self.worker_lanes)
             out["lanes"] = [
                 {"lane": l.index, "n_devices": l.runner.n_devices,
                  "iterations": l.iterations,
-                 "busy": l.busy, "busy_s": round(l.busy_s, 4)}
+                 "busy": l.busy, "busy_s": round(l.busy_s, 4),
+                 "health": round(l.health, 3),
+                 "quarantined": l.quarantined,
+                 "reprobes": l.reprobes}
                 for l in (self._lanes or ())]
         stats = self._merged_stats()
         compiles, compile_s = self._compile_totals(stats)

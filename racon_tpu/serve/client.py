@@ -340,8 +340,18 @@ class PolishClient:
 
     def debug(self, max_events: int = 5000) -> dict:
         """The flight recorder's recent events plus the automatic dump
-        artifacts written so far — the live post-mortem view."""
+        artifacts written so far — the live post-mortem view. On a
+        server with the identity-audit sentinel armed, the response
+        additionally carries the `audit` counters snapshot."""
         return self.request({"type": "debug", "max_events": max_events})
+
+    def audit_ack(self) -> dict:
+        """Operator acknowledgement of the identity-audit alert: clears
+        the racon_tpu_audit_alert gauge (and journals the typed clear)
+        until the NEXT mismatch. Returns the server's post-ack audit
+        snapshot."""
+        return self.request({"type": "debug", "audit_ack": True,
+                             "max_events": 0})
 
     def shutdown(self) -> dict:
         return self.request({"type": "shutdown"})

@@ -231,6 +231,20 @@ class ServeConfig:
         self.tenant_weights = _parse_tenant_weights(kw.pop(
             "tenant_weights",
             env("RACON_TPU_SERVE_TENANT_WEIGHTS") or None))
+        # identity-audit sentinel (obs/audit.py): the fraction of
+        # production windows deterministically sampled for shadow
+        # re-execution through the oracle path. 0 (the default) keeps
+        # every serve surface byte-identical to the pre-audit code;
+        # the companion knobs gate the mismatch consequences (online
+        # winner-table demotion, lane quarantine/re-probe)
+        self.audit_rate = min(1.0, max(0.0, kw.pop(
+            "audit_rate", _env_float("RACON_TPU_AUDIT_RATE", 0.0))))
+        self.audit_demote = bool(kw.pop(
+            "audit_demote",
+            (env("RACON_TPU_AUDIT_DEMOTE") or "1") != "0"))
+        self.lane_quarantine = bool(kw.pop(
+            "lane_quarantine",
+            (env("RACON_TPU_LANE_QUARANTINE") or "1") != "0"))
         self.warmup = kw.pop("warmup", True)
         self.max_frame = kw.pop("max_frame", max_frame_bytes())
         # telemetry exposition: None = no HTTP endpoint (the scrape RPC
@@ -387,6 +401,20 @@ class PolishServer:
         self.batcher.hists = self.hists
         self.batcher.pipeline_stats.hists = self.hists
         self.batcher.scheduler.stats.hists = self.hists
+        #: identity-audit sentinel (obs/audit.py): armed only when the
+        #: sampled fraction is nonzero — with it off, the scrape, the
+        #: journal and the FASTA are byte-identical to the pre-audit
+        #: server (test-pinned)
+        self.auditor = None
+        if cfg.audit_rate > 0.0:
+            from ..obs.audit import WindowAuditor
+
+            self.auditor = WindowAuditor(
+                rate=cfg.audit_rate, demote=cfg.audit_demote,
+                quarantine=cfg.lane_quarantine, hists=self.hists,
+                flight_dir=cfg.flight_dir or None,
+                on_alert=self._on_audit_alert)
+            self.batcher.auditor = self.auditor
         #: flight recorder (obs/flight.py): installed at start() unless
         #: a full trace is already armed (then that recorder serves as
         #: the flight source too)
@@ -470,6 +498,11 @@ class PolishServer:
         # queue-side lifecycle transitions (started / expired) feed the
         # journal and the live progress relay
         self.queue.on_event = self._on_queue_event
+        if self.auditor is not None:
+            # the sentinel journals its annotation events (audit-
+            # mismatch / audit-lane / alert) into the same lifecycle
+            # journal, keyed by the owning job
+            self.auditor.journal = self.journal
         # always-on flight recorder: when no full trace is armed,
         # install the bounded ring as the process tracer so every span
         # hook feeds it (<2% overhead, synthbench --flight A/Bs it);
@@ -566,6 +599,21 @@ class PolishServer:
                 f"fast {res['fast']:g}x / slow {res['slow']:g}x of "
                 f"budget (threshold {res['threshold']:g}x, "
                 f"{miss} deadline misses)")
+
+    def _on_audit_alert(self, state: str, detail: dict) -> None:
+        """WindowAuditor.on_alert sink: a nonzero mismatch count flips
+        the racon_tpu_audit_alert gauge (rendered from the auditor's
+        live state) and journals a typed alert; the operator clears it
+        with the debug RPC's `audit_ack`."""
+        if self.journal is not None:
+            self.journal.record(
+                "alert", kind="audit-mismatch", state=state,
+                mismatches=detail.get("mismatches"),
+                acked=detail.get("acked"))
+        log_info(f"[racon_tpu::serve] audit alert "
+                 f"{'FIRING' if state == 'firing' else 'clear'}: "
+                 f"{detail.get('mismatches', 0)} identity mismatches "
+                 f"({detail.get('acked', 0)} acknowledged)")
 
     def healthz_snapshot(self) -> dict:
         """The health body both transports serve (`/healthz` HTTP —
@@ -706,6 +754,8 @@ class PolishServer:
         # in-flight jobs are done (or over budget): stop the device
         # feeder so the process can exit without a straggler iteration
         self.batcher.close()
+        if self.auditor is not None:
+            self.auditor.close()
         # flush observability BEFORE dropping connections: an armed
         # trace/metrics artifact must survive the shutdown
         self._flush_observability()
@@ -848,8 +898,15 @@ class PolishServer:
                     "content_type": obs_prom.CONTENT_TYPE,
                     "text": self.prometheus_text()}
         if rtype == "debug":
-            return self.debug_snapshot(
+            resp = self.debug_snapshot(
                 max_events=int(req.get("max_events", 5000)))
+            if self.auditor is not None:
+                # operator acknowledgement: clears the audit alert
+                # (gauge + journal) until the next mismatch
+                if req.get("audit_ack"):
+                    resp["audit_ack"] = self.auditor.ack()
+                resp["audit"] = self.auditor.snapshot()
+            return resp
         if rtype == "shutdown":
             threading.Thread(target=self.drain,
                              name="racon-tpu-serve-drain",
@@ -1175,8 +1232,11 @@ class PolishServer:
             job.stats_ref = polisher.pipeline_stats
             # trace context + live progress ride the polisher: the
             # batcher tags shared-round spans with serve_trace_id, and
-            # progress events relay through the job to the handler
+            # progress events relay through the job to the handler;
+            # the job id lets the audit sentinel journal a mismatch
+            # into the OWNING job's timeline
             polisher.serve_trace_id = job.trace_id
+            polisher.serve_job_id = job.id
             if job.want_progress:
                 polisher.progress_hook = job.notify_progress
             polisher.initialize()
@@ -1376,6 +1436,44 @@ class PolishServer:
                 [({"tenant": t}, tc.get("credit", 0.0))
                  for t, tc in sorted(tenants.items())],
                 "accrued DRR credit per tenant (spent one per pop)")
+        # identity-audit families (obs/audit.py) — rendered ONLY when
+        # the sentinel is armed, so an audit-off scrape stays
+        # byte-identical to the pre-audit exposition (test-pinned)
+        if self.auditor is not None:
+            a = self.auditor.snapshot()
+            counters["audit.windows"] = (
+                a["windows"], "windows that passed through audited "
+                "iterations (the sampling denominator)")
+            counters["audit.sampled"] = (
+                a["sampled"], "windows selected by the content-keyed "
+                "sample at the armed rate")
+            counters["audit.shadow_seconds"] = round(a["shadow_s"], 4)
+            counters["audit.repaired"] = a["repaired"]
+            counters["audit.demotions"] = (
+                a["demotions"], "autotuner winner entries online-"
+                "demoted to the oracle candidate after a mismatch")
+            counters["audit.shadow_launches"] = a["shadow"]["launches"]
+            counters["audit.shadow_compiles"] = a["shadow"]["compiles"]
+            mism = self.auditor.mismatch_samples()
+            if mism:
+                counters["audit.mismatches"] = obs_prom.Labeled(
+                    mism, "confirmed silent-data-corruption events by "
+                    "(engine, kernel, dtype, bucket, lane)")
+            gauges["audit.rate"] = (
+                a["rate"], "deterministic content-keyed sample "
+                "fraction the sentinel audits at")
+            gauges["audit.alert"] = (
+                a["alert_firing"],
+                "1 while unacknowledged identity mismatches exist "
+                "(clear via the debug RPC's audit_ack)")
+            lane_rows = b.get("lanes") or ()
+            if lane_rows:
+                gauges["lane_health"] = obs_prom.Labeled(
+                    [({"lane": str(l["lane"])}, l["health"])
+                     for l in lane_rows],
+                    "audit-sentinel lane health: 1 healthy, 0 "
+                    "quarantined, 0.5 degraded (failed re-probe, "
+                    "last serving lane)")
         # SLO burn-rate view (obs/fleet.py tracker, fed by the queue's
         # on_slo hook)
         burn = self.burn.state()
@@ -1428,6 +1526,8 @@ class PolishServer:
                         "recent": q.get("recent"),
                         "latency": (latency.snapshot()
                                     if latency is not None else None)},
+                "audit": (self.auditor.snapshot()
+                          if self.auditor is not None else None),
                 "flight": {"dumps": list(self._dumps),
                            "installed": self._flight_installed},
                 "journal": ({"path": self.config.journal_path,
@@ -1497,6 +1597,16 @@ def serve_main(argv: list[str]) -> int:
     ap.add_argument("--gather-ms", type=float, default=None,
                     help="DEPRECATED (round-barrier era): aliased to "
                          "--max-wait-ms with a deprecation warning")
+    ap.add_argument("--audit-rate", type=float, default=None,
+                    help="identity-audit sentinel: deterministically "
+                         "sample this fraction of production windows "
+                         "(content-keyed hash, no RNG) and shadow "
+                         "re-execute them through the oracle path, "
+                         "byte-comparing consensus output "
+                         "(RACON_TPU_AUDIT_RATE, default 0 = off; "
+                         "companions RACON_TPU_AUDIT_DEMOTE / "
+                         "RACON_TPU_LANE_QUARANTINE gate the mismatch "
+                         "consequences)")
     ap.add_argument("--no-warmup", action="store_true",
                     help="skip the synthetic warmup job (first real "
                          "request pays the compiles)")
@@ -1578,6 +1688,8 @@ def serve_main(argv: list[str]) -> int:
         kw["tenant_quota"] = args.tenant_quota
     if args.worker_lanes is not None:
         kw["worker_lanes"] = args.worker_lanes
+    if args.audit_rate is not None:
+        kw["audit_rate"] = args.audit_rate
     if args.gather_ms is not None:
         # deprecated alias: ServeConfig warns and maps it to max_wait_s
         kw["gather_window_s"] = args.gather_ms / 1000.0

@@ -1,0 +1,776 @@
+"""Online identity-audit sentinel (racon_tpu/obs/audit.py + wiring).
+
+The ISSUE-13 acceptance pins: deterministic content-keyed sampling,
+oracle-path equality on clean runs, injected silent-corruption (`sdc`)
+detection with online winner-table demotion persisting across
+processes, lane quarantine/re-probe, telemetry isolation (a sampled run
+leaves production pipeline counters identical to an unsampled one), the
+flagless byte-identity pin (audit off => no audit surface anywhere),
+and THE end-to-end sentinel pin: a live serve run with audit rate 1.0
+and a fault plan corrupting one device chunk detects the mismatch
+(labeled counter + typed journal event + dual-stream flight dump),
+demotes the persisted winner entry on disk, quarantines then re-probes
+the lane, and the job's final FASTA is STILL byte-identical to a clean
+solo run."""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+import types
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+from racon_tpu.core.window import WindowType, create_window  # noqa: E402
+from racon_tpu.obs.audit import (WindowAuditor,  # noqa: E402
+                                 window_sample_fraction)
+from racon_tpu.ops.oracle import (OracleExecutor, oracle_active,  # noqa: E402
+                                  oracle_scope, rebuild_window,
+                                  snapshot_window)
+from racon_tpu.ops.poa import BatchPOA  # noqa: E402
+from racon_tpu.resilience.faults import FaultPlan  # noqa: E402
+from racon_tpu.sched.autotune import (Autotuner,  # noqa: E402
+                                      reset_autotuner_cache)
+
+
+def make_windows(n=6, seed=3, length=60, depth=4):
+    """Small consensus-ready windows: backbone + mutated layers."""
+    import random
+
+    rng = random.Random(seed)
+    acgt = "ACGT"
+    windows = []
+    for k in range(n):
+        bb = "".join(rng.choice(acgt) for _ in range(length))
+        w = create_window(0, k, WindowType.kNGS, bb.encode(),
+                          b"!" * length)
+        for _ in range(depth):
+            layer = "".join(c if rng.random() > 0.05
+                            else rng.choice(acgt) for c in bb)
+            w.add_layer(layer.encode(), None, 0, length - 1)
+        windows.append(w)
+    return windows
+
+
+def host_params(**kw):
+    """A polisher-parameters stub for host-engine consensus."""
+    base = dict(match=3, mismatch=-5, gap=-4, window_length=500,
+                trim=True, num_threads=1, tpu_poa_batches=0,
+                tpu_banded_alignment=False, tpu_aligner_band_width=0,
+                tpu_engine=None, tpu_pipeline_depth=0,
+                tpu_device_timeout=0.0)
+    base.update(kw)
+    return types.SimpleNamespace(**base)
+
+
+# ------------------------------------------------------------- sampling
+def test_content_keyed_sampling_deterministic():
+    """The sample decision is a pure function of the window bytes: the
+    same content always lands at the same fraction, rates NEST (the
+    r=0.2 sampled set is a subset of the r=0.7 set), and distinct
+    windows spread across [0, 1)."""
+    windows = make_windows(n=32)
+    fracs = [window_sample_fraction(w) for w in windows]
+    assert fracs == [window_sample_fraction(w) for w in windows]
+    assert all(0.0 <= f < 1.0 for f in fracs)
+    assert len(set(fracs)) == len(fracs)  # content-distinct -> distinct
+    low = {w.rank for w, f in zip(windows, fracs) if f < 0.2}
+    high = {w.rank for w, f in zip(windows, fracs) if f < 0.7}
+    assert low <= high
+    # content sensitivity: one flipped base moves the fraction
+    w = windows[0]
+    mutated = create_window(0, 0, WindowType.kNGS,
+                            b"A" + w.sequences[0][1:], w.qualities[0])
+    assert window_sample_fraction(mutated) != fracs[0]
+
+
+def test_sampling_rate_bounds():
+    auditor = WindowAuditor(rate=0.0)
+    assert not auditor.armed
+    auditor.set_rate(2.0)
+    assert auditor.rate == 1.0
+    auditor.set_rate(-1.0)
+    assert auditor.rate == 0.0
+
+
+# ------------------------------------------------------------- oracle
+def test_oracle_scope_is_thread_local():
+    from racon_tpu.ops.dtypes import dtype_mode
+    from racon_tpu.ops.encode import pack_bases_enabled
+    from racon_tpu.ops.poa_fused import fused_mode
+    from racon_tpu.ops.poa_pallas import pallas_mode
+
+    assert not oracle_active()
+    with oracle_scope():
+        assert oracle_active()
+        assert pallas_mode() == "off"
+        assert dtype_mode() == "int32"
+        assert fused_mode() == "0"
+        assert not pack_bases_enabled()
+    assert not oracle_active()
+
+    import threading
+    seen = {}
+
+    def probe():
+        seen["active"] = oracle_active()
+
+    with oracle_scope():
+        t = threading.Thread(target=probe)
+        t.start()
+        t.join()
+    assert seen["active"] is False  # other threads stay production
+
+
+def test_oracle_matches_clean_host_run():
+    """Oracle-path equality on a clean run: re-executing every window
+    through the oracle reproduces the production host consensus
+    bit-for-bit (including the <3-sequence backbone fallback)."""
+    windows = make_windows(n=5)
+    thin = create_window(0, 99, WindowType.kNGS, b"ACGTACGT", b"!" * 8)
+    windows.append(thin)
+    p = host_params()
+    BatchPOA(p.match, p.mismatch, p.gap, p.window_length,
+             num_threads=1).generate_consensus(windows, p.trim)
+    ex = OracleExecutor()
+    clones = ex.consensus(p, [snapshot_window(w) for w in windows])
+    for w, c in zip(windows, clones):
+        assert w.consensus == c.consensus
+        assert w.polished == c.polished
+    ex.close()
+
+
+def test_rebuild_window_roundtrip():
+    w = make_windows(n=1)[0]
+    clone = rebuild_window(snapshot_window(w))
+    assert clone.sequences == w.sequences
+    assert clone.positions == w.positions
+    assert clone.consensus == b"" and not clone.polished
+
+
+# ---------------------------------------------------------- sdc faults
+def test_sdc_fault_flips_one_base_silently():
+    windows = make_windows(n=3)
+    BatchPOA(3, -5, -4, 500, num_threads=1).generate_consensus(
+        windows, True)
+    before = [w.consensus for w in windows]
+    plan = FaultPlan.parse("device:chunk=1:sdc")
+    # fire() must NOT treat sdc as a stage hook (no raise, stays armed)
+    plan.fire("device", 1)
+    assert plan.unfired
+    assert plan.corrupt_consensus(windows) == 1
+    after = [w.consensus for w in windows]
+    assert after[0] == before[0] and after[2] == before[2]
+    assert after[1] != before[1]
+    assert len(after[1]) == len(before[1])  # a flip, not a truncation
+    assert all(w.polished for w in windows)  # silent: nothing degraded
+    # one-shot: a second pass finds the fault consumed
+    assert plan.corrupt_consensus(windows) == 0
+
+
+def test_batchpoa_consumes_sdc_plan():
+    from racon_tpu.pipeline import DispatchPipeline
+
+    windows = make_windows(n=3)
+    plan = FaultPlan.parse("device:chunk=0:sdc")
+    pl = DispatchPipeline(depth=0, faults=plan)
+    BatchPOA(3, -5, -4, 500, num_threads=1,
+             pipeline=pl).generate_consensus(windows, True)
+    clean = make_windows(n=3)
+    BatchPOA(3, -5, -4, 500, num_threads=1).generate_consensus(
+        clean, True)
+    assert windows[0].consensus != clean[0].consensus
+    assert [w.consensus for w in windows[1:]] == \
+        [w.consensus for w in clean[1:]]
+    assert pl.stats.snapshot()["faults"] == 1
+
+
+# ------------------------------------------------------ auditor core
+def test_auditor_clean_run_no_mismatch():
+    windows = make_windows(n=6)
+    p = host_params()
+    BatchPOA(p.match, p.mismatch, p.gap, p.window_length,
+             num_threads=1).generate_consensus(windows, p.trim)
+    auditor = WindowAuditor(rate=1.0)
+    n = auditor.audit_windows([(w, p) for w in windows],
+                              lane_index=0, iteration=1)
+    snap = auditor.snapshot()
+    assert n == 0
+    assert snap["windows"] == 6 and snap["sampled"] == 6
+    assert snap["audited"] == 6 and snap["clean"] == 6
+    assert snap["mismatches"] == 0 and not snap["alert_firing"]
+    auditor.close()
+
+
+def test_auditor_detects_and_repairs_corruption(tmp_path):
+    """A silently corrupted window is caught, labeled, dumped with both
+    byte streams, REPAIRED with the oracle bytes, and flips the alert
+    until acked; the known-good probe is captured for the lane
+    re-probe."""
+    windows = make_windows(n=4)
+    p = host_params()
+    BatchPOA(p.match, p.mismatch, p.gap, p.window_length,
+             num_threads=1).generate_consensus(windows, p.trim)
+    truth = windows[1].consensus
+    corrupted = bytearray(truth)
+    corrupted[0] = ord("A") if corrupted[0] != ord("A") else ord("C")
+    windows[1].consensus = bytes(corrupted)
+    alerts = []
+    auditor = WindowAuditor(rate=1.0, flight_dir=str(tmp_path),
+                            on_alert=lambda s, d: alerts.append(s))
+    n = auditor.audit_windows([(w, p) for w in windows],
+                              lane_index=3, iteration=7)
+    assert n == 1
+    assert windows[1].consensus == truth  # repaired before delivery
+    snap = auditor.snapshot()
+    assert snap["mismatches"] == 1 and snap["repaired"] == 1
+    assert snap["alert_firing"] and alerts == ["firing"]
+    samples = auditor.mismatch_samples()
+    assert len(samples) == 1
+    labels, count = samples[0]
+    assert count == 1 and labels["engine"] == "host"
+    assert labels["lane"] == "3"
+    dumps = [f for f in os.listdir(tmp_path) if "audit-mismatch" in f]
+    assert len(dumps) == 1
+    doc = json.load(open(tmp_path / dumps[0]))
+    fl = doc["flight"]
+    assert fl["oracle"].encode("latin-1") == truth
+    assert fl["produced"].encode("latin-1") == bytes(corrupted)
+    assert fl["labels"]["lane"] == "3"
+    probe = auditor.probe()
+    assert probe is not None and probe[2] == truth
+    # operator ack clears the alert; the NEXT mismatch re-fires it
+    auditor.ack()
+    assert not auditor.alert_firing and alerts[-1] == "clear"
+    auditor.close()
+
+
+def test_autotuner_demote(tmp_path):
+    """The online veto: matching non-oracle entries rewrite to the
+    oracle candidate (xla/split at int32, identical=False,
+    demoted=True), the rewrite persists atomically, and already-oracle
+    entries are untouched."""
+    path = str(tmp_path / "t.json")
+    at = Autotuner(path)
+    at.record("session", (64, 128), (3, -5, -4, 8),
+              {"kernel": "pallas", "dtype": "int16", "ms": {"a": 1},
+               "identical": True})
+    at.record("session", (128, 256), (3, -5, -4, 8),
+              {"kernel": "xla", "dtype": "int32", "ms": {},
+               "identical": True})
+    at.record("fused_loop", (256, 64, 8), (3, -5, -4, 8),
+              {"kernel": "fused", "dtype": "int32", "ms": {},
+               "identical": True})
+    at.record("aligner", (512, 64), (),
+              {"kernel": "pallas", "dtype": "int16", "ms": {},
+               "identical": True})
+    at.save()
+    demoted = at.demote(engine="session")
+    assert len(demoted) == 1 and "64x128" in demoted[0]
+    # a second sweep finds nothing left to demote
+    assert at.demote(engine="session") == []
+    demoted = at.demote(engine="fused_loop")
+    assert len(demoted) == 1
+    # persistence across processes: a FRESH handle sees the veto
+    re = Autotuner(path)
+    ent = re.table[Autotuner.key("session", (64, 128), (3, -5, -4, 8))]
+    assert ent == {"kernel": "xla", "dtype": "int32", "ms": {"a": 1},
+                   "identical": False, "demoted": True}
+    fl = re.table[Autotuner.key("fused_loop", (256, 64, 8),
+                                (3, -5, -4, 8))]
+    assert fl["kernel"] == "split" and fl["demoted"]
+    # the aligner entry (different engine) survived untouched
+    al = re.table[Autotuner.key("aligner", (512, 64))]
+    assert al["kernel"] == "pallas" and "demoted" not in al
+
+
+def test_demote_scoped_to_backend(tmp_path):
+    path = str(tmp_path / "t.json")
+    at = Autotuner(path)
+    at.record("session", (64, 128), (), {"kernel": "pallas",
+                                         "dtype": "int16", "ms": {},
+                                         "identical": True})
+    other = Autotuner.key("session", (64, 128), (), backend="tpu")
+    at.table[other] = {"kernel": "pallas", "dtype": "int16", "ms": {},
+                       "identical": True}
+    demoted = at.demote(engine="session")  # this backend (cpu) only
+    assert len(demoted) == 1 and not demoted[0].startswith("tpu|")
+    assert "demoted" not in at.table[other]
+
+
+# ----------------------------------------------- lane quarantine logic
+class _FakeAuditor:
+    """Probe-only auditor stand-in for the batcher's re-probe path."""
+
+    def __init__(self, probe):
+        self._probe = probe
+        self.events = []
+        self.armed = True
+
+    def probe(self):
+        return self._probe
+
+    def lane_event(self, lane, state, **fields):
+        self.events.append((lane, state))
+
+
+@pytest.fixture
+def two_lane_batcher():
+    import jax
+
+    from racon_tpu.serve.batcher import WindowBatcher
+
+    b = WindowBatcher(worker_lanes=2, devices=jax.devices("cpu")[:2])
+    yield b
+    b.close(timeout=5)
+
+
+def test_lane_quarantine_reprobe_rejoins(two_lane_batcher):
+    """A quarantined lane whose re-probe reproduces the known-good
+    bytes rejoins at health 1.0 (engines rebuilt along the way)."""
+    b = two_lane_batcher
+    with b._cond:
+        lanes = b._lanes_locked()
+    p = host_params()
+    w = make_windows(n=1)[0]
+    snap = snapshot_window(w)
+    ex = OracleExecutor()
+    truth = ex.consensus(p, [snap])[0]
+    ex.close()
+    b.auditor = _FakeAuditor((p, snap, truth.consensus, truth.polished))
+    b.quarantine_lane(1)
+    assert lanes[1].quarantined and lanes[1].health == 0.0
+    assert lanes[1].flush_engines
+    assert b._reprobe_lane(lanes[1]) is True
+    assert not lanes[1].quarantined and lanes[1].health == 1.0
+    assert not lanes[1].flush_engines  # cache was rebuilt
+    snap_b = b.snapshot()
+    assert snap_b["lane_quarantines"] == 1
+    assert snap_b["lane_rejoins"] == 1
+    assert (1, "quarantined") in b.auditor.events
+    assert (1, "rejoined") in b.auditor.events
+
+
+def test_lane_quarantine_stays_when_probe_fails(two_lane_batcher):
+    """A failing re-probe keeps the lane quarantined while a healthy
+    sibling serves; the LAST lane instead rejoins DEGRADED (health 0.5)
+    rather than wedging the service."""
+    b = two_lane_batcher
+    with b._cond:
+        lanes = b._lanes_locked()
+    p = host_params()
+    snap = snapshot_window(make_windows(n=1)[0])
+    b.auditor = _FakeAuditor((p, snap, b"NOT-THE-REAL-BYTES", True))
+    b.quarantine_lane(1)
+    assert b._reprobe_lane(lanes[1]) is False
+    assert lanes[1].quarantined and lanes[1].health == 0.0
+    # now lane 0 is quarantined too: its failed probe degrades instead
+    b.quarantine_lane(0)
+    assert b._reprobe_lane(lanes[0]) is True
+    assert not lanes[0].quarantined and lanes[0].health == 0.5
+    assert (0, "degraded") in b.auditor.events
+
+
+def test_solo_jobs_avoid_quarantined_lanes(two_lane_batcher):
+    b = two_lane_batcher
+    with b._cond:
+        lanes = b._lanes_locked()
+        healthy = [l for l in lanes if not l.quarantined]
+    assert len(healthy) == 2
+    b.quarantine_lane(0)
+    with b._cond:
+        healthy = [l for l in lanes if not l.quarantined]
+    assert [l.index for l in healthy] == [1]
+
+
+# -------------------------------------------------------- serve pins
+@pytest.fixture(scope="module")
+def serve_dataset(tmp_path_factory):
+    from racon_tpu.serve import make_synth_dataset
+
+    tmp = tmp_path_factory.mktemp("audit_data")
+    return make_synth_dataset(str(tmp))
+
+
+def start_server(tmp_path, **kw):
+    from racon_tpu.serve import PolishClient, PolishServer
+
+    sock = str(tmp_path / f"s{len(os.listdir(tmp_path))}.sock")
+    server = PolishServer(socket_path=sock, workers=1, warmup=False,
+                          quality_threshold=-1.0, **kw)
+    server.start()
+    return server, PolishClient(socket_path=sock)
+
+
+def solo_fasta(paths, **opts):
+    from racon_tpu.core.polisher import PolisherType, create_polisher
+
+    p = create_polisher(*paths, PolisherType.kC,
+                        opts.get("window_length", 500), -1.0, 0.3,
+                        num_threads=2,
+                        tpu_poa_batches=opts.get("tpu_poa_batches", 0),
+                        tpu_pipeline_depth=2)
+    p.initialize()
+    return b"".join(b">" + s.name.encode() + b"\n" + s.data + b"\n"
+                    for s in p.polish())
+
+
+def test_flagless_serve_has_no_audit_surface(serve_dataset, tmp_path):
+    """THE flagless pin: audit off (the default) => no auditor object,
+    no audit/lane-health scrape families, zero audit accounting — and
+    the served FASTA byte-identical to a solo run."""
+    server, client = start_server(tmp_path)
+    try:
+        resp = client.submit(*serve_dataset)
+        assert server.auditor is None
+        scrape = client.scrape()
+        assert "racon_tpu_audit" not in scrape
+        assert "racon_tpu_lane_health" not in scrape
+        snap = server.batcher.snapshot()
+        assert snap["audit_s"] == 0.0
+        assert snap["lane_quarantines"] == 0
+        assert client.stats()["audit"] is None
+        assert resp.fasta == solo_fasta(serve_dataset)
+    finally:
+        server.drain(timeout=20)
+
+
+def test_audited_run_keeps_production_telemetry_clean(serve_dataset,
+                                                     tmp_path):
+    """Satellite pin: shadow executions bill to the audit.* namespace
+    only — a rate-1.0 run's PRODUCTION pipeline/scheduler counters and
+    autotuner consult meters are identical to a rate-0 run's, while the
+    audit namespace shows the shadow work."""
+    from racon_tpu.sched.autotune import get_autotuner
+
+    server_on, client_on = start_server(tmp_path, audit_rate=1.0)
+    server_off, client_off = start_server(tmp_path)
+    try:
+        consults_before = dict(get_autotuner().consults)
+        on = client_on.submit(*serve_dataset)
+        off = client_off.submit(*serve_dataset)
+        assert on.fasta == off.fasta
+        pipe_on = server_on.batcher._merged_pipeline()
+        pipe_off = server_off.batcher._merged_pipeline()
+        for key in ("launches", "chunks", "errors", "faults",
+                    "quarantined"):
+            assert pipe_on[key] == pipe_off[key], key
+        # the shadow work exists — and is accounted SEPARATELY
+        a = server_on.auditor.snapshot()
+        assert a["audited"] > 0
+        assert a["shadow"]["launches"] > 0
+        assert dict(get_autotuner().consults) == consults_before
+        # per-job production metrics: same structural counters
+        assert (on.metrics["pipeline"]["launches"]
+                == off.metrics["pipeline"]["launches"])
+    finally:
+        server_on.drain(timeout=20)
+        server_off.drain(timeout=20)
+
+
+@pytest.mark.usefixtures("serve_dataset")
+def test_e2e_sentinel_pin(serve_dataset, tmp_path, monkeypatch):
+    """THE acceptance pin (ISSUE 13): RACON_TPU_AUDIT_RATE=1.0 + a
+    fault plan corrupting one device chunk on a live serve run =>
+    mismatch detected (labeled counter + typed journal event +
+    dual-stream flight dump), persisted winner entry demoted ON DISK
+    (visible to a fresh process-level handle), lane quarantined then
+    re-probed back to health, and the job's final FASTA byte-identical
+    to a clean solo run."""
+    at_path = str(tmp_path / "autotune.json")
+    monkeypatch.setenv("RACON_TPU_AUTOTUNE_CACHE", at_path)
+    reset_autotuner_cache()
+    at = Autotuner(at_path)
+    at.record("session", (64, 128), (3, -5, -4, 8),
+              {"kernel": "pallas", "dtype": "int16", "ms": {},
+               "identical": True})
+    at.save()
+    reset_autotuner_cache()
+    journal_path = str(tmp_path / "journal.jsonl")
+    flight_dir = str(tmp_path / "flight")
+    server, client = start_server(tmp_path, audit_rate=1.0,
+                                  journal=journal_path,
+                                  flight_dir=flight_dir)
+    opts = {"tpu_poa_batches": 1, "window_length": 100}
+    try:
+        clean = client.submit(*serve_dataset, options=opts)
+        assert server.auditor.snapshot()["mismatches"] == 0
+        bad = client.submit(*serve_dataset, options=opts,
+                            fault_plan="device:chunk=1:sdc")
+        # repaired: identical to the clean serve run AND to solo
+        assert bad.fasta == clean.fasta
+        assert bad.fasta == solo_fasta(serve_dataset, **opts)
+        a = server.auditor.snapshot()
+        assert a["mismatches"] == 1 and a["repaired"] == 1
+        assert a["demotions"] >= 1
+        # labeled counter + alert + lane health in the live scrape
+        scrape = client.scrape()
+        assert 'racon_tpu_audit_mismatches_total{' in scrape
+        assert 'engine="session"' in scrape
+        assert "racon_tpu_audit_alert 1" in scrape
+        # winner table demoted ON DISK, visible to a fresh handle
+        reset_autotuner_cache()
+        ent = Autotuner(at_path).table[
+            Autotuner.key("session", (64, 128), (3, -5, -4, 8))]
+        assert ent["demoted"] and ent["kernel"] == "xla"
+        assert ent["dtype"] == "int32" and not ent["identical"]
+        # lane: quarantined, then re-probed back to health 1.0
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            lanes = server.batcher.snapshot()["lanes"]
+            if lanes and all(l["health"] == 1.0 for l in lanes):
+                break
+            time.sleep(0.1)
+        snap = server.batcher.snapshot()
+        assert snap["lane_quarantines"] == 1
+        assert snap["lane_rejoins"] == 1
+        assert all(l["health"] == 1.0 for l in snap["lanes"])
+        # dual-stream dump on disk
+        dumps = [f for f in os.listdir(flight_dir)
+                 if "audit-mismatch" in f]
+        assert len(dumps) == 1
+        fl = json.load(open(os.path.join(flight_dir, dumps[0])))["flight"]
+        assert fl["produced"] != fl["oracle"]
+        # ack clears the alert
+        client.audit_ack()
+        assert "racon_tpu_audit_alert 0" in client.scrape()
+    finally:
+        server.drain(timeout=30)
+    # journal: typed audit-mismatch in the owning job's timeline, the
+    # lane transitions as annotations, and the consistency check (plus
+    # obsreport --check) stays green
+    from racon_tpu.obs.journal import check_consistency, read_journal
+
+    entries = read_journal(journal_path)
+    mism = [e for e in entries if e["event"] == "audit-mismatch"]
+    assert len(mism) == 1
+    assert mism[0]["job"] == bad.job_id
+    assert mism[0]["engine"] == "session"
+    assert mism[0]["flight"]
+    lane_events = [e["state"] for e in entries
+                   if e["event"] == "audit-lane"]
+    assert "quarantined" in lane_events and "rejoined" in lane_events
+    alert_states = [e["state"] for e in entries
+                    if e["event"] == "alert"
+                    and e.get("kind") == "audit-mismatch"]
+    assert alert_states[0] == "firing" and alert_states[-1] == "clear"
+    assert check_consistency(entries) == []
+    import obsreport
+
+    rc = obsreport.main(["--journal", journal_path, "--check",
+                         "--flight-dir", flight_dir])
+    assert rc == 0
+
+
+def test_obsreport_renders_audit_mismatch_in_timeline(tmp_path,
+                                                      capsys):
+    """Satellite pin: obsreport renders `audit-mismatch` in the owning
+    job's timeline and --check stays rc 0 (annotation events)."""
+    import obsreport
+
+    t = time.time()
+    entries = [
+        {"t": t, "event": "received", "job": "j1"},
+        {"t": t + 0.01, "event": "admitted", "job": "j1"},
+        {"t": t + 0.02, "event": "started", "job": "j1"},
+        {"t": t + 0.5, "event": "audit-mismatch", "job": "j1",
+         "engine": "session", "kernel": "pallas", "dtype": "int16",
+         "bucket": "8x500", "lane": "0", "iteration": 4,
+         "window": "0:3", "flight": "/tmp/f.json"},
+        {"t": t + 0.6, "event": "audit-lane", "lane": 0,
+         "state": "quarantined"},
+        {"t": t + 0.9, "event": "finished", "job": "j1",
+         "sequences": 0},
+    ]
+    path = tmp_path / "j.jsonl"
+    with open(path, "w") as fh:
+        for e in entries:
+            fh.write(json.dumps(e) + "\n")
+    rc = obsreport.main(["--journal", str(path), "--check",
+                         "--flight-dir", str(tmp_path / "none")])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "audit-mismatch" in out and "engine=session" in out
+    assert "consistency: OK" in out
+
+
+def test_fleet_federates_audit_families():
+    """Satellite pin: the aggregator federates the labeled audit and
+    lane-health families — per-(name, labels) sums across replicas —
+    and the federated body re-renders parseably."""
+    from racon_tpu.obs import prom
+    from racon_tpu.obs.fleet import FleetSnapshot, ReplicaSample
+
+    def body(mism, health):
+        counters = {
+            "audit.sampled": 10,
+            "audit.mismatches": prom.Labeled(
+                [({"engine": "session", "kernel": "pallas",
+                   "dtype": "int16", "bucket": "8x500", "lane": "0"},
+                  mism)])}
+        gauges = {
+            "audit.alert": 1 if mism else 0,
+            "lane_health": prom.Labeled([({"lane": "0"}, health)])}
+        return prom.render(counters, gauges)
+
+    snap = FleetSnapshot()
+    for k, (mism, health) in enumerate([(1, 0.0), (2, 1.0)]):
+        rs = ReplicaSample(f"r{k}")
+        rs.parsed = prom.parse(body(mism, health))
+        rs.ok = True
+        snap.replicas.append(rs)
+    from racon_tpu.obs.fleet import FleetAggregator
+
+    FleetAggregator._merge(snap)
+    series = snap.counter_series["racon_tpu_audit_mismatches_total"]
+    assert len(series) == 1
+    (_labels, total), = series.values()
+    assert total == 3  # summed per identical label set
+    assert snap.counters["racon_tpu_audit_sampled_total"] == 20
+    assert snap.gauges["racon_tpu_audit_alert"] >= 1  # summed gauge
+    health = snap.gauge_series["racon_tpu_lane_health"]
+    (_labels, h), = health.values()
+    assert h == 1.0  # summed; per-replica detail stays in replicas
+    # the merged labeled families re-render into a parseable body
+    merged = prom.render(
+        {n: prom.Labeled([(l, v) for l, v in s.values()])
+         for n, s in snap.counter_series.items()},
+        {n: prom.Labeled([(l, v) for l, v in s.values()])
+         for n, s in snap.gauge_series.items()})
+    reparsed = prom.parse(merged)
+    assert ("racon_tpu_audit_mismatches_total"
+            in reparsed.counter_series)
+
+
+def test_servetop_renders_audit_cell():
+    """Satellite pin: servetop's per-replica audit cell reads the new
+    scrape families (sampled/s, mismatches, demotions, lane health)."""
+    import servetop
+
+    from racon_tpu.obs import prom
+
+    text = prom.render(
+        {"serve.batch.iterations": 5,
+         "audit.sampled": 40,
+         "audit.demotions": 2,
+         "audit.mismatches": prom.Labeled(
+             [({"engine": "session", "kernel": "pallas",
+                "dtype": "int16", "bucket": "8x500", "lane": "1"},
+               3)])},
+        {"serve.queue_depth": 0, "serve.inflight": 0,
+         "serve.worker_lanes": 2,
+         "audit.alert": 1,
+         "lane_health": prom.Labeled([({"lane": "0"}, 1.0),
+                                      ({"lane": "1"}, 0.0)])})
+    parsed = prom.parse(text)
+    cell = servetop.audit_cell(parsed, {}, 0.0)
+    assert cell == {"sampled": 40, "sampled_rate": 0.0,
+                    "mismatches": 3, "demotions": 2,
+                    "lane_health_min": 0.0, "alert": True}
+    # rate from the previous poll
+    cell2 = servetop.audit_cell(
+        parsed, {"audit": {"sampled": 20}}, 2.0)
+    assert cell2["sampled_rate"] == 10.0
+    # a replica without audit families renders no cell
+    plain = prom.parse(prom.render({"serve.batch.iterations": 5}, {}))
+    assert servetop.audit_cell(plain, {}, 1.0) is None
+
+    scrape = parsed
+
+    class _RS:
+        endpoint = "r0"
+        ok = True
+        draining = False
+        error = None
+        parsed = scrape
+        scrape_s = 0.001
+
+    row = servetop.replica_row(_RS(), {}, 0.0)
+    assert row["audit"]["mismatches"] == 3
+
+    class _Snap:
+        replicas = [_RS()]
+        poll_s = 0.01
+        counters = scrape.counters
+        gauges = scrape.gauges
+        counter_series = scrape.counter_series
+        gauge_series = scrape.gauge_series
+
+    screen = servetop.render_screen(_Snap(), {}, [row], {}, 0.0)
+    assert "audit" in screen and "[ALERT]" in screen
+    line = servetop.fleet_line(_Snap(), {}, {}, 0.0)
+    assert "audit 3 mism" in line and "[AUDIT-ALERT]" in line
+
+
+def test_demotion_flushes_every_lane(two_lane_batcher):
+    """Review pin: an online demotion flags EVERY lane's cached
+    engines stale (not just the quarantined lane's), and the stale
+    cache is rebuilt at the lane's next use — a vetoed winner must
+    stop dispatching fleet-wide, immediately."""
+    b = two_lane_batcher
+    with b._cond:
+        lanes = b._lanes_locked()
+    p = host_params()
+    for lane in lanes:
+        with lane.lock:
+            b._lane_engine(lane, ("k",), p)
+        assert lane.engines
+    b.flush_lane_engines()
+    assert all(l.flush_engines for l in lanes)
+    for lane in lanes:
+        with lane.lock:
+            b._fresh_engines_locked(lane)
+        assert not lane.engines and not lane.flush_engines
+
+
+def test_mismatch_exemplar_rides_real_shadow_observation(tmp_path):
+    """Review pin: no phantom zero-duration samples — the shadow
+    histogram gets exactly ONE observation per pass, and a mismatching
+    pass's own bucket carries the exemplar naming the dual-stream
+    artifact."""
+    from racon_tpu.obs.hist import HistogramSet
+
+    windows = make_windows(n=3)
+    p = host_params()
+    BatchPOA(p.match, p.mismatch, p.gap, p.window_length,
+             num_threads=1).generate_consensus(windows, p.trim)
+    windows[0].consensus = b"X" + windows[0].consensus[1:]
+    hists = HistogramSet()
+    auditor = WindowAuditor(rate=1.0, hists=hists,
+                            flight_dir=str(tmp_path))
+    auditor.audit_windows([(w, p) for w in windows],
+                          lane_index=0, iteration=1)
+    h = hists.get("audit.shadow")
+    assert h.count == 1  # one pass, one sample
+    assert h.min > 0.0   # no phantom 0.0 observation
+    exemplars = h.bucket_exemplars()
+    assert len(exemplars) == 1
+    (_le, ex), = exemplars.items()
+    assert "audit-mismatch" in ex["flight"]
+    assert ex["value"] == h.max  # the pass's real duration bucket
+    auditor.close()
+
+
+def test_probe_does_not_pin_the_polisher():
+    """Review pin: the known-good probe snapshots only the slim
+    parameter fields, never the mismatched job's Polisher (which would
+    pin its whole dataset in memory)."""
+    windows = make_windows(n=2)
+    p = host_params()
+    BatchPOA(p.match, p.mismatch, p.gap, p.window_length,
+             num_threads=1).generate_consensus(windows, p.trim)
+    windows[0].consensus = b"X" + windows[0].consensus[1:]
+    auditor = WindowAuditor(rate=1.0)
+    auditor.audit_windows([(w, p) for w in windows],
+                          lane_index=0, iteration=1)
+    probe_p = auditor.probe()[0]
+    assert probe_p is not p
+    assert probe_p.match == p.match
+    assert probe_p.trim == p.trim
+    assert not hasattr(probe_p, "windows")  # slim, not a Polisher
+    auditor.close()

@@ -413,3 +413,46 @@ def test_scale_balance_max_mandatory_when_requested(tmp_path, capsys):
                           "--windows-per-s-min", "1.0",
                           "--scale-balance-max", "1.5"]) == 2
     assert "scale.curve" in capsys.readouterr().err
+
+
+def test_audit_overhead_gate(tmp_path, capsys):
+    """ISSUE-13 satellite: perfgate gates audit.overhead_pct (default
+    2.0 whenever the block is present, --audit-overhead-max mandatory
+    rc 2 naming the dotted key) and audit.mismatches == 0."""
+    base = ["--ref-value", "1.0", "--tolerance-pct", "50"]
+
+    def audit_artifact(**audit):
+        doc = serve_artifact(p50=1.0)
+        if audit:
+            doc["audit"] = audit
+        return doc
+
+    ok = write(tmp_path / "ok.json",
+               audit_artifact(overhead_pct=0.7, mismatches=0))
+    assert perfgate.main(["--artifact", ok] + base) == 0
+    err = capsys.readouterr().err
+    assert "audit.overhead_pct" in err and "audit.mismatches" in err
+    # over the default 2% budget fails
+    slow = write(tmp_path / "slow.json",
+                 audit_artifact(overhead_pct=3.4, mismatches=0))
+    assert perfgate.main(["--artifact", slow] + base) == 1
+    # ANY mismatch on the clean bench workload fails
+    corrupt = write(tmp_path / "corrupt.json",
+                    audit_artifact(overhead_pct=0.5, mismatches=1))
+    assert perfgate.main(["--artifact", corrupt] + base) == 1
+    assert "audit.mismatches" in capsys.readouterr().err
+    # explicit limit is honored (tighter fails, laxer passes)
+    assert perfgate.main(["--artifact", ok,
+                          "--audit-overhead-max", "0.5"] + base) == 1
+    assert perfgate.main(["--artifact", slow,
+                          "--audit-overhead-max", "5.0"] + base) == 0
+
+
+def test_audit_overhead_max_mandatory_when_requested(tmp_path, capsys):
+    """--audit-overhead-max over an artifact without an audit block is
+    a named-key broken gate, rc 2 (the slo.miss_rate convention)."""
+    plain = write(tmp_path / "plain.json", serve_artifact(p50=1.0))
+    assert perfgate.main(["--artifact", plain, "--ref-value", "1.0",
+                          "--tolerance-pct", "50",
+                          "--audit-overhead-max", "2.0"]) == 2
+    assert "audit.overhead_pct" in capsys.readouterr().err

@@ -31,6 +31,18 @@ job is submitted strict, so nothing degrades it away), the server must
 survive, and the NEXT clean job on the same warm server must reproduce
 the clean run's bytes exactly.
 
+An AUDIT section (two gated cells) exercises the identity-audit
+sentinel (racon_tpu/obs/audit.py) against the one failure class nothing
+above can represent: SILENT data corruption (`device:chunk=N:sdc`, a
+wrong-bytes-no-exception flip). A sampled-corruption run (audit rate
+1.0) MUST be caught within the iteration — typed `audit-mismatch`
+journal event, labeled mismatch counter, online winner-table demotion
+on disk, and the job's FASTA repaired back to the clean bytes — while
+an unsampled-corruption run (rate 0) documents the miss: the corrupted
+bytes ship and no audit event fires. Both cells are gated; together
+they pin that detection is real AND that it comes from the sampling,
+not from some hidden always-on check.
+
 Usage: python tools/faultcheck.py [--quick]
   --quick drops the hang cases (the slow rows; the pytest suite tags the
   same cases with the `slow`/`faults` markers so tier-1 skips them too).
@@ -357,6 +369,94 @@ def run_serve_cell(client, paths, clean, aligner, spec, timeout):
     return f"pass  {etype}, next clean"
 
 
+def run_audit_cells(tmp: str, paths) -> list[tuple[str, str]]:
+    """The identity-audit sentinel section (module docstring): one
+    server with audit rate 1.0, a planted autotuner winner table, a
+    journal and a flight dir; a silent `sdc` corruption must be caught
+    (and repaired, and demoted) when sampled, and must ship (with no
+    audit events) when unsampled."""
+    from racon_tpu.obs.journal import read_journal
+    from racon_tpu.sched.autotune import Autotuner, reset_autotuner_cache
+    from racon_tpu.serve import PolishClient, PolishServer
+
+    cells: list[tuple[str, str]] = []
+    at_path = os.path.join(tmp, "audit_autotune.json")
+    prev_cache = os.environ.get("RACON_TPU_AUTOTUNE_CACHE")
+    os.environ["RACON_TPU_AUTOTUNE_CACHE"] = at_path
+    reset_autotuner_cache()
+    try:
+        # plant an aggressive session winner so the online demotion has
+        # a concrete persisted entry to veto
+        at = Autotuner(at_path)
+        at.record("session", (64, 128), (3, -5, -4, 8),
+                  {"kernel": "pallas", "dtype": "int16", "ms": {},
+                   "identical": True})
+        at.save()
+        reset_autotuner_cache()
+        sock = os.path.join(tmp, "audit.sock")
+        journal = os.path.join(tmp, "audit_journal.jsonl")
+        server = PolishServer(socket_path=sock, workers=1,
+                              warmup=False, quality_threshold=-1.0,
+                              audit_rate=1.0, journal=journal,
+                              flight_dir=os.path.join(tmp, "audit_fl"))
+        server.start()
+        client = PolishClient(socket_path=sock)
+        # small windows keep the device-session oracle compiles cheap
+        opts = {"tpu_poa_batches": 1, "window_length": 100}
+        try:
+            clean = client.submit(*paths, options=opts).fasta
+            bad = client.submit(*paths, options=opts,
+                                fault_plan="device:chunk=1:sdc").fasta
+            snap = server.auditor.snapshot()
+            events = [e for e in read_journal(journal)
+                      if e.get("event") == "audit-mismatch"]
+            table = Autotuner(at_path).table
+            demoted_on_disk = any(e.get("demoted") for e in
+                                  table.values()
+                                  if isinstance(e, dict))
+            checks = [("repaired", bad == clean),
+                      ("journal", len(events) >= 1),
+                      ("counter", snap["mismatches"] >= 1),
+                      ("demoted", snap["demotions"] >= 1
+                       and demoted_on_disk)]
+            failed = [n for n, ok in checks if not ok]
+            cells.append((
+                "audit sdc sampled",
+                f"pass  caught ({snap['mismatches']} mismatches, "
+                f"{snap['demotions']} demotions, FASTA identical)"
+                if not failed else f"FAIL {' '.join(failed)}"))
+            # unsampled half: the SAME corruption at rate 0 must ship —
+            # the miss is the sampling tradeoff, documented and gated
+            pre = snap["mismatches"]
+            server.auditor.set_rate(0.0)
+            missed = client.submit(*paths, options=opts,
+                                   fault_plan="device:chunk=1:sdc").fasta
+            snap2 = server.auditor.snapshot()
+            checks = [("shipped-corrupt", missed != clean),
+                      ("no-audit-event", snap2["mismatches"] == pre)]
+            failed = [n for n, ok in checks if not ok]
+            cells.append((
+                "audit sdc unsampled",
+                "pass  missed (corruption shipped, no audit event — "
+                "the documented sampling tradeoff)"
+                if not failed else f"FAIL {' '.join(failed)}"))
+        finally:
+            server.drain(timeout=30)
+    except Exception as exc:  # noqa: BLE001 — a crashed section is a
+        # red pair of cells, not a crashed grid
+        detail = f"FAIL crashed ({type(exc).__name__}: {exc})"
+        while len(cells) < 2:
+            cells.append((("audit sdc sampled", "audit sdc unsampled")
+                          [len(cells)], detail))
+    finally:
+        if prev_cache is None:
+            os.environ.pop("RACON_TPU_AUTOTUNE_CACHE", None)
+        else:
+            os.environ["RACON_TPU_AUTOTUNE_CACHE"] = prev_cache
+        reset_autotuner_cache()
+    return cells
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true",
@@ -487,7 +587,12 @@ def main() -> int:
                 # a failed drain of the first server must not leak the
                 # lanes server's threads/socket
                 lanes_server.drain(timeout=30)
-    n_cells = (len(columns) + 2) * len(rows)
+        # the identity-audit section: silent corruption vs the sentinel
+        audit_cells = run_audit_cells(tmp, paths)
+        for name, cell in audit_cells:
+            failures += cell.startswith("FAIL")
+            print(f"{name:<{width}}  {cell}", file=sys.stderr)
+    n_cells = (len(columns) + 2) * len(rows) + len(audit_cells)
     print(f"[faultcheck] {'FAIL' if failures else 'PASS'}: "
           f"{n_cells - failures}/{n_cells} cells green",
           file=sys.stderr)

@@ -14,11 +14,15 @@ post-mortem for it" — needs them TOGETHER. This tool renders that view:
 Per job: the transition timeline with +deltas from the first event, the
 terminal state, the trace id (when the client minted one), and the
 flight dump that names the job, if any. Annotation events — the SLO
-burn tracker's `alert` lines, and any event type this tool does not
-know — render in the timeline of the job they name (an alert next to
-the deadline-miss that tripped it) but are IGNORED by the consistency
-check: `--check` red means a lifecycle invariant broke, never "a newer
-server emits a newer event type". The summary counts events by
+burn tracker's `alert` lines, the identity-audit sentinel's
+`audit-mismatch` lines (rendered in the OWNING job's timeline, next to
+the iteration that produced the corrupted bytes, carrying the
+dual-stream flight dump path), its `audit-lane` quarantine/rejoin
+transitions, and any event type this tool does not know — render in
+the timeline of the job they name (an alert next to the deadline-miss
+that tripped it) but are IGNORED by the consistency check: `--check`
+red means a lifecycle invariant broke, never "a newer server emits a
+newer event type". The summary counts events by
 type and runs the journal consistency check (`--check` turns problems
 into a nonzero exit — the CI shape; `tools/servebench.py` runs the same
 check inside its gate). `--check` additionally verifies the streamed-

@@ -27,6 +27,16 @@ Metric extraction understands both artifact shapes:
     RELATIVELY (tolerance-pct) against the `--against` reference
     whenever both artifacts carry the key.
 
+  - servebench `--audit-rate` artifacts carry an `audit` block (the
+    identity-audit sentinel's measured cost): `audit.overhead_pct` —
+    the A/B wall delta of the audited vs muted sequential pass — gates
+    ABSOLUTELY at the established observability budget (default 2.0
+    whenever the block is present; `--audit-overhead-max` makes it
+    mandatory, rc 2 naming the dotted key when absent), and
+    `audit.mismatches` must be ZERO whenever the block is present (a
+    sentinel mismatch on a clean bench workload is silent corruption,
+    not a perf number).
+
   - servebench `--fleet` artifacts additionally carry a `fleet` block:
     `fleet.scrape_overhead_pct` — replica time spent answering the
     aggregator's scrape+healthz polls as a percentage of the wave —
@@ -161,6 +171,15 @@ def extract(doc: dict, path: str = "<artifact>") -> dict:
         overhead = _lookup(inner, "fleet.scrape_overhead_pct")
         if overhead is not None:
             out["scrape_overhead_pct"] = float(overhead)
+        # identity-audit sentinel cost (servebench --audit-rate): the
+        # measured A/B wall delta, plus the mismatch count that must
+        # stay zero on a clean workload
+        audit_ov = _lookup(inner, "audit.overhead_pct")
+        if audit_ov is not None:
+            out["audit_overhead_pct"] = float(audit_ov)
+        audit_mism = _lookup(inner, "audit.mismatches")
+        if audit_mism is not None:
+            out["audit_mismatches"] = float(audit_mism)
         # latency-tail metrics (continuous-batching era): gated
         # absolutely via --p99-max / --ttfb-p50-max and relatively
         # against the --against reference when both artifacts carry them
@@ -458,6 +477,34 @@ def fleet_checks(cand: dict, args,
              limit)]
 
 
+def audit_checks(cand: dict, args,
+                 candidate_path: str) -> list[tuple[str, float, float]]:
+    """Identity-audit gates for servebench --audit-rate artifacts:
+    `audit.overhead_pct` (the measured audited-vs-muted wall delta)
+    gates ABSOLUTELY at the established <2% observability budget —
+    default whenever the artifact carries the key (the slo.miss_rate
+    convention), mandatory via `--audit-overhead-max` (an artifact
+    without it then exits 2 naming the dotted key) — and
+    `audit.mismatches` gates at ZERO whenever the block is present: a
+    sentinel mismatch on the clean bench workload is silent data
+    corruption, never an acceptable perf trade."""
+    explicit = args.audit_overhead_max is not None
+    if "audit_overhead_pct" not in cand:
+        if explicit:
+            raise GateError(
+                f"{candidate_path}: artifact lacks gated metric "
+                "'audit.overhead_pct' (--audit-overhead-max gates "
+                "servebench --audit-rate artifacts)")
+        return []
+    limit = args.audit_overhead_max if explicit else 2.0
+    checks = [("audit.overhead_pct", cand["audit_overhead_pct"],
+               limit)]
+    if "audit_mismatches" in cand:
+        checks.append(("audit.mismatches", cand["audit_mismatches"],
+                       0.0))
+    return checks
+
+
 def wps_floor_check(cand: dict, args,
                     candidate_path: str) -> list[tuple[str, float, float]]:
     """Absolute windows/s floor (--windows-per-s-min): mandatory once
@@ -539,6 +586,12 @@ def run(args) -> int:
         print(f"[perfgate] {'PASS' if check_ok else 'FAIL'}: "
               f"{os.path.basename(candidate_path)} {name} = {value:g}% "
               f"(limit {limit:g}%)", file=sys.stderr)
+    for name, value, limit in audit_checks(cand, args, candidate_path):
+        check_ok = value <= limit
+        failures += 0 if check_ok else 1
+        print(f"[perfgate] {'PASS' if check_ok else 'FAIL'}: "
+              f"{os.path.basename(candidate_path)} {name} = {value:g} "
+              f"(limit {limit:g})", file=sys.stderr)
     for name, value, limit in slo_checks(doc, cand, args,
                                          candidate_path):
         check_ok = value <= limit
@@ -615,6 +668,16 @@ def main(argv=None) -> int:
                          "time-to-first-byte p50 (warm.ttfb_p50_s); "
                          "same mandatory/relative semantics as "
                          "--p99-max")
+    ap.add_argument("--audit-overhead-max", type=float, default=None,
+                    help="absolute bound in PERCENT on the identity-"
+                         "audit sentinel's measured overhead "
+                         "(audit.overhead_pct, servebench --audit-rate "
+                         "artifacts; default: gate at 2.0 whenever the "
+                         "artifact carries the key; passing a value "
+                         "makes the gate mandatory — an artifact "
+                         "without it then exits 2 naming the dotted "
+                         "key). Artifacts with an audit block are also "
+                         "always gated on audit.mismatches == 0")
     ap.add_argument("--scrape-overhead-max", type=float, default=None,
                     help="absolute bound in PERCENT on the fleet "
                          "observability overhead "

@@ -38,6 +38,16 @@ poll every replica's scrape+healthz MID-WAVE. The artifact gains a
 scrape-overhead percentage — which tools/perfgate.py gates at the
 established <2% observability budget.
 
+AUDIT MODE (`--audit-rate R`): arm the identity-audit sentinel
+(racon_tpu/obs/audit.py) on every replica, keep it armed through the
+measured warm phases, and A/B the same sequential workload with the
+sentinel muted on the same warm server — the wall delta is the real
+audit cost. The artifact gains an `audit` block (sampled fraction,
+shadow device seconds, mismatch/demotion counts, overhead_pct) which
+tools/perfgate.py gates at the <2% observability budget and at ZERO
+mismatches (a mismatch on a clean bench workload is a corruption bug,
+and also fails the bench directly).
+
 OPEN-LOOP ARRIVAL MODE (`--qps`, optionally a `--qps-curve` sweep):
 instead of firing the whole wave at once (closed-loop, back-pressure
 hides the queueing), jobs arrive by a Poisson process at the target
@@ -402,6 +412,18 @@ def main(argv=None) -> int:
                          "iterations really overlapped on distinct "
                          "lanes (batcher max_concurrent_iterations "
                          ">= 2)")
+    ap.add_argument("--audit-rate", type=float, default=None,
+                    help="arm the identity-audit sentinel at this "
+                         "sampled fraction (RACON_TPU_AUDIT_RATE "
+                         "semantics) and measure its overhead: the "
+                         "bench runs an extra audit-OFF sequential "
+                         "pass on the same warm server and reports the "
+                         "wall delta plus the sentinel's sampled "
+                         "fraction and shadow device seconds in an "
+                         "`audit` artifact block, which "
+                         "tools/perfgate.py gates at the <2% "
+                         "observability budget (and at zero "
+                         "mismatches)")
     ap.add_argument("--json", default=None,
                     help="write the bench-style JSON artifact here")
     ap.add_argument("--fleet", type=int, default=None,
@@ -493,6 +515,8 @@ def main(argv=None) -> int:
             server_kw["iteration_windows"] = args.iteration_windows
         if args.worker_lanes is not None:
             server_kw["worker_lanes"] = args.worker_lanes
+        if args.audit_rate is not None:
+            server_kw["audit_rate"] = args.audit_rate
         servers, clients, journal_paths = [], [], []
         t0 = time.perf_counter()
         for k in range(n_replicas):
@@ -516,7 +540,9 @@ def main(argv=None) -> int:
               f"({server._warm['compiles']} compiles "
               f"{server._warm['compile_s']:.2f}s)", file=sys.stderr)
 
-        # ---- warm sequential: like-for-like vs the cold runs
+        # ---- warm sequential: like-for-like vs the cold runs (with
+        # --audit-rate the sentinel is armed here — its overhead is part
+        # of the measured warm numbers, not hidden from them)
         seq_s: list[float] = []
         seq_results: list = []
         for i in range(cold_n):
@@ -525,6 +551,37 @@ def main(argv=None) -> int:
             seq_s.append(time.perf_counter() - t0)
             print(f"[servebench] warm seq run {i + 1}/{cold_n}: "
                   f"{seq_s[-1]:.2f}s", file=sys.stderr)
+
+        # ---- audit overhead A/B (--audit-rate): the same sequential
+        # workload on the same warm server with the sentinel armed vs
+        # muted, INTERLEAVED (on, off, on, off, ...) so drift in the
+        # host's background load cancels instead of biasing one arm —
+        # the wall delta IS the audit cost (sampling + shadow
+        # re-execution + compare), measured not modeled
+        audit_on_s: list[float] = []
+        audit_off_s: list[float] = []
+        # rate 0 means the server built NO auditor (the flagless
+        # byte-identity posture) — there is nothing to A/B
+        if args.audit_rate and servers[0].auditor is not None:
+            ab_pairs = max(cold_n, 5)
+            for _ in range(ab_pairs):
+                for rate, sink in ((args.audit_rate, audit_on_s),
+                                   (0.0, audit_off_s)):
+                    for srv in servers:
+                        srv.auditor.set_rate(rate)
+                    t0 = time.perf_counter()
+                    r = client.submit(*paths)
+                    sink.append(time.perf_counter() - t0)
+                    if r.fasta != seq_results[0].fasta:
+                        raise SystemExit("[servebench] audit A/B run "
+                                         "diverged from the audited "
+                                         "run")
+            for srv in servers:
+                srv.auditor.set_rate(args.audit_rate)
+            print(f"[servebench] audit A/B ({ab_pairs} interleaved "
+                  f"pairs): on {statistics.mean(audit_on_s):.2f}s vs "
+                  f"off {statistics.mean(audit_off_s):.2f}s mean",
+                  file=sys.stderr)
 
         # ---- warm concurrent wave: the multiplexing story, fully
         # streamed — every wave job asks for live progress AND streamed
@@ -625,6 +682,8 @@ def main(argv=None) -> int:
         # every replica's numbers reach the artifact: the gated SLO
         # counters and batcher activity aggregate across the fleet
         snap = merge_fleet_snaps([s.stats_snapshot() for s in servers])
+        audit_snaps = [s.auditor.snapshot() for s in servers
+                       if s.auditor is not None]
         for srv in servers:
             srv.drain(timeout=30)
 
@@ -717,6 +776,40 @@ def main(argv=None) -> int:
                     f"fleet aggregator saw {unhealthy} unhealthy and "
                     f"{len(poll_errors)} failed polls mid-wave — every "
                     "replica must answer scrape+healthz under load")
+    # ---- audit overhead columns (--audit-rate): sampled fraction,
+    # shadow device seconds, and the measured A/B wall delta — the
+    # number perfgate holds to the <2% observability budget
+    audit_block = None
+    if args.audit_rate is not None and audit_snaps:
+        def _tot(key):
+            return sum(a[key] for a in audit_snaps)
+
+        on_mean = statistics.mean(audit_on_s or seq_s)
+        off_mean = statistics.mean(audit_off_s) if audit_off_s else 0.0
+        overhead_pct = ((on_mean / off_mean - 1.0) * 100.0
+                        if off_mean > 0 else 0.0)
+        audit_block = {
+            "rate": args.audit_rate,
+            "windows": _tot("windows"),
+            "sampled": _tot("sampled"),
+            "sampled_frac": round(_tot("sampled")
+                                  / max(1, _tot("windows")), 4),
+            "audited": _tot("audited"),
+            "mismatches": _tot("mismatches"),
+            "demotions": _tot("demotions"),
+            "repaired": _tot("repaired"),
+            "shadow_s": round(_tot("shadow_s"), 4),
+            "overhead_pct": round(overhead_pct, 3),
+            "ab_runs": len(audit_on_s),
+            "seq_mean_on_s": round(on_mean, 4),
+            "seq_mean_off_s": round(off_mean, 4),
+        }
+        if audit_block["mismatches"]:
+            # a mismatch on this clean synthetic workload is a REAL
+            # silent-corruption (or oracle) bug, never acceptable noise
+            fail.append(f"audit sentinel caught "
+                        f"{audit_block['mismatches']} mismatches on a "
+                        "clean bench workload")
     baseline = None
     if args.baseline:
         try:
@@ -770,6 +863,16 @@ def main(argv=None) -> int:
                  f"{baseline['ttfb_p50_s']:.2f}s"
                  if cand_ttfb and baseline.get("ttfb_p50_s")
                  else ""), file=sys.stderr)
+    if audit_block:
+        print(f"[servebench] audit: rate {audit_block['rate']:g} — "
+              f"{audit_block['sampled']}/{audit_block['windows']} "
+              f"windows sampled "
+              f"({audit_block['sampled_frac'] * 100:.1f}%), shadow "
+              f"{audit_block['shadow_s']:.3f}s, "
+              f"{audit_block['mismatches']} mismatches, overhead "
+              f"{audit_block['overhead_pct']:+.2f}% "
+              f"[{'OK' if audit_block['overhead_pct'] <= 2.0 else 'FAIL'} "
+              "budget 2%]", file=sys.stderr)
     if fleet_block:
         print(f"[servebench] fleet: {n_replicas} replicas, "
               f"{fleet_block['polls']} aggregator polls mid-wave — "
@@ -880,6 +983,8 @@ def main(argv=None) -> int:
                                     if k != "occupancy"}},
             "pass": not fail,
         }
+        if audit_block:
+            artifact["audit"] = audit_block
         if fleet_block:
             artifact["fleet"] = fleet_block
         if openloop:

@@ -17,7 +17,12 @@ scrapes through obs/fleet.py, and redraws one screen per poll:
     fairness dial) from the labeled scrape series;
   - AUTOTUNER activity: winner-table consult counts by (engine,
     decision, dtype) — which kernel plane the fleet is actually
-    dispatching.
+    dispatching;
+  - AUDIT rows (rendered only when a replica exposes the identity-audit
+    families): one cell per replica with the sentinel's sampled/s rate,
+    confirmed mismatches, online winner demotions and the worst lane
+    health, plus [ALERT] while racon_tpu_audit_alert is up — the live
+    silent-data-corruption view.
 
 On a TTY the screen redraws in place; on a pipe it degrades to one
 summary line per poll (greppable, CI-friendly). `--once` polls once
@@ -57,6 +62,29 @@ def _series(parsed, name) -> dict:
     return series
 
 
+def audit_cell(p, prev: dict, dt: float) -> dict | None:
+    """One replica's identity-audit cell from the sentinel's scrape
+    families, or None when the replica doesn't expose them (audit
+    off)."""
+    if p is None or "racon_tpu_audit_sampled_total" not in p.counters:
+        return None
+    sampled = _c(p, "racon_tpu_audit_sampled_total")
+    prev_a = prev.get("audit") or {}
+    rate = ((sampled - prev_a.get("sampled", sampled)) / dt
+            if dt > 0 else 0.0)
+    mism = sum(int(v) for _labels, v in
+               p.counter_series.get("racon_tpu_audit_mismatches_total",
+                                    {}).values())
+    healths = [v for _labels, v in
+               p.gauge_series.get("racon_tpu_lane_health",
+                                  {}).values()]
+    return {"sampled": int(sampled), "sampled_rate": rate,
+            "mismatches": mism,
+            "demotions": int(_c(p, "racon_tpu_audit_demotions_total")),
+            "lane_health_min": min(healths) if healths else 1.0,
+            "alert": bool(p.gauges.get("racon_tpu_audit_alert", 0))}
+
+
 def replica_row(rs, prev: dict, dt: float) -> dict:
     """One replica's console row, with rates from the previous poll."""
     p = rs.parsed
@@ -78,7 +106,8 @@ def replica_row(rs, prev: dict, dt: float) -> dict:
             "iterations": iters, "iter_rate": rate,
             "lanes_busy": lanes_busy, "lanes": lanes_total,
             "compiles": int(_c(p, G + "compiles_total")),
-            "scrape_ms": rs.scrape_s * 1e3}
+            "scrape_ms": rs.scrape_s * 1e3,
+            "audit": audit_cell(p, prev, dt)}
 
 
 def tenant_rows(snap) -> list[dict]:
@@ -120,7 +149,21 @@ def fleet_line(snap, burn: dict, prev: dict, dt: float) -> str:
             f"  burn {burn.get('fast', 0):g}x/{burn.get('slow', 0):g}x"
             f"{' [FIRING]' if burn.get('firing') else ''}"
             f"  iters {int(iters)} ({rate:.1f}/s)"
-            f"  compiles {int(snap.counters.get(G + 'compiles_total', 0))}")
+            f"  compiles {int(snap.counters.get(G + 'compiles_total', 0))}"
+            + _fleet_audit(snap))
+
+
+def _fleet_audit(snap) -> str:
+    """Fleet-level audit suffix (empty when no replica audits): the
+    federated mismatch total plus [AUDIT-ALERT] while any replica's
+    racon_tpu_audit_alert gauge is up."""
+    if "racon_tpu_audit_sampled_total" not in snap.counters:
+        return ""
+    mism = sum(int(v) for _labels, v in snap.counter_series.get(
+        "racon_tpu_audit_mismatches_total", {}).values())
+    return (f"  audit {mism} mism"
+            + ("  [AUDIT-ALERT]"
+               if snap.gauges.get("racon_tpu_audit_alert", 0) else ""))
 
 
 def render_screen(snap, burn: dict, rows: list[dict], prev: dict,
@@ -157,6 +200,18 @@ def render_screen(snap, burn: dict, rows: list[dict], prev: dict,
         lines.append("")
         lines.append("autotune  " + "  ".join(
             f"{tag}={n}" for tag, n in tunes))
+    audit_rows = [(r["endpoint"], r["audit"]) for r in rows
+                  if r.get("audit")]
+    if audit_rows:
+        lines.append("")
+        lines.append(f"{'audit':<36} {'smp/s':>6} {'mism':>5} "
+                     f"{'demot':>5} {'laneh':>6}")
+        for endpoint, a in audit_rows:
+            lines.append(
+                f"{endpoint:<36} {a['sampled_rate']:>6.1f} "
+                f"{a['mismatches']:>5} {a['demotions']:>5} "
+                f"{a['lane_health_min']:>6.2f}"
+                + ("  [ALERT]" if a["alert"] else ""))
     return "\n".join(lines)
 
 
