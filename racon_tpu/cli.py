@@ -18,6 +18,7 @@ HELP = """\
 usage: racon_tpu [options ...] <sequences> <overlaps> <target sequences>
        racon_tpu serve [serve options ...]
        racon_tpu submit [submit options ...] <sequences> <overlaps> <target>
+       racon_tpu router [router options ...]
        racon_tpu fleet [fleet options ...]
 
     subcommands (see `racon_tpu serve --help` / `racon_tpu submit --help`
@@ -36,6 +37,15 @@ usage: racon_tpu [options ...] <sequences> <overlaps> <target sequences>
                 `--tenant` names the fair-scheduling bucket, and
                 `--trace-out t.json` writes one merged client+server
                 Chrome trace of the request
+        router  shard-aware front-end over N warm serve replicas: one
+                submit is split by contig across routable replicas
+                (wrapper partition math, output byte-identical to a
+                solo server), merged back in contig order; a durable
+                journal ledger requeues a dead replica's shards onto
+                healthy ones with streamed contigs deduped (each
+                contig exactly once), and rolling restarts — drain,
+                restart, rejoin on clean healthz — lose no jobs
+                (README "Serving"; RACON_TPU_ROUTER_* env knobs)
         fleet   federate N replicas' metrics and health into one view:
                 polls every endpoint in --endpoints /
                 RACON_TPU_FLEET_ENDPOINTS, merges counters and latency
@@ -414,6 +424,10 @@ def main(argv: list[str] | None = None) -> int:
         from .serve.client import submit_main
 
         return submit_main(argv[1:])
+    if argv and argv[0] == "router":
+        from .serve.router import router_main
+
+        return router_main(argv[1:])
     if argv and argv[0] == "fleet":
         from .obs.fleet import fleet_main
 

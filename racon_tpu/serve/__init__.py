@@ -20,6 +20,11 @@ servers, built from the repo's existing layers:
                   result streaming, graceful SIGTERM drain, per-job
                   failure isolation + obs scoping
     client.py     PolishClient / `racon_tpu submit [--stream]`
+    router.py     PolishRouter / `racon_tpu router`: shard-aware
+                  front-end over N warm replicas — contig-sharded
+                  fan-out (byte-identical merge), journal-backed
+                  requeue on replica loss, rolling restarts without
+                  job loss
 
 CLI: `python -m racon_tpu.cli serve ...` / `... submit ...`;
 benchmarks: tools/servebench.py; failure matrix: tools/faultcheck.py
@@ -30,9 +35,11 @@ from .batcher import WindowBatcher
 from .client import (JobFailed, PolishClient, PolishResult, QueueFull,
                      ServeError, ServerDraining, TenantQuota)
 from .queue import Job, JobQueue
+from .router import PolishRouter, RouterConfig
 from .server import PolishServer, ServeConfig, make_synth_dataset
 
 __all__ = ["WindowBatcher", "PolishClient", "PolishResult", "PolishServer",
+           "PolishRouter", "RouterConfig",
            "ServeConfig", "Job", "JobQueue", "ServeError", "QueueFull",
            "ServerDraining", "TenantQuota", "JobFailed",
            "make_synth_dataset"]

@@ -43,6 +43,7 @@ from __future__ import annotations
 
 import json
 import os
+import random
 import socket
 import sys
 import time
@@ -50,6 +51,24 @@ import uuid
 
 from .protocol import WIRE_LIMIT, recv_frame, send_frame
 from .server import DEFAULT_SOCKET
+
+#: ceiling on any single retry sleep — a server advertising a huge
+#: retry_after must not park a client for minutes
+RETRY_DELAY_CAP_S = 30.0
+
+
+def _retry_delay(retry_after: float, cap: float = RETRY_DELAY_CAP_S,
+                 rng: random.Random | None = None) -> float:
+    """Jittered backoff for full-queue retries: the server's
+    `retry_after` hint spread by ±25% and capped. Every client waiting
+    out the same hint sleeping EXACTLY retry_after would re-submit in
+    one synchronized thundering herd the instant a restarted replica
+    comes back — the jitter de-correlates the storm. Bounds are pinned
+    by test: 0 <= delay <= cap, and within [0.75, 1.25] * hint when the
+    hint is under the cap."""
+    base = min(max(float(retry_after), 0.0), cap)
+    r = (rng or random).random()
+    return min(base * (0.75 + 0.5 * r), cap)
 
 
 class ServeError(Exception):
@@ -93,7 +112,7 @@ _ERROR_TYPES = {"queue-full": QueueFull, "draining": ServerDraining,
 
 class PolishResult:
     __slots__ = ("job_id", "fasta", "metrics", "serve", "trace",
-                 "trace_base_mono", "streamed", "parts")
+                 "trace_base_mono", "streamed", "parts", "router")
 
     def __init__(self, resp: dict):
         self.job_id = resp.get("job_id")
@@ -111,6 +130,10 @@ class PolishResult:
             self.fasta = resp.get("fasta", "").encode("latin-1")
         self.metrics = resp.get("metrics") or {}
         self.serve = resp.get("serve") or {}
+        #: fan-out accounting when the job went through a shard-aware
+        #: router (shards / requeues / parts / wall_s); {} for a direct
+        #: replica submit
+        self.router = resp.get("router") or {}
         self.trace = resp.get("trace")
         #: the server-side recorder's time zero in SERVER perf_counter
         #: terms — merge_trace() needs it to rebase server spans
@@ -294,7 +317,7 @@ class PolishClient:
                 if attempt >= retries:
                     raise
                 attempt += 1
-                time.sleep(exc.retry_after)
+                time.sleep(_retry_delay(exc.retry_after))
 
     def submit_traced(self, sequences: str, overlaps: str, target: str,
                       *, trace_out: str | None = None, on_progress=None,

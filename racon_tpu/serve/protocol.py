@@ -52,6 +52,20 @@ byte-identical to the buffered path). ``pong`` responses carry
 ``mono_s`` (the server's ``time.perf_counter``), the clock-handshake
 sample clients RTT-bracket to merge client- and server-side spans onto
 one timeline.
+
+Child-job fields (router fan-out, serve/router.py): when a shard-aware
+router splits one client submit across replicas, each child ``submit``
+carries ``parent`` (the router-side parent job id), ``shard`` /
+``shards`` (this child's slot in the contig fan-out) and a derived
+``trace_id`` of ``<parent trace>.s<k>`` — the "." is in the trace-id
+charset precisely so child ids stay valid. Replicas journal the three
+fields on the child's ``received`` line for cross-correlation with the
+router's ledger and otherwise ignore them, which also means a child
+submit sent to a pre-router replica is handled as a plain job (unknown
+top-level submit keys are ignored by contract). A router's
+``result_part`` frames add a ``shard`` field and renumber ``part``
+globally in contig order; its final ``result`` adds a ``router`` block
+(``shards`` / ``requeues`` / ``parts`` / ``wall_s``).
 """
 
 from __future__ import annotations

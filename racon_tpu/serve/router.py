@@ -1,0 +1,1131 @@
+"""Shard-aware serve router: one logical polishing service over N warm
+`PolishServer` replicas, surviving the loss of any one of them mid-job.
+
+Everything through the fused serve path still lives in one process on
+one mesh: a crashed server loses every queued and in-flight job, and
+the only scale-out story is the wrapper's cold file-level scatter.
+`PolishRouter` is the replicated serve fabric on top of the existing
+pieces — it speaks the SAME submit frame as a replica (protocol.py), so
+`racon_tpu submit` pointed at a router works unchanged:
+
+  - **Shard fan-out.** A submit's target FASTA is split by CONTIG into
+    `min(routable replicas, contigs)` shards using the wrapper's lo/hi
+    contiguous-block partition math (`wrapper.py` — concatenating shard
+    outputs in shard order reproduces the unsharded output byte for
+    byte, a pinned contract the router inherits: per-contig polishing
+    is independent, so routing whole contigs preserves identity). Each
+    shard goes to a replica as a child job tagged with the parent
+    (``parent`` / ``shard`` / ``shards`` submit keys, child trace id
+    ``<parent>.s<k>``), always with ``stream: true`` so finished
+    contigs flow back the moment they land.
+  - **Contig-order merge.** Replies merge via `ContigStreamer`
+    semantics at shard granularity: shard k's parts are forwarded (or
+    buffered, for a non-streaming client) only once shards 0..k-1 have
+    fully shipped, so the client sees one job in exact target order.
+    The final result frame aggregates the shards' stats and carries a
+    ``router`` block (shards / requeues / parts).
+  - **Journal-backed requeue.** The router keeps its own durable
+    journal (obs/journal.py) as the retry ledger: parent lifecycle
+    lines (received / started / finished / failed) plus annotation
+    events — ``shard-dispatched``, one ``part-routed`` per contig
+    forwarded to the client, ``shard-finished``, ``requeued``,
+    ``replica-down`` / ``replica-up`` (all outside LIFECYCLE_EVENTS,
+    so older journal checkers ignore them). A replica that dies
+    mid-shard — connection drop, kill -9, a healthz that never comes
+    back — gets that shard re-dispatched to a healthy replica; parts
+    the ledger already counted as routed are deduped by position
+    (replica output is deterministic, so the re-run re-streams
+    byte-identical parts and the router skips the first `arrived`
+    ones), and the client sees each contig EXACTLY once.
+  - **Health + rolling restarts.** Replica health rides the PR-12
+    obs/fleet.py machinery: a background `FleetAggregator` poll
+    (healthz + scrape) marks replicas routable / draining / down, and
+    the router's own /metrics federates the replicas' scrapes behind
+    one endpoint plus ``racon_tpu_router_*`` families. `drain` on a
+    replica flips it unroutable (its healthz answers draining/503) —
+    in-flight shards finish there, new shards route elsewhere — and a
+    restarted replica rejoins on its first clean healthz. The router's
+    own healthz reports the live routable count throughout.
+
+Env knobs (all strict-parsed at startup, the --metrics-port
+discipline): RACON_TPU_ROUTER_REPLICAS (comma-separated replica RPC
+endpoints — unix socket paths or localhost host:port; http:// metrics
+bases cannot take submits and are rejected), RACON_TPU_ROUTER_SOCKET /
+RACON_TPU_ROUTER_PORT (the router's own listener),
+RACON_TPU_ROUTER_JOURNAL (retry-ledger path; pair with
+RACON_TPU_JOURNAL_FSYNC=1 for fsync-per-record durability),
+RACON_TPU_ROUTER_METRICS_PORT, RACON_TPU_ROUTER_HEALTH_INTERVAL
+(replica poll seconds, default 2), RACON_TPU_ROUTER_MAX_SHARDS (cap on
+shards per job, default 0 = one per routable replica),
+RACON_TPU_ROUTER_RETRIES (replica losses tolerated per shard, default
+3), RACON_TPU_ROUTER_WAIT_S (how long a shard waits for any routable
+replica before the job fails, default 60).
+
+CLI: ``racon_tpu router --replicas /tmp/a.sock,/tmp/b.sock`` (cli.py);
+benchmarks: ``tools/servebench.py --router N``; failure matrix:
+``tools/faultcheck.py`` router column. See README "Serving" for the
+rolling-restart runbook.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import shutil
+import socket
+import sys
+import tempfile
+import threading
+import time
+
+from ..errors import RaconError
+from ..obs import prom as obs_prom
+from ..obs.fleet import FleetAggregator
+from ..obs.journal import Journal
+from ..utils.logger import log_info
+from .client import (JobFailed, PolishClient, QueueFull, ServeError,
+                     ServerDraining, _retry_delay)
+from .protocol import (ProtocolError, error_response, max_frame_bytes,
+                       recv_frame, send_frame)
+
+DEFAULT_ROUTER_SOCKET = "/tmp/racon_tpu_router.sock"
+
+#: journal annotation events the router emits alongside the parent
+#: job's lifecycle lines. Deliberately OUTSIDE obs.journal's
+#: LIFECYCLE_EVENTS: the consistency checker must ignore them, so an
+#: older obsreport reading a router journal never reds out on them.
+ROUTER_EVENTS = frozenset((
+    "router-start", "router-stop", "shard-dispatched", "shard-finished",
+    "part-routed", "requeued", "replica-down", "replica-up"))
+
+#: trace-id charset (mirrors PolishServer._TRACE_ID_OK — "." is legal,
+#: which is what makes the `<parent>.s<k>` child ids valid replica-side)
+_TRACE_ID_OK = frozenset(
+    "abcdefghijklmnopqrstuvwxyz"
+    "ABCDEFGHIJKLMNOPQRSTUVWXYZ0123456789-_.")
+
+
+def _env_int(name: str, default: int) -> int:
+    raw = os.environ.get(name, "")
+    if not raw:
+        return default
+    try:
+        return int(raw)
+    except ValueError:
+        raise RaconError(
+            "router", f"{name} must be an integer, got {raw!r}") from None
+
+
+def _env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name, "")
+    if not raw:
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        raise RaconError(
+            "router", f"{name} must be a number, got {raw!r}") from None
+
+
+class RouterConfig:
+    """Router knobs; every constructor override has an env twin (module
+    docstring) and parse failures raise NOW, not at the first job."""
+
+    def __init__(self, **kw):
+        replicas = kw.pop("replicas", None)
+        if replicas is None:
+            replicas = os.environ.get("RACON_TPU_ROUTER_REPLICAS", "")
+        if isinstance(replicas, str):
+            replicas = [s.strip() for s in replicas.split(",") if s.strip()]
+        self.replicas = list(replicas)
+        if not self.replicas:
+            raise RaconError(
+                "router", "no replicas configured (pass replicas= / "
+                "--replicas or set RACON_TPU_ROUTER_REPLICAS)")
+        for spec in self.replicas:
+            if spec.startswith(("http://", "https://")):
+                raise RaconError(
+                    "router",
+                    f"replica {spec!r} is an http:// metrics base — "
+                    "the router submits jobs, so replicas must be RPC "
+                    "endpoints (unix socket path or localhost "
+                    "host:port)")
+            if "/" not in spec and os.path.sep not in spec:
+                host = spec.rpartition(":")[0]
+                if host not in ("", "127.0.0.1", "localhost"):
+                    raise RaconError(
+                        "router",
+                        f"replica {spec!r}: TCP replicas must be "
+                        "localhost (the serve transport binds "
+                        "127.0.0.1 only)")
+        self.socket_path = (kw.pop("socket_path", None)
+                            or os.environ.get("RACON_TPU_ROUTER_SOCKET")
+                            or DEFAULT_ROUTER_SOCKET)
+        port = kw.pop("port", None)
+        if port is None:
+            raw = os.environ.get("RACON_TPU_ROUTER_PORT", "")
+            port = _env_int("RACON_TPU_ROUTER_PORT", -1) if raw else None
+        self.port = port
+        self.journal_path = kw.pop("journal", None)
+        if self.journal_path is None:
+            self.journal_path = os.environ.get(
+                "RACON_TPU_ROUTER_JOURNAL", "")
+        mp = kw.pop("metrics_port", None)
+        if mp is None:
+            raw = os.environ.get("RACON_TPU_ROUTER_METRICS_PORT", "")
+            mp = _env_int("RACON_TPU_ROUTER_METRICS_PORT", 0) if raw \
+                else None
+        self.metrics_port = mp
+        hi = kw.pop("health_interval_s", None)
+        self.health_interval_s = (
+            float(hi) if hi is not None
+            else _env_float("RACON_TPU_ROUTER_HEALTH_INTERVAL", 2.0))
+        ms = kw.pop("max_shards", None)
+        self.max_shards = (int(ms) if ms is not None
+                           else _env_int("RACON_TPU_ROUTER_MAX_SHARDS", 0))
+        sr = kw.pop("shard_retries", None)
+        self.shard_retries = (
+            int(sr) if sr is not None
+            else _env_int("RACON_TPU_ROUTER_RETRIES", 3))
+        ws = kw.pop("replica_wait_s", None)
+        self.replica_wait_s = (
+            float(ws) if ws is not None
+            else _env_float("RACON_TPU_ROUTER_WAIT_S", 60.0))
+        pt = kw.pop("probe_timeout_s", None)
+        self.probe_timeout_s = (
+            float(pt) if pt is not None
+            else _env_float("RACON_TPU_ROUTER_PROBE_TIMEOUT", 2.0))
+        self.max_frame = max_frame_bytes()
+        if kw:
+            raise RaconError(
+                "router",
+                f"unknown router option(s): {', '.join(sorted(kw))}")
+
+    @property
+    def address(self) -> str:
+        if self.port is not None:
+            return f"127.0.0.1:{self.port}"
+        return self.socket_path
+
+
+class ReplicaState:
+    """One replica's live routing state. `ok`/`draining` come from the
+    fleet poll (the authority); `down_forced` bridges the gap between
+    polls when a submit observed the replica dead — cleared by the next
+    poll, which re-probes for real."""
+
+    def __init__(self, spec: str):
+        self.spec = spec
+        self.ok = True  # optimistic until the first poll lands
+        self.draining = False
+        self.down_forced = False
+        self.error: str | None = None
+        self.inflight = 0  # shards currently dispatched here
+
+    @property
+    def routable(self) -> bool:
+        return self.ok and not self.draining and not self.down_forced
+
+    def client(self, timeout: float | None = None) -> PolishClient:
+        if "/" in self.spec or os.path.sep in self.spec:
+            return PolishClient(socket_path=self.spec, timeout=timeout)
+        port = int(self.spec.rpartition(":")[2])
+        return PolishClient(port=port, timeout=timeout)
+
+
+class _ShardFailure(Exception):
+    """Internal: a shard (and therefore the parent job) failed typed."""
+
+    def __init__(self, code: str, message: str, **extra):
+        super().__init__(message)
+        self.code = code
+        self.extra = extra
+
+
+class _JobMerge:
+    """Per-job merge + dedupe ledger: buffers each shard's streamed
+    parts, forwards them in global contig order (shard k only after
+    shards 0..k-1 fully shipped — ContigStreamer semantics one level
+    up), and dedupes a requeued shard's re-streamed parts by position
+    (`arrived` counts the CURRENT attempt; anything below the buffered
+    length is a byte-identical duplicate and is skipped)."""
+
+    def __init__(self, n_shards: int, emit_part=None, on_routed=None):
+        self.lock = threading.Lock()
+        self.parts: list[list[tuple[str | None, str]]] = [
+            [] for _ in range(n_shards)]
+        self.arrived = [0] * n_shards
+        self.done = [False] * n_shards
+        self.results: list[dict | None] = [None] * n_shards
+        self.failure: _ShardFailure | None = None
+        self._emit_part = emit_part
+        self._on_routed = on_routed
+        self._cursor_shard = 0
+        self._cursor_part = 0
+        self.total_routed = 0
+
+    def on_part(self, k: int, frame: dict) -> None:
+        with self.lock:
+            idx = self.arrived[k]
+            self.arrived[k] += 1
+            if idx < len(self.parts[k]):
+                return  # requeued re-run duplicate: ledger dedupe
+            self.parts[k].append(
+                (frame.get("name"), frame.get("fasta", "")))
+            self._pump_locked()
+
+    def shard_done(self, k: int, resp: dict) -> None:
+        with self.lock:
+            self.done[k] = True
+            self.results[k] = resp
+            self._pump_locked()
+
+    def requeue(self, k: int) -> None:
+        with self.lock:
+            self.arrived[k] = 0  # the re-run streams from its contig 0
+
+    def fail(self, failure: _ShardFailure) -> None:
+        with self.lock:
+            if self.failure is None:
+                self.failure = failure
+
+    def _pump_locked(self) -> None:
+        n = len(self.parts)
+        while self._cursor_shard < n:
+            k = self._cursor_shard
+            while self._cursor_part < len(self.parts[k]):
+                name, fasta = self.parts[k][self._cursor_part]
+                part_index = self.total_routed
+                self.total_routed += 1
+                self._cursor_part += 1
+                if self._on_routed is not None:
+                    self._on_routed(k, part_index, name, len(fasta))
+                if self._emit_part is not None:
+                    self._emit_part(k, part_index, name, fasta)
+            if not self.done[k]:
+                return
+            self._cursor_shard += 1
+            self._cursor_part = 0
+
+    def fasta(self) -> str:
+        """The merged body (latin-1 text, as it rides the wire)."""
+        with self.lock:
+            return "".join(fasta for shard in self.parts
+                           for _name, fasta in shard)
+
+
+class PolishRouter:
+    """The replicated serve front-end (module docstring). Mirrors
+    PolishServer's transport shape — same frame protocol, same
+    accept/handle/dispatch skeleton, same typed-error discipline — but
+    executes nothing itself: every submit fans out to replicas."""
+
+    def __init__(self, config: RouterConfig | None = None, **overrides):
+        self.config = config if config is not None \
+            else RouterConfig(**overrides)
+        cfg = self.config
+        self.replicas = [ReplicaState(s) for s in cfg.replicas]
+        #: PR-12 reuse: the fleet aggregator IS the health poller and
+        #: the scrape federation source behind the router's /metrics
+        self.fleet = FleetAggregator(cfg.replicas,
+                                     timeout_s=cfg.probe_timeout_s)
+        self.journal: Journal | None = None
+        self._listener: socket.socket | None = None
+        self._http = None
+        self._threads: list[threading.Thread] = []
+        self._conns: set[socket.socket] = set()
+        self._conn_lock = threading.Lock()
+        self._state_lock = threading.Lock()
+        self._job_seq = 0
+        self._inflight_jobs = 0
+        self._requeued_outstanding = 0
+        self._draining = threading.Event()
+        self._stopped = threading.Event()
+        self._t_start = time.perf_counter()
+        self.counters = {"jobs_submitted": 0, "jobs_completed": 0,
+                         "jobs_failed": 0, "shards_dispatched": 0,
+                         "parts_routed": 0, "requeues": 0}
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self) -> "PolishRouter":
+        cfg = self.config
+        if cfg.journal_path:
+            try:
+                self.journal = Journal(cfg.journal_path)
+            except OSError as exc:
+                raise RaconError(
+                    "router",
+                    f"cannot open router journal {cfg.journal_path!r} "
+                    f"({exc}); point --journal / "
+                    "RACON_TPU_ROUTER_JOURNAL at a writable path") \
+                    from None
+        # first poll before accepting: replica state starts honest, not
+        # optimistic (a dead replica configured at startup is already
+        # unroutable when the first submit arrives)
+        self._apply_poll(self.fleet.poll())
+        if cfg.metrics_port is not None:
+            self._start_metrics_http()
+        if cfg.port is not None:
+            lst = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            lst.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            lst.bind(("127.0.0.1", max(0, cfg.port)))
+            if cfg.port <= 0:
+                cfg.port = lst.getsockname()[1]
+        else:
+            with contextlib.suppress(OSError):
+                os.unlink(cfg.socket_path)
+            lst = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            lst.bind(cfg.socket_path)
+        lst.listen(64)
+        lst.settimeout(0.2)
+        self._listener = lst
+        for target, name in ((self._accept_loop, "racon-tpu-router-accept"),
+                             (self._health_loop, "racon-tpu-router-health")):
+            t = threading.Thread(target=target, name=name, daemon=True)
+            t.start()
+            self._threads.append(t)
+        if self.journal is not None:
+            self.journal.record("router-start", address=cfg.address,
+                                pid=os.getpid(),
+                                replicas=len(self.replicas))
+        log_info(f"[racon_tpu::router] routing on {cfg.address} over "
+                 f"{len(self.replicas)} replica(s), "
+                 f"{self._routable_count()} routable"
+                 + (f", metrics on 127.0.0.1:{cfg.metrics_port}"
+                    if self._http is not None else "")
+                 + (f", journal {cfg.journal_path}"
+                    if self.journal is not None else ""))
+        return self
+
+    def drain(self, timeout: float = 30.0) -> bool:
+        """Stop admitting, let in-flight fan-outs finish (bounded by
+        `timeout`), close the transport and the journal."""
+        if self._draining.is_set():
+            self._stopped.wait()
+            return True
+        self._draining.set()
+        if self._listener is not None:
+            with contextlib.suppress(OSError):
+                self._listener.close()
+        deadline = time.monotonic() + timeout
+        clean = True
+        while time.monotonic() < deadline:
+            with self._state_lock:
+                if self._inflight_jobs == 0:
+                    break
+            time.sleep(0.05)
+        else:
+            clean = False
+        for t in self._threads:
+            if t is not threading.current_thread():
+                t.join(timeout=2.0)
+        if self._http is not None:
+            with contextlib.suppress(Exception):
+                self._http.shutdown()
+                self._http.server_close()
+            self._http = None
+        with self._conn_lock:
+            conns = list(self._conns)
+        for c in conns:
+            with contextlib.suppress(OSError):
+                c.shutdown(socket.SHUT_RDWR)
+            with contextlib.suppress(OSError):
+                c.close()
+        if self.config.port is None:
+            with contextlib.suppress(OSError):
+                os.unlink(self.config.socket_path)
+        self.fleet.close()
+        if self.journal is not None:
+            self.journal.record(
+                "router-stop", clean=clean,
+                completed=self.counters["jobs_completed"],
+                failed=self.counters["jobs_failed"],
+                requeues=self.counters["requeues"])
+            self.journal.close()
+        self._stopped.set()
+        return clean
+
+    # --------------------------------------------------------------- health
+    def _health_loop(self) -> None:
+        while not self._draining.is_set():
+            self._draining.wait(self.config.health_interval_s)
+            if self._draining.is_set():
+                return
+            with contextlib.suppress(Exception):
+                self._apply_poll(self.fleet.poll())
+
+    def _apply_poll(self, snap) -> None:
+        by_spec = {rs.endpoint: rs for rs in snap.replicas}
+        with self._state_lock:
+            for r in self.replicas:
+                rs = by_spec.get(r.spec)
+                if rs is None:
+                    continue
+                was = r.routable
+                r.ok = rs.ok
+                r.draining = rs.draining
+                r.error = rs.error
+                # the poll re-probed for real: it overrides any
+                # submit-observed failure either way
+                r.down_forced = False
+                now = r.routable
+                if was != now and self.journal is not None:
+                    self.journal.record(
+                        "replica-up" if now else "replica-down",
+                        replica=r.spec,
+                        draining=r.draining or None,
+                        error=r.error)
+                if was != now:
+                    log_info(f"[racon_tpu::router] replica {r.spec} "
+                             + ("rejoined"
+                                if now else
+                                ("draining" if r.draining
+                                 else f"down ({r.error})")))
+
+    def _routable_count(self) -> int:
+        with self._state_lock:
+            return sum(1 for r in self.replicas if r.routable)
+
+    def _pick_replica(self, exclude: set) -> ReplicaState | None:
+        """Least-inflight routable replica, preferring ones the shard
+        has not failed on yet; claims an inflight slot under the lock."""
+        with self._state_lock:
+            cands = [r for r in self.replicas
+                     if r.routable and r.spec not in exclude]
+            if not cands:
+                cands = [r for r in self.replicas if r.routable]
+            if not cands:
+                return None
+            best = min(cands, key=lambda r: r.inflight)
+            best.inflight += 1
+            return best
+
+    def _release_replica(self, r: ReplicaState) -> None:
+        with self._state_lock:
+            r.inflight = max(0, r.inflight - 1)
+
+    # -------------------------------------------------------------- serving
+    def _accept_loop(self) -> None:
+        while not self._draining.is_set():
+            try:
+                conn, _ = self._listener.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            conn.settimeout(None)
+            with self._conn_lock:
+                self._conns.add(conn)
+            t = threading.Thread(target=self._handle, args=(conn,),
+                                 name="racon-tpu-router-conn",
+                                 daemon=True)
+            t.start()
+
+    def _handle(self, conn: socket.socket) -> None:
+        send_lock = threading.Lock()
+        try:
+            while True:
+                try:
+                    req = recv_frame(conn, self.config.max_frame)
+                except ProtocolError as exc:
+                    with contextlib.suppress(OSError):
+                        send_frame(conn,
+                                   error_response(exc.code, str(exc)))
+                    if not exc.resync:
+                        return
+                    continue
+                except OSError:
+                    return
+                if req is None:
+                    return
+                try:
+                    resp = self._dispatch(req, conn, send_lock)
+                except Exception as exc:  # noqa: BLE001 — typed answer
+                    resp = error_response(
+                        "internal", f"{type(exc).__name__}: {exc}")
+                try:
+                    with send_lock:
+                        send_frame(conn, resp)
+                except ProtocolError as exc:
+                    with contextlib.suppress(OSError):
+                        send_frame(conn,
+                                   error_response(exc.code, str(exc)))
+                except OSError:
+                    return
+        finally:
+            with self._conn_lock:
+                self._conns.discard(conn)
+            with contextlib.suppress(OSError):
+                conn.close()
+
+    def _dispatch(self, req: dict, conn: socket.socket,
+                  send_lock: threading.Lock) -> dict:
+        rtype = req.get("type")
+        if rtype == "submit":
+            return self._submit(req, conn, send_lock)
+        if rtype == "ping":
+            return {"type": "pong", "router": True,
+                    "replicas": len(self.replicas),
+                    "routable": self._routable_count(),
+                    "uptime_s": round(
+                        time.perf_counter() - self._t_start, 3),
+                    "mono_s": time.perf_counter()}
+        if rtype == "healthz":
+            return dict(self.healthz_snapshot(), type="healthz")
+        if rtype == "stats":
+            return dict(self.stats_snapshot(), type="stats")
+        if rtype == "scrape":
+            return {"type": "metrics",
+                    "content_type": obs_prom.CONTENT_TYPE,
+                    "text": self.prometheus_text()}
+        if rtype == "shutdown":
+            threading.Thread(target=self.drain,
+                             name="racon-tpu-router-drain",
+                             daemon=True).start()
+            return {"type": "ok", "message": "draining"}
+        return error_response("bad-request",
+                              f"unknown request type {rtype!r}")
+
+    def healthz_snapshot(self) -> dict:
+        with self._state_lock:
+            routable = sum(1 for r in self.replicas if r.routable)
+            draining = sum(1 for r in self.replicas if r.draining)
+            down = sum(1 for r in self.replicas
+                       if not r.ok or r.down_forced)
+            outstanding = self._requeued_outstanding
+            inflight = self._inflight_jobs
+        self_draining = self._draining.is_set()
+        return {"ok": routable > 0 and not self_draining,
+                "draining": self_draining,
+                "router": True,
+                "replicas": len(self.replicas),
+                "routable": routable,
+                "replicas_draining": draining,
+                "replicas_down": down,
+                "requeued_outstanding": outstanding,
+                "inflight": inflight,
+                "uptime_s": round(
+                    time.perf_counter() - self._t_start, 3)}
+
+    def stats_snapshot(self) -> dict:
+        with self._state_lock:
+            replicas = [{"endpoint": r.spec, "ok": r.ok,
+                         "draining": r.draining,
+                         "down_forced": r.down_forced,
+                         "inflight": r.inflight, "error": r.error}
+                        for r in self.replicas]
+            counters = dict(self.counters)
+            counters["requeued_outstanding"] = self._requeued_outstanding
+        return {"router": dict(counters,
+                               inflight_jobs=self._inflight_jobs,
+                               uptime_s=round(
+                                   time.perf_counter() - self._t_start,
+                                   3)),
+                "replicas": replicas}
+
+    def prometheus_text(self) -> str:
+        """The router's /metrics body: the replicas' scrapes federated
+        through the fleet aggregator (counters/gauges summed, histogram
+        buckets pooled — the PR-12 merge), plus the router's own
+        ``racon_tpu_router_*`` families."""
+        body = ""
+        with contextlib.suppress(Exception):
+            body = self.fleet.prometheus_text()
+        with self._state_lock:
+            counters = {
+                "router.jobs.submitted": self.counters["jobs_submitted"],
+                "router.jobs.completed": self.counters["jobs_completed"],
+                "router.jobs.failed": self.counters["jobs_failed"],
+                "router.shards_dispatched": (
+                    self.counters["shards_dispatched"],
+                    "child jobs sent to replicas (requeues re-count)"),
+                "router.parts_routed": (
+                    self.counters["parts_routed"],
+                    "contigs forwarded to clients exactly once (the "
+                    "requeue dedupe ledger's routed count)"),
+                "router.requeues": (
+                    self.counters["requeues"],
+                    "shards re-dispatched after a replica loss"),
+            }
+            gauges = {
+                "router.replicas": (
+                    len(self.replicas), "configured replicas"),
+                "router.replicas_routable": (
+                    sum(1 for r in self.replicas if r.routable),
+                    "replicas accepting new shards at the last probe"),
+                "router.replicas_draining": sum(
+                    1 for r in self.replicas if r.draining),
+                "router.requeued_outstanding": (
+                    self._requeued_outstanding,
+                    "requeued shards not yet re-completed"),
+                "router.inflight_jobs": self._inflight_jobs,
+                "router.uptime_seconds": round(
+                    time.perf_counter() - self._t_start, 3),
+            }
+        return body + obs_prom.render(counters, gauges)
+
+    def _start_metrics_http(self) -> None:
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        router = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def do_GET(self):
+                try:
+                    path = self.path.split("?", 1)[0]
+                    if path in ("/metrics", "/"):
+                        body = router.prometheus_text().encode()
+                        self.send_response(200)
+                        self.send_header("Content-Type",
+                                         obs_prom.CONTENT_TYPE)
+                    elif path == "/healthz":
+                        doc = router.healthz_snapshot()
+                        body = (json.dumps(doc, sort_keys=True)
+                                + "\n").encode()
+                        self.send_response(200 if doc["ok"] else 503)
+                        self.send_header("Content-Type",
+                                         "application/json")
+                    else:
+                        self.send_error(404)
+                        return
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                except Exception as exc:  # noqa: BLE001
+                    with contextlib.suppress(Exception):
+                        self.send_error(
+                            500, f"{type(exc).__name__}: {exc}")
+
+            def log_message(self, *args):
+                pass
+
+        httpd = ThreadingHTTPServer(
+            ("127.0.0.1", max(0, self.config.metrics_port)), _Handler)
+        httpd.daemon_threads = True
+        self.config.metrics_port = httpd.server_address[1]
+        self._http = httpd
+        t = threading.Thread(target=httpd.serve_forever,
+                             name="racon-tpu-router-metrics-http",
+                             daemon=True)
+        t.start()
+
+    # --------------------------------------------------------------- submit
+    def _read_target_contigs(self, path: str) -> list:
+        from ..io.parsers import create_sequence_parser
+
+        parser = create_sequence_parser(path, "router")
+        contigs: list = []
+        parser.parse(contigs, -1)
+        return contigs
+
+    @staticmethod
+    def _write_shard_targets(contigs: list, n_shards: int,
+                             workdir: str) -> list[str]:
+        """The wrapper's lo/hi contiguous-block partition over whole
+        contigs (wrapper.py — shard outputs concatenated in shard order
+        are byte-identical to the unsharded run)."""
+        fastq = any(getattr(c, "quality", b"") for c in contigs)
+        paths = []
+        for k in range(n_shards):
+            lo = k * len(contigs) // n_shards
+            hi = (k + 1) * len(contigs) // n_shards
+            ext = "fastq" if fastq else "fasta"
+            path = os.path.join(workdir, f"shard_{k}.{ext}")
+            with open(path, "wb") as fh:
+                for c in contigs[lo:hi]:
+                    if fastq:
+                        qual = getattr(c, "quality", b"") \
+                            or b"!" * len(c.data)
+                        fh.write(b"@" + c.name.encode() + b"\n"
+                                 + c.data + b"\n+\n" + qual + b"\n")
+                    else:
+                        fh.write(b">" + c.name.encode() + b"\n"
+                                 + c.data + b"\n")
+            paths.append(path)
+        return paths
+
+    def _submit(self, req: dict, conn: socket.socket,
+                send_lock: threading.Lock) -> dict:
+        for key in ("sequences", "overlaps", "target"):
+            path = req.get(key)
+            if not isinstance(path, str) or not path:
+                return error_response("bad-request",
+                                      f"missing input path {key!r}")
+            if not os.path.isfile(path):
+                return error_response(
+                    "bad-request", f"{key} file not found: {path}")
+        trace_id = req.get("trace_id")
+        if trace_id is not None and (
+                not isinstance(trace_id, str)
+                or not 0 < len(trace_id) <= 64
+                or not set(trace_id) <= _TRACE_ID_OK):
+            return error_response(
+                "bad-request",
+                "trace_id must be 1-64 chars of [A-Za-z0-9._-]")
+        if self._draining.is_set():
+            return error_response("draining", "router is draining")
+        with self._state_lock:
+            self._job_seq += 1
+            job_id = f"r{self._job_seq}"
+            self.counters["jobs_submitted"] += 1
+            self._inflight_jobs += 1
+        want_stream = bool(req.get("stream"))
+        want_progress = bool(req.get("progress"))
+        t0 = time.perf_counter()
+        if self.journal is not None:
+            self.journal.record("received", job=job_id, trace=trace_id,
+                                tenant=req.get("tenant"),
+                                target=req.get("target"))
+            # "started" immediately: parsing the target IS the router's
+            # work, and any failure from here on must legally pair
+            # started -> failed under the journal consistency checker
+            self.journal.record("started", job=job_id, trace=trace_id)
+        workdir = None
+        try:
+            try:
+                contigs = self._read_target_contigs(req["target"])
+            except (RaconError, OSError) as exc:
+                if self.journal is not None:
+                    self.journal.record("failed", job=job_id,
+                                        trace=trace_id,
+                                        code="bad-request",
+                                        message="unreadable target")
+                with self._state_lock:
+                    self.counters["jobs_failed"] += 1
+                return error_response(
+                    "bad-request", f"cannot parse target: {exc}",
+                    job_id=job_id)
+            n_routable = self._routable_count()
+            n_shards = max(1, min(n_routable, len(contigs)))
+            if self.config.max_shards > 0:
+                n_shards = min(n_shards, self.config.max_shards)
+            if n_shards > 1:
+                workdir = tempfile.mkdtemp(
+                    prefix=f"racon_tpu_router_{job_id}_")
+                shard_targets = self._write_shard_targets(
+                    contigs, n_shards, workdir)
+            else:
+                shard_targets = [req["target"]]
+            del contigs  # the shard files own the bytes now
+            requeues_before = self.counters["requeues"]
+            emit_part = None
+            if want_stream:
+                def emit_part(k, part_index, name, fasta):
+                    frame = {"type": "result_part", "job_id": job_id,
+                             "part": part_index, "name": name,
+                             "fasta": fasta, "shard": k}
+                    if trace_id:
+                        frame["trace_id"] = trace_id
+                    try:
+                        with send_lock:
+                            send_frame(conn, frame)
+                    except (ProtocolError, OSError):
+                        pass  # client gone: shards still finish
+
+            def on_routed(k, part_index, name, nbytes):
+                with self._state_lock:
+                    self.counters["parts_routed"] += 1
+                if self.journal is not None:
+                    self.journal.record("part-routed", job=job_id,
+                                        trace=trace_id, shard=k,
+                                        part=part_index, name=name,
+                                        bytes=nbytes)
+
+            merge = _JobMerge(n_shards, emit_part=emit_part,
+                              on_routed=on_routed)
+            threads = []
+            for k in range(n_shards):
+                t = threading.Thread(
+                    target=self._run_shard,
+                    args=(req, job_id, trace_id, k, n_shards,
+                          shard_targets[k], merge, conn, send_lock,
+                          want_progress),
+                    name=f"racon-tpu-router-{job_id}-s{k}", daemon=True)
+                t.start()
+                threads.append(t)
+            for t in threads:
+                t.join()
+
+            if merge.failure is not None:
+                f = merge.failure
+                if self.journal is not None:
+                    self.journal.record("failed", job=job_id,
+                                        trace=trace_id, code=f.code,
+                                        message=str(f))
+                with self._state_lock:
+                    self.counters["jobs_failed"] += 1
+                return error_response(f.code, str(f), job_id=job_id,
+                                      **f.extra)
+
+            wall_s = time.perf_counter() - t0
+            job_requeues = self.counters["requeues"] - requeues_before
+            queue_wait = 0.0
+            exec_max = 0.0
+            metrics: dict = {}
+            for resp in merge.results:
+                serve = (resp or {}).get("serve") or {}
+                queue_wait = max(queue_wait,
+                                 float(serve.get("queue_wait_s", 0.0)))
+                exec_max = max(exec_max,
+                               float(serve.get("exec_s", 0.0)))
+                for mk, mv in ((resp or {}).get("metrics") or {}).items():
+                    if isinstance(mv, (int, float)):
+                        metrics[mk] = metrics.get(mk, 0) + mv
+            out = {"type": "result", "job_id": job_id,
+                   "serve": {"queue_wait_s": round(queue_wait, 4),
+                             "exec_s": round(exec_max, 4)},
+                   "router": {"shards": n_shards,
+                              "replicas": n_routable,
+                              "requeues": job_requeues,
+                              "parts": merge.total_routed,
+                              "wall_s": round(wall_s, 4),
+                              "shard_exec_max_s": round(exec_max, 4)}}
+            if trace_id:
+                out["trace_id"] = trace_id
+            if metrics:
+                out["metrics"] = metrics
+            if want_stream:
+                out["streamed"] = True
+                out["parts"] = merge.total_routed
+            else:
+                out["fasta"] = merge.fasta()
+            if self.journal is not None:
+                self.journal.record("finished", job=job_id,
+                                    trace=trace_id, shards=n_shards,
+                                    parts=merge.total_routed,
+                                    requeues=job_requeues,
+                                    wall_s=round(wall_s, 4))
+            with self._state_lock:
+                self.counters["jobs_completed"] += 1
+            return out
+        finally:
+            with self._state_lock:
+                self._inflight_jobs = max(0, self._inflight_jobs - 1)
+            if workdir is not None:
+                shutil.rmtree(workdir, ignore_errors=True)
+
+    def _run_shard(self, req: dict, job_id: str, trace_id: str | None,
+                   k: int, n_shards: int, shard_target: str,
+                   merge: _JobMerge, conn: socket.socket,
+                   send_lock: threading.Lock,
+                   want_progress: bool) -> None:
+        """One shard's dispatch loop: submit to the least-loaded
+        routable replica, stream parts into the merge, and on replica
+        loss requeue to a healthy one (journal-backed, dedupe by the
+        merge ledger) up to `shard_retries` times."""
+        child: dict = {"type": "submit",
+                       "sequences": req["sequences"],
+                       "overlaps": req["overlaps"],
+                       "target": shard_target,
+                       "stream": True,
+                       "parent": job_id, "shard": k, "shards": n_shards,
+                       "trace_id": f"{trace_id or job_id}.s{k}"}
+        for key in ("options", "priority", "deadline_s", "fault_plan",
+                    "strict", "tenant"):
+            if req.get(key) is not None:
+                child[key] = req[key]
+        if want_progress:
+            child["progress"] = True
+
+        def on_progress(frame):
+            fwd = dict(frame, job_id=job_id, shard=k)
+            try:
+                with send_lock:
+                    send_frame(conn, fwd)
+            except (ProtocolError, OSError):
+                pass
+
+        losses = 0
+        busy_waits = 0
+        requeued_pending = False
+        exclude: set[str] = set()
+        wait_deadline = time.monotonic() + self.config.replica_wait_s
+
+        def settle():
+            if requeued_pending:
+                with self._state_lock:
+                    self._requeued_outstanding = max(
+                        0, self._requeued_outstanding - 1)
+
+        while True:
+            replica = self._pick_replica(exclude)
+            if replica is None:
+                if time.monotonic() < wait_deadline \
+                        and not self._draining.is_set():
+                    time.sleep(0.1)
+                    continue
+                merge.fail(_ShardFailure(
+                    "no-replica",
+                    f"shard {k}: no routable replica within "
+                    f"{self.config.replica_wait_s:g}s"))
+                settle()
+                return
+            with self._state_lock:
+                self.counters["shards_dispatched"] += 1
+            if self.journal is not None:
+                self.journal.record("shard-dispatched", job=job_id,
+                                    trace=trace_id, shard=k,
+                                    replica=replica.spec,
+                                    attempt=losses + busy_waits)
+            lost = False
+            try:
+                resp = replica.client().request(
+                    child,
+                    on_part=lambda f: merge.on_part(k, f),
+                    on_progress=on_progress if want_progress else None)
+                merge.shard_done(k, resp)
+                if self.journal is not None:
+                    self.journal.record(
+                        "shard-finished", job=job_id, trace=trace_id,
+                        shard=k, replica=replica.spec,
+                        parts=len(resp.get("_parts") or ()))
+                settle()
+                return
+            except JobFailed as exc:
+                merge.fail(_ShardFailure(
+                    "job-failed", f"shard {k}: {exc}",
+                    error_type=exc.error_type))
+                settle()
+                return
+            except ServerDraining:
+                # rolling restart in progress: this replica stopped
+                # admitting — route the shard elsewhere, no loss
+                exclude.add(replica.spec)
+                continue
+            except QueueFull as exc:
+                busy_waits += 1
+                if busy_waits > 50:
+                    merge.fail(_ShardFailure(
+                        "queue-full",
+                        f"shard {k}: replicas stayed full"))
+                    settle()
+                    return
+                time.sleep(_retry_delay(exc.retry_after))
+                continue
+            except ServeError as exc:
+                if exc.code == "closed":
+                    lost = True
+                else:
+                    merge.fail(_ShardFailure(
+                        exc.code, f"shard {k}: {exc}"))
+                    settle()
+                    return
+            except (ProtocolError, OSError):
+                lost = True
+            finally:
+                self._release_replica(replica)
+            if not lost:
+                return  # unreachable, but keeps the loop shape honest
+            # ---- replica loss: mark down, requeue with ledger dedupe
+            with self._state_lock:
+                replica.down_forced = True
+            if self.journal is not None:
+                self.journal.record("replica-down", replica=replica.spec,
+                                    job=job_id, shard=k)
+            log_info(f"[racon_tpu::router] replica {replica.spec} lost "
+                     f"mid-shard ({job_id} shard {k})")
+            losses += 1
+            if losses > self.config.shard_retries:
+                merge.fail(_ShardFailure(
+                    "replica-lost",
+                    f"shard {k}: lost {losses} replicas "
+                    f"(retry limit {self.config.shard_retries})"))
+                settle()
+                return
+            with self._state_lock:
+                self.counters["requeues"] += 1
+                if not requeued_pending:
+                    self._requeued_outstanding += 1
+                    requeued_pending = True
+            if self.journal is not None:
+                self.journal.record("requeued", job=job_id,
+                                    trace=trace_id, shard=k,
+                                    from_replica=replica.spec)
+            merge.requeue(k)
+            exclude.add(replica.spec)
+            wait_deadline = time.monotonic() + self.config.replica_wait_s
+
+
+# ------------------------------------------------------------------ CLI
+def router_main(argv: list[str]) -> int:
+    """`racon_tpu router` entry point: run a PolishRouter until
+    SIGTERM / SIGINT, then drain."""
+    import argparse
+    import signal
+
+    ap = argparse.ArgumentParser(
+        prog="racon_tpu router",
+        description="shard-aware front-end over N warm `racon_tpu "
+                    "serve` replicas: contig-sharded fan-out, "
+                    "journal-backed requeue on replica loss, rolling "
+                    "restarts without job loss (README 'Serving')")
+    ap.add_argument("--replicas", default=None,
+                    help="comma-separated replica RPC endpoints — unix "
+                         "socket paths or localhost host:port "
+                         "(RACON_TPU_ROUTER_REPLICAS)")
+    ap.add_argument("--socket", default=None,
+                    help=f"router unix socket (RACON_TPU_ROUTER_SOCKET, "
+                         f"default {DEFAULT_ROUTER_SOCKET})")
+    ap.add_argument("--port", type=int, default=None,
+                    help="listen on localhost TCP instead "
+                         "(RACON_TPU_ROUTER_PORT; 0 = ephemeral)")
+    ap.add_argument("--journal", default=None,
+                    help="durable JSONL retry ledger + lifecycle "
+                         "journal (RACON_TPU_ROUTER_JOURNAL; pair with "
+                         "RACON_TPU_JOURNAL_FSYNC=1 for per-record "
+                         "fsync; an unwritable path fails the start)")
+    ap.add_argument("--metrics-port", type=int, default=None,
+                    help="federated /metrics + /healthz over the "
+                         "replicas plus racon_tpu_router_* families "
+                         "(RACON_TPU_ROUTER_METRICS_PORT; 0 = "
+                         "ephemeral)")
+    ap.add_argument("--health-interval", type=float, default=None,
+                    help="replica healthz/scrape poll seconds "
+                         "(RACON_TPU_ROUTER_HEALTH_INTERVAL, default "
+                         "2)")
+    ap.add_argument("--max-shards", type=int, default=None,
+                    help="cap shards per job "
+                         "(RACON_TPU_ROUTER_MAX_SHARDS, default 0 = "
+                         "one per routable replica)")
+    ap.add_argument("--shard-retries", type=int, default=None,
+                    help="replica losses tolerated per shard before "
+                         "the job fails (RACON_TPU_ROUTER_RETRIES, "
+                         "default 3)")
+    args = ap.parse_args(argv)
+
+    kw: dict = {}
+    if args.replicas is not None:
+        kw["replicas"] = args.replicas
+    if args.socket is not None:
+        kw["socket_path"] = args.socket
+    if args.port is not None:
+        kw["port"] = args.port
+    if args.journal is not None:
+        kw["journal"] = args.journal
+    if args.metrics_port is not None:
+        kw["metrics_port"] = args.metrics_port
+    if args.health_interval is not None:
+        kw["health_interval_s"] = args.health_interval
+    if args.max_shards is not None:
+        kw["max_shards"] = args.max_shards
+    if args.shard_retries is not None:
+        kw["shard_retries"] = args.shard_retries
+
+    try:
+        router = PolishRouter(**kw).start()
+    except (RaconError, OSError, ValueError) as exc:
+        print(f"[racon_tpu::router] error: {exc}", file=sys.stderr)
+        return 1
+
+    stop = threading.Event()
+
+    def _on_signal(signum, frame):
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _on_signal)
+    signal.signal(signal.SIGINT, _on_signal)
+    while not stop.is_set() and not router._stopped.is_set():
+        stop.wait(0.2)
+    router.drain()
+    return 0

@@ -327,14 +327,18 @@ class ServeConfig:
 
 def make_synth_dataset(dirname: str, seed: int = 11,
                        genome_len: int = 2000, read_len: int = 400,
-                       step: int = 100) -> tuple[str, str, str]:
+                       step: int = 100,
+                       contigs: int = 1) -> tuple[str, str, str]:
     """Tiny deterministic ONT-shaped dataset (reads/PAF/draft gz
     triple) — the warmup job's input, also reused by servebench and the
     serve tests. Overlength pairs are included so the device-aligner
-    fallback path warms too."""
+    fallback path warms too. `contigs` > 1 emits that many independent
+    draft contigs (each with its own reads and PAF rows) for the
+    multi-contig streaming / router-sharding tests; `contigs` == 1 is
+    byte-identical to what this function always produced (same rng call
+    order, same `draft` / `r{k}` names)."""
     rng = random.Random(seed)
     acgt = b"ACGT"
-    truth = bytes(rng.choice(acgt) for _ in range(genome_len))
 
     def mutate(s, rate):
         out = bytearray()
@@ -352,18 +356,23 @@ def make_synth_dataset(dirname: str, seed: int = 11,
             out.append(c)
         return bytes(out)
 
-    draft = mutate(truth, 0.04)
-    jobs = [(start, read_len)
-            for start in range(0, genome_len - read_len, step)]
-    jobs += [(0, genome_len - 700), (600, genome_len - 700)]
-    reads, paf = [], []
-    for k, (start, length) in enumerate(jobs):
-        read = mutate(truth[start:start + length], 0.05)
-        reads.append((f"r{k}", read))
-        t_end = min(start + length, len(draft))
-        paf.append(f"r{k}\t{len(read)}\t0\t{len(read)}\t+\tdraft\t"
-                   f"{len(draft)}\t{start}\t{t_end}\t{length}\t"
-                   f"{length}\t60")
+    reads, paf, drafts = [], [], []
+    for c in range(max(1, contigs)):
+        cname = "draft" if contigs <= 1 else f"ctg{c:02d}"
+        truth = bytes(rng.choice(acgt) for _ in range(genome_len))
+        draft = mutate(truth, 0.04)
+        jobs = [(start, read_len)
+                for start in range(0, genome_len - read_len, step)]
+        jobs += [(0, genome_len - 700), (600, genome_len - 700)]
+        for k, (start, length) in enumerate(jobs):
+            read = mutate(truth[start:start + length], 0.05)
+            rname = f"r{k}" if contigs <= 1 else f"r{c:02d}_{k}"
+            reads.append((rname, read))
+            t_end = min(start + length, len(draft))
+            paf.append(f"{rname}\t{len(read)}\t0\t{len(read)}\t+\t"
+                       f"{cname}\t{len(draft)}\t{start}\t{t_end}\t"
+                       f"{length}\t{length}\t60")
+        drafts.append((cname, draft))
     paths = (os.path.join(dirname, "reads.fasta.gz"),
              os.path.join(dirname, "ovl.paf.gz"),
              os.path.join(dirname, "draft.fasta.gz"))
@@ -373,7 +382,8 @@ def make_synth_dataset(dirname: str, seed: int = 11,
     with gzip.open(paths[1], "wb") as f:
         f.write(("\n".join(paf) + "\n").encode())
     with gzip.open(paths[2], "wb") as f:
-        f.write(b">draft\n" + draft + b"\n")
+        for cname, draft in drafts:
+            f.write(b">" + cname.encode() + b"\n" + draft + b"\n")
     return paths
 
 
@@ -977,11 +987,27 @@ class PolishServer:
                   want_progress=bool(req.get("progress")),
                   want_stream=bool(req.get("stream")),
                   tenant=tenant or "")
+        # child-job fields from a serve router (router.py): `parent` is
+        # the router-side parent job id, `shard`/`shards` this child's
+        # slot in the contig fan-out. Purely observational replica-side
+        # — journaled so the replica's journal lines correlate with the
+        # router's ledger — and ignored (like any unknown key) when
+        # absent or malformed.
+        parent = req.get("parent")
+        if not isinstance(parent, str) or not parent \
+                or not set(parent) <= self._TRACE_ID_OK:
+            parent = None
+        shard = req.get("shard") if isinstance(req.get("shard"), int) \
+            else None
+        shards = req.get("shards") if isinstance(req.get("shards"), int) \
+            else None
         if self.journal is not None:
             self.journal.record("received", job=job.id, trace=trace_id,
                                 priority=job.priority or None,
                                 tenant=job.tenant or None,
-                                deadline_s=req.get("deadline_s"))
+                                deadline_s=req.get("deadline_s"),
+                                parent=parent, shard=shard,
+                                shards=shards)
         try:
             self.queue.submit(job)
         except QueueFull as exc:

@@ -456,3 +456,54 @@ def test_audit_overhead_max_mandatory_when_requested(tmp_path, capsys):
                           "--tolerance-pct", "50",
                           "--audit-overhead-max", "2.0"]) == 2
     assert "audit.overhead_pct" in capsys.readouterr().err
+
+
+def router_artifact(jobs_per_s=4.0, scaling_x=1.8, identical=True,
+                    requeues=0):
+    return {"mode": "router", "jobs": 4,
+            "router": {"replicas_max": 2, "jobs_per_s": jobs_per_s,
+                       "scaling_x": scaling_x, "identical": identical,
+                       "requeues": requeues,
+                       "curve": [{"replicas": 1, "jobs_per_s": 2.2},
+                                 {"replicas": 2,
+                                  "jobs_per_s": jobs_per_s}]}}
+
+
+def test_router_gates(tmp_path, capsys):
+    """ISSUE-15 satellite: perfgate gates servebench --router artifacts
+    on router.identical and router.requeues == 0 whenever the block is
+    present, and on router.scaling_x via --router-scaling-min."""
+    ok = write(tmp_path / "ok.json", router_artifact())
+    assert perfgate.main(["--artifact", ok]) == 0
+    err = capsys.readouterr().err
+    assert "router.identical" in err and "router.requeues" in err
+    # a diverged merge or a requeue on the healthy bench fleet fails
+    diverged = write(tmp_path / "div.json",
+                     router_artifact(identical=False))
+    assert perfgate.main(["--artifact", diverged]) == 1
+    requeued = write(tmp_path / "rq.json", router_artifact(requeues=2))
+    assert perfgate.main(["--artifact", requeued]) == 1
+    assert "router.requeues" in capsys.readouterr().err
+    # the scaling floor gates only when requested, then both ways
+    assert perfgate.main(["--artifact", ok,
+                          "--router-scaling-min", "1.5"]) == 0
+    assert perfgate.main(["--artifact", ok,
+                          "--router-scaling-min", "1.9"]) == 1
+    assert "router.scaling_x" in capsys.readouterr().err
+
+
+def test_router_scaling_min_mandatory_when_requested(tmp_path, capsys):
+    """--router-scaling-min over an artifact without a router block is
+    a named-key broken gate, rc 2 (the slo.miss_rate convention) — and
+    so is a router block missing scaling_x."""
+    plain = write(tmp_path / "plain.json", serve_artifact(p50=1.0))
+    assert perfgate.main(["--artifact", plain, "--ref-value", "1.0",
+                          "--tolerance-pct", "50",
+                          "--router-scaling-min", "1.5"]) == 2
+    assert "router.scaling_x" in capsys.readouterr().err
+    doc = router_artifact()
+    del doc["router"]["scaling_x"]
+    partial = write(tmp_path / "partial.json", doc)
+    assert perfgate.main(["--artifact", partial,
+                          "--router-scaling-min", "1.5"]) == 2
+    assert "router.scaling_x" in capsys.readouterr().err

@@ -1,0 +1,434 @@
+"""Replicated serve fabric tests (serve/router.py) — the ISSUE's three
+pinned contracts plus the satellite units:
+
+  - byte-identity: 1 router over {1, 2, 4} warm replicas produces the
+    SAME polished FASTA as a solo PolishServer run (which is itself
+    pinned byte-identical to the one-shot path), including a
+    multi-contig job with streamed parts — contig-sharded fan-out plus
+    contig-order merge is invisible to the client;
+  - failover: a replica that streams part of its shard and then dies
+    (connection drop — what kill -9 looks like from the router) gets
+    the shard re-dispatched to a healthy replica, the already-streamed
+    contig deduped by the journal-backed ledger, output byte-identical
+    with each contig EXACTLY once, `requeued` + `replica-down` in the
+    router journal and the journal still lifecycle-consistent;
+  - rolling restart: drain -> restart -> rejoin of each replica in turn
+    while a wave of jobs runs loses zero jobs, and the router's healthz
+    tracks the routable count throughout;
+  - client retry jitter bounds (`_retry_delay`), and journal fsync mode
+    surviving rotation plus a torn final line.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import random
+import socket
+import sys
+import threading
+import time
+import urllib.request
+
+import pytest
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "tools"))
+
+from racon_tpu.core.polisher import PolisherType, create_polisher
+from racon_tpu.errors import RaconError
+from racon_tpu.obs.journal import Journal, check_consistency, read_journal
+from racon_tpu.serve import (PolishClient, PolishRouter, PolishServer,
+                             RouterConfig, make_synth_dataset)
+from racon_tpu.serve.client import RETRY_DELAY_CAP_S, _retry_delay
+from racon_tpu.serve.protocol import ProtocolError, recv_frame, send_frame
+from racon_tpu.serve.router import _JobMerge, router_main
+
+
+# --------------------------------------------------------------- fixtures
+@pytest.fixture(scope="module")
+def dataset4(tmp_path_factory):
+    """Four independent contigs — enough to shard 1/2/4 ways."""
+    return make_synth_dataset(str(tmp_path_factory.mktemp("router_data")),
+                              contigs=4)
+
+
+def polish_solo(paths) -> bytes:
+    p = create_polisher(*paths, PolisherType.kC, 500, 10.0, 0.3,
+                        num_threads=2)
+    p.initialize()
+    return b"".join(b">" + s.name.encode() + b"\n" + s.data + b"\n"
+                    for s in p.polish())
+
+
+@pytest.fixture(scope="module")
+def solo4(dataset4):
+    return polish_solo(dataset4)
+
+
+@pytest.fixture(scope="module")
+def replicas4(tmp_path_factory):
+    d = tmp_path_factory.mktemp("router_reps")
+    socks = [str(d / f"rep{i}.sock") for i in range(4)]
+    servers = [PolishServer(socket_path=s, workers=2).start()
+               for s in socks]
+    yield socks
+    for srv in servers:
+        srv.drain(timeout=10)
+
+
+def _wait_routable(cli: PolishClient, want: int, deadline_s: float = 30.0):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < deadline_s:
+        with contextlib.suppress(Exception):
+            hz = cli.request({"type": "healthz"})
+            if hz.get("routable") == want:
+                return hz
+        time.sleep(0.1)
+    raise AssertionError(f"router never reached routable == {want}")
+
+
+# ------------------------------------------------------ merge ledger unit
+def test_merge_dedupes_requeued_parts_and_keeps_order():
+    emitted = []
+    m = _JobMerge(2, emit_part=lambda k, i, n, f: emitted.append((i, n)))
+    m.on_part(1, {"name": "c", "fasta": "C"})  # later shard buffers
+    assert emitted == []
+    m.on_part(0, {"name": "a", "fasta": "A"})
+    m.requeue(0)  # replica died after streaming "a"
+    m.on_part(0, {"name": "a", "fasta": "A"})  # re-run replays: deduped
+    m.on_part(0, {"name": "b", "fasta": "B"})
+    m.shard_done(0, {})
+    m.shard_done(1, {})
+    assert [name for _i, name in emitted] == ["a", "b", "c"]
+    assert [i for i, _name in emitted] == [0, 1, 2]
+    assert m.fasta() == "ABC"
+    assert m.total_routed == 3
+
+
+# ------------------------------------------------------------- byte pins
+def test_router_byte_identity_1_2_4_replicas(dataset4, solo4, replicas4,
+                                             tmp_path):
+    for n in (1, 2, 4):
+        router = PolishRouter(replicas=",".join(replicas4[:n]),
+                              socket_path=str(tmp_path / f"r{n}.sock"),
+                              health_interval_s=0.2).start()
+        try:
+            cli = PolishClient(socket_path=router.config.socket_path)
+            raw = cli.request({"type": "submit",
+                               "sequences": dataset4[0],
+                               "overlaps": dataset4[1],
+                               "target": dataset4[2]})
+            assert raw["fasta"].encode("latin-1") == solo4
+            assert raw["router"]["shards"] == min(n, 4)
+            assert raw["router"]["requeues"] == 0
+            # streamed multi-contig: parts arrive globally renumbered
+            # in contig order and concatenate byte-identical
+            parts = []
+            res = cli.submit(*dataset4, stream=True,
+                             on_part=lambda f: parts.append(f))
+            assert res.fasta == solo4
+            assert [p["part"] for p in parts] == list(range(len(parts)))
+            assert len(parts) == 4  # one per contig, each exactly once
+        finally:
+            router.drain()
+
+
+def test_router_metrics_and_healthz_http(dataset4, replicas4, tmp_path):
+    router = PolishRouter(replicas=",".join(replicas4[:2]),
+                          socket_path=str(tmp_path / "rm.sock"),
+                          metrics_port=0,
+                          health_interval_s=0.2).start()
+    try:
+        cli = PolishClient(socket_path=router.config.socket_path)
+        cli.submit(*dataset4)
+        base = f"http://127.0.0.1:{router.config.metrics_port}"
+        body = urllib.request.urlopen(base + "/metrics",
+                                      timeout=5).read().decode()
+        assert "racon_tpu_router_replicas 2" in body
+        assert "racon_tpu_router_replicas_routable 2" in body
+        assert "racon_tpu_router_jobs_completed_total 1" in body
+        assert "racon_tpu_router_requeued_outstanding 0" in body
+        # federated replica families ride the same body (fleet merge)
+        assert "racon_tpu_fleet_replicas 2" in body
+        with urllib.request.urlopen(base + "/healthz", timeout=5) as r:
+            doc = json.loads(r.read().decode())
+        assert doc["ok"] and doc["routable"] == 2 and doc["router"]
+    finally:
+        router.drain()
+
+
+# ------------------------------------------------------------- failover
+class _DyingReplica:
+    """Protocol-complete fake replica: healthy to every probe, but a
+    submit streams the TRUE first polished contig of its shard and then
+    drops the connection — exactly what kill -9 after one result_part
+    looks like from the router's side, made deterministic."""
+
+    def __init__(self, sock_path: str, polished_records: dict):
+        self.path = sock_path
+        self.polished = polished_records  # contig name -> record text
+        self.submits = 0
+        self._stop = threading.Event()
+        self._lst = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._lst.bind(sock_path)
+        self._lst.listen(8)
+        self._lst.settimeout(0.2)
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def _loop(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._lst.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            threading.Thread(target=self._handle, args=(conn,),
+                             daemon=True).start()
+
+    def _handle(self, conn):
+        try:
+            while True:
+                req = recv_frame(conn)
+                if req is None:
+                    return
+                rtype = req.get("type")
+                if rtype == "healthz":
+                    send_frame(conn, {"type": "healthz", "ok": True,
+                                      "draining": False})
+                elif rtype == "scrape":
+                    send_frame(conn, {"type": "metrics", "text": ""})
+                elif rtype == "ping":
+                    send_frame(conn, {"type": "pong"})
+                elif rtype == "submit":
+                    self.submits += 1
+                    from racon_tpu.io.parsers import \
+                        create_sequence_parser
+                    contigs: list = []
+                    create_sequence_parser(req["target"],
+                                           "test").parse(contigs, -1)
+                    name = contigs[0].name
+                    send_frame(conn, {"type": "result_part",
+                                      "job_id": "stub", "part": 0,
+                                      "name": name,
+                                      "fasta": self.polished[name]})
+                    with contextlib.suppress(OSError):
+                        conn.shutdown(socket.SHUT_RDWR)
+                    return
+                else:
+                    send_frame(conn, {"type": "ok"})
+        except (OSError, ProtocolError):
+            return
+        finally:
+            with contextlib.suppress(OSError):
+                conn.close()
+
+    def close(self):
+        self._stop.set()
+        with contextlib.suppress(OSError):
+            self._lst.close()
+
+
+def _records_by_name(fasta: bytes) -> dict:
+    """Polished records keyed by contig name (first header token — the
+    polisher appends LN/RC/XC tags after it)."""
+    out = {}
+    for chunk in fasta.split(b">")[1:]:
+        header, _, _body = chunk.partition(b"\n")
+        out[header.split()[0].decode()] = (b">" + chunk).decode("latin-1")
+    return out
+
+
+def test_failover_requeues_with_ledger_dedupe(dataset4, solo4, tmp_path):
+    stub = _DyingReplica(str(tmp_path / "stub.sock"),
+                         _records_by_name(solo4))
+    real = PolishServer(socket_path=str(tmp_path / "real.sock"),
+                        workers=2).start()
+    journal = str(tmp_path / "router.jsonl")
+    router = PolishRouter(
+        replicas=f"{stub.path},{real.config.socket_path}",
+        socket_path=str(tmp_path / "r.sock"), journal=journal,
+        health_interval_s=0.2).start()
+    try:
+        cli = PolishClient(socket_path=router.config.socket_path)
+        parts: list[dict] = []
+        res = cli.submit(*dataset4, stream=True,
+                         on_part=lambda f: parts.append(f))
+        assert res.fasta == solo4
+        # each contig streamed to the client EXACTLY once despite the
+        # re-run replaying the stub's already-routed part
+        assert len(parts) == 4
+        assert len({p["name"] for p in parts}) == 4
+        headers = [ln for ln in res.fasta.split(b"\n")
+                   if ln.startswith(b">")]
+        assert len(headers) == 4 and len(set(headers)) == 4
+        assert stub.submits >= 1  # the dying replica really got a shard
+        hz = cli.request({"type": "healthz"})
+        assert hz["requeued_outstanding"] == 0  # settled after requeue
+    finally:
+        router.drain()
+        stub.close()
+        real.drain(timeout=10)
+    entries = read_journal(journal)
+    events = [e["event"] for e in entries]
+    assert "replica-down" in events
+    assert "requeued" in events
+    # every client-visible contig was ledgered exactly once
+    routed = [e for e in entries if e["event"] == "part-routed"]
+    assert len(routed) == 4
+    assert len({(e["job"], e["part"]) for e in routed}) == 4
+    assert check_consistency(entries) == []
+
+
+# ------------------------------------------------------- rolling restart
+def test_rolling_restart_loses_no_jobs(dataset4, solo4, tmp_path):
+    socks = [str(tmp_path / "a.sock"), str(tmp_path / "b.sock")]
+    servers = {s: PolishServer(socket_path=s, workers=2).start()
+               for s in socks}
+    router = PolishRouter(replicas=",".join(socks),
+                          socket_path=str(tmp_path / "r.sock"),
+                          health_interval_s=0.2,
+                          replica_wait_s=30.0).start()
+    cli = PolishClient(socket_path=router.config.socket_path)
+    stop = threading.Event()
+    results: list[bytes] = []
+    errors: list[Exception] = []
+
+    def wave():
+        w = PolishClient(socket_path=router.config.socket_path)
+        while not stop.is_set():
+            try:
+                results.append(w.submit(*dataset4).fasta)
+            except Exception as exc:  # noqa: BLE001 — the assertion
+                errors.append(exc)
+                return
+
+    threads = [threading.Thread(target=wave, daemon=True)
+               for _ in range(2)]
+    try:
+        for t in threads:
+            t.start()
+        for s in socks:  # drain -> restart -> rejoin, each in turn
+            servers[s].drain(timeout=20)
+            hz = _wait_routable(cli, 1)
+            assert hz["ok"]  # one replica down, still serving
+            servers[s] = PolishServer(socket_path=s, workers=2).start()
+            _wait_routable(cli, 2)
+        stop.set()
+        for t in threads:
+            t.join(timeout=60)
+        assert not errors, f"wave lost jobs: {errors!r}"
+        assert len(results) >= 2
+        assert all(b == solo4 for b in results)
+    finally:
+        stop.set()
+        router.drain()
+        for srv in servers.values():
+            srv.drain(timeout=10)
+
+
+# ---------------------------------------------------------- config + CLI
+def test_router_config_validation(monkeypatch):
+    monkeypatch.delenv("RACON_TPU_ROUTER_REPLICAS", raising=False)
+    with pytest.raises(RaconError, match="no replicas"):
+        RouterConfig()
+    with pytest.raises(RaconError, match="metrics base"):
+        RouterConfig(replicas="http://x:9100/metrics")
+    with pytest.raises(RaconError, match="localhost"):
+        RouterConfig(replicas="10.1.2.3:4000")
+    with pytest.raises(RaconError, match="unknown router option"):
+        RouterConfig(replicas="/tmp/a.sock", bogus=1)
+    monkeypatch.setenv("RACON_TPU_ROUTER_HEALTH_INTERVAL", "nope")
+    with pytest.raises(RaconError, match="HEALTH_INTERVAL"):
+        RouterConfig(replicas="/tmp/a.sock")
+
+
+def test_router_cli_rejects_bad_config(capsys):
+    assert router_main(["--replicas", ""]) == 1
+    assert "error" in capsys.readouterr().err
+
+
+# ------------------------------------------------- satellite: jitter
+def test_retry_delay_jitter_bounds():
+    rng = random.Random(7)
+    for hint in (0.0, 0.2, 1.0, 5.0):
+        for _ in range(300):
+            d = _retry_delay(hint, rng=rng)
+            assert 0.0 <= d <= RETRY_DELAY_CAP_S
+            assert 0.75 * hint - 1e-9 <= d <= 1.25 * hint + 1e-9
+    # cap: a hostile/huge hint can never park the client past the cap
+    for _ in range(300):
+        assert _retry_delay(1e9, rng=rng) <= RETRY_DELAY_CAP_S
+    assert _retry_delay(-5.0, rng=rng) == 0.0
+    # jitter actually spreads (anti-thundering-herd is the point)
+    spread = {round(_retry_delay(1.0, rng=rng), 3) for _ in range(50)}
+    assert len(spread) > 10
+
+
+# ----------------------------------------- satellite: journal durability
+def test_journal_fsync_rotation_and_torn_tail(tmp_path, monkeypatch):
+    monkeypatch.setenv("RACON_TPU_JOURNAL_FSYNC", "1")
+    path = str(tmp_path / "ledger.jsonl")
+    j = Journal(path, max_bytes=512)
+    assert j.fsync  # env opt-in picked up
+    for i in range(40):  # far past max_bytes: forces rotation
+        j.record("received", job=f"j{i}")
+        j.record("started", job=f"j{i}")
+        j.record("finished", job=f"j{i}")
+    assert os.path.isfile(path + ".1")  # rotation really happened
+    assert j.dropped == 0
+    j.close()
+    # mid-write crash: a torn, unterminated final line on disk
+    with open(path, "a", encoding="utf-8") as fh:
+        fh.write('{"t":1.0,"event":"recei')
+    entries = read_journal(path)
+    assert all(isinstance(e, dict) and "event" in e for e in entries)
+    # at most the final (torn) line is lost — every recorded line that
+    # survived rotation parses; only the two live generations count
+    # (rotation discards older ones by design)
+    with open(path, encoding="utf-8") as fh:
+        live_main = sum(1 for ln in fh if ln.endswith("\n"))
+    with open(path + ".1", encoding="utf-8") as fh:
+        live_rotated = sum(1 for ln in fh)
+    assert len(entries) == live_main + live_rotated
+    finished = [e for e in entries if e["event"] == "finished"]
+    assert finished  # the tail generation is readable, not garbage
+    # explicit override beats the env knob
+    j2 = Journal(str(tmp_path / "nofsync.jsonl"), fsync=False)
+    assert not j2.fsync
+    j2.close()
+
+
+# -------------------------------------------------------- servetop suffix
+def test_servetop_fleet_line_router_suffix(replicas4, tmp_path):
+    """Satellite pin: servetop's fleet line grows a router suffix when
+    a polled endpoint is the shard-aware router — routable vs
+    configured replica counts and outstanding requeued shards, read
+    from the racon_tpu_router_* gauges the router's scrape federates —
+    and stays suffix-free against a plain replica."""
+    import servetop
+
+    from racon_tpu.obs.fleet import FleetAggregator
+
+    router = PolishRouter(replicas=replicas4[:2],
+                          socket_path=str(tmp_path / "rt.sock"),
+                          health_interval_s=0.2).start()
+    try:
+        _wait_routable(
+            PolishClient(socket_path=router.config.socket_path), 2)
+        agg = FleetAggregator([router.config.socket_path])
+        snap = agg.poll()
+        agg.close()
+        line = servetop.fleet_line(snap, {}, {}, 0.0)
+        assert "router 2/2 routable" in line
+        assert "requeued 0" in line
+        assert "[REQUEUED]" not in line
+    finally:
+        router.drain(timeout=10)
+    agg = FleetAggregator([replicas4[0]])
+    snap = agg.poll()
+    agg.close()
+    assert servetop._fleet_router(snap) == ""

@@ -457,6 +457,120 @@ def run_audit_cells(tmp: str, paths) -> list[tuple[str, str]]:
     return cells
 
 
+def run_router_cells(tmp: str) -> list[tuple[str, str]]:
+    """The replicated-fabric section (serve/router.py): two REAL
+    `racon_tpu serve` replica subprocesses behind one in-process
+    router, then kill -9 one replica mid-job. The job must complete via
+    the journal-backed requeue with FASTA byte-identical to a solo run
+    (each contig exactly once), the `requeued` event must be on the
+    router's ledger, and a CONCURRENT job sharing the fabric must come
+    back undisturbed on the surviving replica."""
+    import signal
+    import subprocess
+
+    from racon_tpu.core.polisher import PolisherType, create_polisher
+    from racon_tpu.obs.journal import read_journal
+    from racon_tpu.serve import (PolishClient, PolishRouter,
+                                 make_synth_dataset)
+
+    names = ("router kill -9 mid-job", "router survivor concurrent job")
+    cells: list[tuple[str, str]] = []
+    data_dir = os.path.join(tmp, "router_data")
+    os.makedirs(data_dir, exist_ok=True)
+    rpaths = make_synth_dataset(data_dir, contigs=4)
+    p = create_polisher(*rpaths, PolisherType.kC, 500, 10.0, 0.3,
+                        num_threads=2)
+    p.initialize()
+    clean = b"".join(b">" + s.name.encode() + b"\n" + s.data + b"\n"
+                     for s in p.polish())
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               RACON_TPU_DEVICE_RETRIES="1")
+    env["PYTHONPATH"] = os.pathsep.join(
+        [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+        + [q for q in env.get("PYTHONPATH", "").split(os.pathsep)
+           if q and "axon_site" not in q])
+    socks = [os.path.join(tmp, f"router_rep{i}.sock") for i in range(2)]
+    procs = [subprocess.Popen(
+        [sys.executable, "-m", "racon_tpu.cli", "serve", "--socket", s,
+         "--workers", "2", "--no-warmup"],
+        env=env, stderr=subprocess.DEVNULL) for s in socks]
+    router = None
+    journal = os.path.join(tmp, "router_journal.jsonl")
+    try:
+        for s in socks:
+            probe = PolishClient(socket_path=s, timeout=30)
+            deadline = time.perf_counter() + 90
+            while time.perf_counter() < deadline:
+                try:
+                    probe.request({"type": "ping"})
+                    break
+                except Exception:  # noqa: BLE001 — still starting
+                    time.sleep(0.2)
+            else:
+                raise RuntimeError(f"replica {s} never came up")
+        router = PolishRouter(replicas=",".join(socks),
+                              socket_path=os.path.join(
+                                  tmp, "router.sock"),
+                              journal=journal,
+                              health_interval_s=0.5).start()
+        client = PolishClient(socket_path=router.config.socket_path)
+        # a watchdog-absorbed hang plan (bytes unchanged — the MATRIX
+        # hang rows pin that) keeps every shard busy long enough for
+        # the kill to land genuinely mid-job
+        slow = {"fault_plan": "device:chunk=0:hang=8",
+                "options": {"tpu_device_timeout": 2.0}}
+        main_res: dict = {}
+        side_res: dict = {}
+
+        def run_job(out: dict):
+            mine = PolishClient(socket_path=router.config.socket_path)
+            try:
+                out["fasta"] = mine.submit(*rpaths, stream=True,
+                                           **slow).fasta
+            except Exception as exc:  # noqa: BLE001 — checked below
+                out["exc"] = exc
+
+        t_main = threading.Thread(target=run_job, args=(main_res,))
+        t_side = threading.Thread(target=run_job, args=(side_res,))
+        t_main.start()
+        t_side.start()
+        time.sleep(1.0)  # shards dispatched and stalled on chunk 0
+        procs[0].send_signal(signal.SIGKILL)  # the real kill -9
+        t_main.join(WALL_CAP)
+        t_side.join(WALL_CAP)
+        events = [e["event"] for e in read_journal(journal)]
+        for name, res, wants_requeue in ((names[0], main_res, True),
+                                         (names[1], side_res, False)):
+            checks = [("completed", "fasta" in res),
+                      ("identical", res.get("fasta") == clean)]
+            if wants_requeue:
+                checks.append(("requeued-journaled",
+                               "requeued" in events
+                               and "replica-down" in events))
+            failed = [n for n, ok in checks if not ok]
+            if "exc" in res:
+                failed.append(f"({type(res['exc']).__name__}: "
+                              f"{res['exc']})")
+            cells.append((name,
+                          "pass  " + ("requeued, identical"
+                                      if wants_requeue
+                                      else "undisturbed, identical")
+                          if not failed else f"FAIL {' '.join(failed)}"))
+    except Exception as exc:  # noqa: BLE001 — a crashed section is a
+        # red pair of cells, not a crashed grid
+        detail = f"FAIL crashed ({type(exc).__name__}: {exc})"
+        while len(cells) < 2:
+            cells.append((names[len(cells)], detail))
+    finally:
+        if router is not None:
+            router.drain()
+        for proc in procs:
+            if proc.poll() is None:
+                proc.kill()
+            proc.wait(timeout=10)
+    return cells
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true",
@@ -592,7 +706,14 @@ def main() -> int:
         for name, cell in audit_cells:
             failures += cell.startswith("FAIL")
             print(f"{name:<{width}}  {cell}", file=sys.stderr)
-    n_cells = (len(columns) + 2) * len(rows) + len(audit_cells)
+        # the replicated-fabric section: kill -9 a replica behind the
+        # router mid-job — requeue must finish the job byte-identically
+        router_cells = run_router_cells(tmp)
+        for name, cell in router_cells:
+            failures += cell.startswith("FAIL")
+            print(f"{name:<{width}}  {cell}", file=sys.stderr)
+    n_cells = ((len(columns) + 2) * len(rows) + len(audit_cells)
+               + len(router_cells))
     print(f"[faultcheck] {'FAIL' if failures else 'PASS'}: "
           f"{n_cells - failures}/{n_cells} cells green",
           file=sys.stderr)

@@ -44,6 +44,18 @@ Metric extraction understands both artifact shapes:
     2.0 whenever the block is present; `--scrape-overhead-max` makes
     it mandatory, rc 2 naming the dotted key when absent).
 
+  - servebench `--router` artifacts (`"mode": "router"`) carry a
+    `router` block (the shard-aware fan-out's scaling curve):
+    `router.identical` — byte-identity of the routed FASTA vs a direct
+    single-replica submit — gates whenever the block is present, as
+    does `router.requeues` == 0 (a requeue on a healthy bench fleet is
+    a real replica loss, not noise); `router.scaling_x` (jobs/s at N
+    replicas over jobs/s at 1) gates ABSOLUTELY against
+    `--router-scaling-min` (mandatory once requested, rc 2 naming the
+    dotted key when absent). The headline `router.jobs_per_s` gates
+    RELATIVELY only against an explicit `--against` router artifact —
+    there is no implicit baseline for a replica-count sweep.
+
   - synthbench `--json` artifacts (`"mode": "synth"`):
     `synth.windows_per_s`, HIGHER is better — gated ABSOLUTELY against
     `--windows-per-s-min` (the kernel-plane regression floor) and
@@ -191,6 +203,24 @@ def extract(doc: dict, path: str = "<artifact>") -> dict:
         if isinstance(inner.get("mesh"), dict):
             out["mesh"] = inner["mesh"]
         return out
+    if inner.get("mode") == "router":
+        # servebench --router artifact: jobs/s through the shard-aware
+        # router at the highest swept replica count, HIGHER is better.
+        # No implicit baseline (the sweep is its own comparison) — the
+        # router block's identity/requeue/scaling gates carry the
+        # verdict; --against another router artifact adds the relative
+        # throughput gate.
+        value = _lookup(inner, "router.jobs_per_s")
+        if value is None:
+            raise GateError(
+                f"{path}: artifact lacks gated metric "
+                "'router.jobs_per_s'")
+        out = {"name": "router jobs/s", "value": float(value),
+               "unit": "jobs/sec", "higher_better": True,
+               "kind": "router"}
+        if isinstance(inner.get("mesh"), dict):
+            out["mesh"] = inner["mesh"]
+        return out
     if inner.get("mode") == "synth":
         # synthbench --json artifact: windows_per_s, HIGHER is better.
         # No implicit baseline exists for it (the published BASELINE
@@ -265,6 +295,11 @@ def resolve_baseline(cand: dict, args, candidate_path: str) -> tuple:
                             "direction than the candidate")
         return ref["value"], os.path.basename(args.against), ref
     baseline_path = os.path.join(args.dir, "BASELINE.json")
+    if cand.get("kind") == "router":
+        # a replica-count sweep is its own comparison point; the
+        # router block's absolute gates carry the verdict
+        raise GateError("router artifact has no implicit baseline "
+                        "(use --router-scaling-min and/or --against)")
     if cand.get("kind") == "synth":
         # a published sample-workload baseline is not comparable with a
         # synthetic-scale run; synth artifacts gate absolutely and/or
@@ -430,6 +465,51 @@ def scale_checks(doc: dict, args,
     return checks
 
 
+def router_checks(doc: dict, args,
+                  candidate_path: str) -> list[tuple[str, bool, str]]:
+    """Replicated-serve gates for servebench --router artifacts:
+    (name, ok, detail) triples. Whenever the artifact carries a
+    `router` block: `router.identical` must be true (the routed merge
+    must reproduce a direct single-replica submit byte-for-byte) and
+    `router.requeues` must be zero (a requeue on the healthy bench
+    fleet means a replica dropped mid-shard). `--router-scaling-min X`
+    additionally gates `router.scaling_x` (jobs/s at the highest swept
+    replica count over jobs/s at 1) >= X, and is mandatory once
+    requested — an artifact without the key exits 2 naming it."""
+    explicit = args.router_scaling_min is not None
+    inner = doc.get("parsed", doc)
+    router = inner.get("router") if isinstance(inner, dict) else None
+    if not isinstance(router, dict):
+        if explicit:
+            raise GateError(
+                f"{candidate_path}: artifact lacks gated metric "
+                "'router.scaling_x' (--router-scaling-min gates "
+                "servebench --router artifacts)")
+        return []
+    identical = bool(router.get("identical"))
+    checks = [("router.identical", identical,
+               "routed FASTA byte-identical to a direct submit"
+               if identical else
+               "routed FASTA DIVERGED from a direct submit")]
+    requeues = router.get("requeues")
+    if requeues is not None:
+        checks.append(("router.requeues", requeues == 0,
+                       f"{requeues} == 0"
+                       + ("" if requeues == 0 else
+                          " (a replica dropped mid-shard on the "
+                          "healthy bench fleet)")))
+    if explicit:
+        scaling = _lookup(inner, "router.scaling_x")
+        if scaling is None:
+            raise GateError(
+                f"{candidate_path}: artifact lacks gated metric "
+                "'router.scaling_x'")
+        limit = float(args.router_scaling_min)
+        checks.append(("router.scaling_x", float(scaling) >= limit,
+                       f"{scaling:g} >= {limit:g}"))
+    return checks
+
+
 def fused_checks(cand: dict, args,
                  candidate_path: str) -> list[tuple[str, float, float]]:
     """Host-overhead gate for artifacts carrying a `fused` block
@@ -550,6 +630,11 @@ def run(args) -> int:
                 and args.windows_per_s_min is not None
                 and not args.against):
             reference, ref_desc, ref = None, "", None
+        elif cand.get("kind") == "router" and not args.against:
+            # router artifacts always carry their own absolute gates
+            # (identity + requeues, plus --router-scaling-min): no
+            # baseline needed unless a relative --against was asked for
+            reference, ref_desc, ref = None, "", None
         else:
             raise
     # mesh comparability resolves BEFORE any relative verdict prints: a
@@ -606,6 +691,12 @@ def run(args) -> int:
         print(f"[perfgate] {'PASS' if check_ok else 'FAIL'}: "
               f"{os.path.basename(candidate_path)} {name} = {value:g}s "
               f"(limit {limit:g}s, {kind})", file=sys.stderr)
+    for name, check_ok, detail in router_checks(doc, args,
+                                                candidate_path):
+        failures += 0 if check_ok else 1
+        print(f"[perfgate] {'PASS' if check_ok else 'FAIL'}: "
+              f"{os.path.basename(candidate_path)} {name} ({detail})",
+              file=sys.stderr)
     for name, check_ok, detail in scale_checks(doc, args,
                                                candidate_path):
         failures += 0 if check_ok else 1
@@ -687,6 +778,16 @@ def main(argv=None) -> int:
                          "passing a value makes the gate mandatory — "
                          "an artifact without it then exits 2 naming "
                          "the dotted key)")
+    ap.add_argument("--router-scaling-min", type=float, default=None,
+                    help="absolute floor on the router throughput "
+                         "scaling factor (router.scaling_x: jobs/s at "
+                         "the highest swept replica count over jobs/s "
+                         "at 1, servebench --router artifacts); "
+                         "mandatory once passed — an artifact without "
+                         "the key exits 2 naming the dotted key. "
+                         "Router artifacts are also always gated on "
+                         "router.identical and router.requeues == 0 "
+                         "whenever the block is present")
     ap.add_argument("--scale-balance-max", type=float, default=None,
                     help="per-shard useful-cell balance bound (max/min) "
                          "for synthbench --scale-curve artifacts "

@@ -38,6 +38,16 @@ poll every replica's scrape+healthz MID-WAVE. The artifact gains a
 scrape-overhead percentage — which tools/perfgate.py gates at the
 established <2% observability budget.
 
+ROUTER MODE (`--router N`): start N warm replicas behind the
+shard-aware router (racon_tpu/serve/router.py) and sweep the same
+concurrent wave through it at 1, 2, 4 ... replicas (capped at N). The
+artifact becomes a `router` block — jobs/s per replica count, requeue
+count (zero on a healthy fleet, any requeue fails the bench), the
+router's merge overhead (job wall minus slowest-shard exec) and
+byte-identity vs a direct single-replica submit — plus `scaling_x`
+(jobs/s at N over jobs/s at 1), which tools/perfgate.py gates via
+`router.identical` and `--router-scaling-min`.
+
 AUDIT MODE (`--audit-rate R`): arm the identity-audit sentinel
 (racon_tpu/obs/audit.py) on every replica, keep it armed through the
 measured warm phases, and A/B the same sequential workload with the
@@ -290,6 +300,162 @@ def check_slo(args, PolishClient, PolishServer) -> int:
     return 1 if failures else 0
 
 
+def run_router_bench(args, PolishClient, PolishServer) -> int:
+    """`--router N`: job throughput through the shard-aware router
+    (racon_tpu/serve/router.py) vs replica count. Starts N warm
+    in-process replicas ONCE, then for each swept count c (1, 2, 4 ...
+    capped at N; N always included) fronts the first c replicas with a
+    PolishRouter and fires the same concurrent wave through it.
+    Reports jobs/s per count, the requeue count (zero on a healthy
+    fleet — any requeue here is a real replica loss and fails the
+    bench), the router's merge overhead (job wall minus the slowest
+    shard's exec seconds: the fan-out + merge + ledger tax) and
+    byte-identity vs a direct single-replica submit. `--json` rides
+    the curve out as a `router` artifact block with `scaling_x`
+    (jobs/s at N replicas over jobs/s at 1) which tools/perfgate.py
+    gates via `router.identical` (always, when the block is present)
+    and `--router-scaling-min` (mandatory once requested)."""
+    from racon_tpu.serve.queue import nearest_rank
+    from racon_tpu.serve.router import PolishRouter
+
+    n_max = max(1, args.router)
+    counts = sorted({c for c in (1, 2, 4) if c < n_max} | {n_max})
+    fail: list[str] = []
+    curve: list[dict] = []
+    with tempfile.TemporaryDirectory(prefix="racon_routerbench_") as tmp:
+        print(f"[servebench] router bench: {n_max} replica(s), sweep "
+              f"{counts}, {args.jobs} jobs per wave", file=sys.stderr)
+        paths = build_dataset(tmp, args.genome_kb, args.coverage,
+                              args.read_len, args.seed,
+                              contigs=args.contigs)
+        servers, socks = [], []
+        try:
+            t0 = time.perf_counter()
+            for k in range(n_max):
+                sock = os.path.join(tmp, f"rep{k}.sock")
+                srv = PolishServer(
+                    socket_path=sock, workers=args.workers, warmup=False,
+                    job_threads=args.threads,
+                    tpu_poa_batches=args.tpupoa_batches,
+                    tpu_aligner_batches=args.tpualigner_batches)
+                srv.warmup(paths=paths)
+                srv.start()
+                servers.append(srv)
+                socks.append(sock)
+            print(f"[servebench] {n_max} replica(s) warm in "
+                  f"{time.perf_counter() - t0:.2f}s", file=sys.stderr)
+            # the identity reference: one direct submit to a single
+            # replica — every routed job must reproduce these bytes
+            solo = PolishClient(socket_path=socks[0]).submit(*paths)
+
+            for c in counts:
+                router = PolishRouter(
+                    replicas=socks[:c],
+                    socket_path=os.path.join(tmp, f"router{c}.sock"),
+                    journal=os.path.join(tmp, f"router{c}.jsonl"))
+                router.start()
+                results: list = [None] * args.jobs
+
+                def submit(i):
+                    try:
+                        cl = PolishClient(
+                            socket_path=router.config.socket_path)
+                        results[i] = cl.submit(*paths, retries=5)
+                    except Exception as exc:
+                        print(f"[servebench] router job {i} "
+                              f"({c} replicas) failed: {exc}",
+                              file=sys.stderr)
+
+                threads = [threading.Thread(target=submit, args=(i,))
+                           for i in range(args.jobs)]
+                t_wave = time.perf_counter()
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join()
+                wall = time.perf_counter() - t_wave
+                requeues = router.counters["requeues"]
+                router.drain(timeout=30)
+                done = [r for r in results if r is not None]
+                identical = bool(done) and all(r.fasta == solo.fasta
+                                               for r in done)
+                # merge overhead: what the router ADDED on top of the
+                # slowest shard — fan-out, part forwarding, contig-order
+                # merge and the journal ledger
+                ov = [(r.router["wall_s"] - r.router["shard_exec_max_s"])
+                      / max(r.router["wall_s"], 1e-9) * 100.0
+                      for r in done
+                      if r.router.get("wall_s")]
+                shards = [r.router.get("shards", 1) for r in done]
+                pt = {"replicas": c, "jobs": args.jobs,
+                      "completed": len(done),
+                      "wall_s": round(wall, 3),
+                      "jobs_per_s": round(len(done) / max(wall, 1e-9),
+                                          3),
+                      "shards_mean": round(statistics.mean(shards), 2)
+                      if shards else 0,
+                      "requeues": requeues,
+                      "merge_overhead_pct": round(
+                          nearest_rank(sorted(ov), 0.50), 2)
+                      if ov else None,
+                      "identical": identical}
+                curve.append(pt)
+                print(f"[servebench] router x{c}: "
+                      f"{pt['completed']}/{args.jobs} jobs in "
+                      f"{wall:.2f}s ({pt['jobs_per_s']:.3f} jobs/s, "
+                      f"{pt['shards_mean']:.1f} shards/job, "
+                      f"merge overhead "
+                      f"{pt['merge_overhead_pct'] or 0:.2f}%, "
+                      f"{requeues} requeues) "
+                      f"[{'OK' if identical else 'FAIL'} identity]",
+                      file=sys.stderr)
+                if len(done) < args.jobs:
+                    fail.append(f"router x{c}: only {len(done)}/"
+                                f"{args.jobs} jobs completed")
+                if not identical:
+                    fail.append(f"router x{c}: routed FASTA diverged "
+                                "from the direct single-replica bytes")
+                if requeues:
+                    fail.append(f"router x{c}: {requeues} requeues on "
+                                "a healthy fleet (a replica dropped "
+                                "mid-shard)")
+        finally:
+            for srv in servers:
+                srv.drain(timeout=30)
+
+    scaling_x = (curve[-1]["jobs_per_s"]
+                 / max(curve[0]["jobs_per_s"], 1e-9)) if curve else 0.0
+    router_block = {
+        "replicas_max": n_max,
+        "jobs": args.jobs,
+        "curve": curve,
+        "jobs_per_s": curve[-1]["jobs_per_s"] if curve else 0.0,
+        "requeues": sum(pt["requeues"] for pt in curve),
+        "merge_overhead_pct": max(
+            (pt["merge_overhead_pct"] for pt in curve
+             if pt["merge_overhead_pct"] is not None), default=None),
+        "identical": bool(curve) and all(pt["identical"]
+                                         for pt in curve),
+        "scaling_x": round(scaling_x, 3),
+    }
+    print(f"[servebench] router scaling: x{scaling_x:.2f} jobs/s at "
+          f"{n_max} replica(s) vs 1 "
+          f"({router_block['requeues']} requeues total)",
+          file=sys.stderr)
+    if args.json:
+        artifact = {"mode": "router", "jobs": args.jobs,
+                    "router": router_block, "pass": not fail}
+        with open(args.json, "w") as fh:
+            json.dump(artifact, fh, indent=2, sort_keys=True)
+        print(f"[servebench] wrote {args.json}", file=sys.stderr)
+    if fail:
+        for f in fail:
+            print(f"[servebench] FAIL: {f}", file=sys.stderr)
+        return 1
+    print("[servebench] PASS", file=sys.stderr)
+    return 0
+
+
 def run_openloop(client, paths, qps: float, n_jobs: int,
                  seed: int) -> dict:
     """One open-loop wave: Poisson arrivals at `qps`, every job
@@ -434,6 +600,16 @@ def main(argv=None) -> int:
                          "a `fleet` block with aggregator-lag and "
                          "scrape-overhead columns that "
                          "tools/perfgate.py gates at the <2% budget")
+    ap.add_argument("--router", type=int, default=None,
+                    help="router bench mode: start this many warm "
+                         "replicas behind the shard-aware router "
+                         "(serve/router.py) and sweep job throughput "
+                         "at 1, 2, 4 ... replicas (capped here) — the "
+                         "artifact gains a `router` block (jobs/s per "
+                         "count, requeue count, merge overhead, "
+                         "byte-identity vs a direct submit, scaling_x) "
+                         "that tools/perfgate.py gates via "
+                         "router.identical and --router-scaling-min")
     ap.add_argument("--fleet-poll-s", type=float, default=0.25,
                     help="fleet mode: aggregator poll interval during "
                          "the wave (default 0.25s)")
@@ -484,6 +660,9 @@ def main(argv=None) -> int:
 
     if args.check_slo:
         return check_slo(args, PolishClient, PolishServer)
+
+    if args.router is not None:
+        return run_router_bench(args, PolishClient, PolishServer)
 
     cold_n = args.cold_runs if args.cold_runs is not None \
         else min(args.jobs, 3)

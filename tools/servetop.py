@@ -18,6 +18,11 @@ scrapes through obs/fleet.py, and redraws one screen per poll:
   - AUTOTUNER activity: winner-table consult counts by (engine,
     decision, dtype) — which kernel plane the fleet is actually
     dispatching;
+  - a ROUTER suffix on the fleet line (rendered only when a polled
+    endpoint is the shard-aware router, serve/router.py): routable vs
+    configured replicas behind it, the draining count mid rolling
+    restart, and outstanding requeued shards with [REQUEUED] while any
+    lost shard is still waiting to finish on a survivor;
   - AUDIT rows (rendered only when a replica exposes the identity-audit
     families): one cell per replica with the sentinel's sampled/s rate,
     confirmed mismatches, online winner demotions and the worst lane
@@ -150,7 +155,7 @@ def fleet_line(snap, burn: dict, prev: dict, dt: float) -> str:
             f"{' [FIRING]' if burn.get('firing') else ''}"
             f"  iters {int(iters)} ({rate:.1f}/s)"
             f"  compiles {int(snap.counters.get(G + 'compiles_total', 0))}"
-            + _fleet_audit(snap))
+            + _fleet_audit(snap) + _fleet_router(snap))
 
 
 def _fleet_audit(snap) -> str:
@@ -164,6 +169,27 @@ def _fleet_audit(snap) -> str:
     return (f"  audit {mism} mism"
             + ("  [AUDIT-ALERT]"
                if snap.gauges.get("racon_tpu_audit_alert", 0) else ""))
+
+
+def _fleet_router(snap) -> str:
+    """Router suffix (empty when no polled endpoint is a shard-aware
+    router, serve/router.py): routable vs configured replica counts
+    behind the router, the draining count mid rolling restart, and the
+    outstanding requeued shards — [REQUEUED] while any shard lost to a
+    dead replica is still waiting to finish on a survivor."""
+    if "racon_tpu_router_replicas" not in snap.gauges:
+        return ""
+    total = int(snap.gauges.get("racon_tpu_router_replicas", 0))
+    routable = int(snap.gauges.get(
+        "racon_tpu_router_replicas_routable", 0))
+    draining = int(snap.gauges.get(
+        "racon_tpu_router_replicas_draining", 0))
+    requeued = int(snap.gauges.get(
+        "racon_tpu_router_requeued_outstanding", 0))
+    return (f"  router {routable}/{total} routable"
+            + (f" ({draining} drn)" if draining else "")
+            + f"  requeued {requeued}"
+            + ("  [REQUEUED]" if requeued else ""))
 
 
 def render_screen(snap, burn: dict, rows: list[dict], prev: dict,
