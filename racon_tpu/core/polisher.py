@@ -347,6 +347,46 @@ class Polisher:
         self._reset_run_state()
         return self
 
+    def redraft(self, polished, workdir: str,
+                tag: str = "round") -> tuple[str, str]:
+        """Warm re-draft for serve-native polishing rounds: take round
+        k's stitched contigs, write them as round k+1's draft, re-map
+        the ORIGINAL reads against them in-process (core/remap.py — no
+        external mapper, no process exit), and rebind this polisher to
+        the new triple. The next initialize()+polish() cycle IS round
+        k+1, on the same warm engines/jit caches/autotune posture.
+
+        Both the serve rounds loop and the chained-solo test path call
+        this one entry, so `rounds=N` output is byte-identical to N
+        chained runs by construction (tests/test_rounds.py pins it).
+        Returns the (draft_fasta, overlaps_paf) paths written under
+        `workdir`. The reads are re-parsed from the ORIGINAL reads path
+        (the polisher streams reads and never holds them whole — one
+        extra parse per round is the cost of the bounded-memory
+        contract)."""
+        import os as _os
+
+        from .remap import remap_overlaps, write_fasta, write_paf
+
+        if not polished:
+            raise RaconError("Polisher.redraft",
+                             "no polished sequences to re-draft from!")
+        reads_path = self.sparser.path
+        fasta_path = write_fasta(
+            polished, _os.path.join(workdir, f"{tag}_draft.fasta"))
+        reads: list[Sequence] = []
+        rparser = create_sequence_parser(reads_path, "Polisher.redraft")
+        rparser.reset()
+        rparser.parse(reads, -1)
+        rows = remap_overlaps(reads, polished)
+        if not rows:
+            raise RaconError("Polisher.redraft",
+                             "no reads re-mapped onto the new draft!")
+        paf_path = write_paf(
+            rows, _os.path.join(workdir, f"{tag}_ovl.paf"))
+        self.rebind(reads_path, paf_path, fasta_path)
+        return fasta_path, paf_path
+
     # ------------------------------------------------------------------ init
     def initialize(self) -> None:
         if self.windows:

@@ -197,7 +197,8 @@ class WindowAuditor:
 
     # ------------------------------------------------------------- audit
     def audit_windows(self, pairs, lane_index: int, iteration: int,
-                      batcher=None) -> int:
+                      batcher=None, wincache=None,
+                      cache_keys=None) -> int:
         """Audit one finished iteration: `pairs` is [(window, polisher)]
         for every window the iteration completed. Samples by content
         hash, shadow re-executes the sampled set through the oracle,
@@ -205,7 +206,16 @@ class WindowAuditor:
         (module docstring) — including REPAIRING the production window
         — before the caller delivers the windows to their jobs. Returns
         the number of mismatches. Never raises: the batcher wraps it,
-        and an audit bug must not fail production."""
+        and an audit bug must not fail production.
+
+        CACHE-HIT audits (serve/wincache.py): when `wincache` and
+        `cache_keys` (id(window) -> cache key) are given, a mismatched
+        window came out of the content cache, not a device lane — the
+        consequence chain redirects at the CACHE: the poisoned entry
+        is evicted and its key quarantined, the window still repaired,
+        but no engine is demoted and no lane quarantined (the
+        populating iteration already had its own audit; blaming
+        whatever lane the hit happened to ride would be noise)."""
         from ..ops.oracle import snapshot_window
 
         rate = self.rate
@@ -235,9 +245,13 @@ class WindowAuditor:
                         self.counters["clean"] += 1
                 if not ok:
                     mismatches += 1
+                    ck = (cache_keys.get(id(w))
+                          if cache_keys is not None else None)
                     exemplar = self._on_mismatch(w, snap, clone, p,
                                                  lane_index, iteration,
-                                                 batcher)
+                                                 batcher,
+                                                 wincache=wincache,
+                                                 cache_key=ck)
         shadow_s = time.perf_counter() - t0
         with self._lock:
             self.counters["shadow_s"] += shadow_s
@@ -250,24 +264,33 @@ class WindowAuditor:
         return mismatches
 
     def _on_mismatch(self, w, snap, clone, p, lane_index: int,
-                     iteration: int, batcher) -> dict | None:
+                     iteration: int, batcher, wincache=None,
+                     cache_key=None) -> dict | None:
         """The full consequence chain for one confirmed mismatch;
         returns the exemplar labels the caller attaches to this shadow
-        pass's `audit.shadow` observation."""
+        pass's `audit.shadow` observation. `cache_key` marks a CACHE
+        mismatch (see audit_windows): the entry takes the blame, the
+        device plane does not."""
         from ..ops.poa_pallas import pallas_mode
 
+        from_cache = cache_key is not None
         engine = _engine_label(p)
         labels = {"engine": engine,
                   "kernel": pallas_mode(),
                   "dtype": _dtype_label(),
                   "bucket": f"{len(w.sequences)}x{len(w.sequences[0])}",
-                  "lane": str(lane_index)}
+                  "lane": "cache" if from_cache else str(lane_index)}
         job = getattr(p, "serve_job_id", None)
         trace = getattr(p, "serve_trace_id", None)
         flight = self._dump_streams(w, clone, labels, job, iteration)
         demoted: list[str] = []
-        if self.demote_enabled:
+        if self.demote_enabled and not from_cache:
             demoted = self._demote(engine)
+        if from_cache and wincache is not None:
+            # evict the poisoned bytes and condemn the key: a repeat
+            # of this content re-dispatches (and re-populates from a
+            # fresh, audited iteration) instead of re-serving them
+            wincache.quarantine(cache_key)
         with self._lock:
             self.counters["mismatches"] += 1
             key = tuple(sorted(labels.items()))
@@ -290,14 +313,21 @@ class WindowAuditor:
             fields = dict(labels)  # carries the lane label already
             fields.update(iteration=iteration,
                           window=f"{w.id}:{w.rank}", flight=flight,
-                          demoted=demoted or None)
+                          demoted=demoted or None,
+                          cache=("entry-quarantined" if from_cache
+                                 else None))
             self.journal.record("audit-mismatch", job=job, trace=trace,
                                 **fields)
-        log_info(f"[racon_tpu::audit] MISMATCH lane {lane_index} "
-                 f"iteration {iteration} window {w.id}:{w.rank} "
+        log_info(f"[racon_tpu::audit] MISMATCH "
+                 + ("cache entry"
+                    if from_cache else f"lane {lane_index} "
+                                       f"iteration {iteration}")
+                 + f" window {w.id}:{w.rank} "
                  f"({labels['engine']}/{labels['kernel']}/"
                  f"{labels['dtype']} {labels['bucket']}): production "
                  f"bytes diverge from the oracle"
+                 + ("; entry evicted and key quarantined"
+                    if from_cache else "")
                  + (f"; demoted {len(demoted)} winner entr"
                     f"{'y' if len(demoted) == 1 else 'ies'}"
                     if demoted else "")
@@ -315,7 +345,8 @@ class WindowAuditor:
             # so flag them all stale (rebuilt at each lane's next
             # iteration), not just the quarantined lane's
             batcher.flush_lane_engines()
-        if (self.quarantine_enabled and batcher is not None):
+        if (self.quarantine_enabled and batcher is not None
+                and not from_cache):
             batcher.quarantine_lane(lane_index)
         return {k: v for k, v in
                 (("trace_id", trace or job), ("job", job),

@@ -73,6 +73,28 @@ def _backend() -> str:
     return jax.default_backend()
 
 
+def posture_key() -> tuple:
+    """The process's kernel/dtype posture fingerprint: every mode knob
+    that can change which kernel plane produces a window's consensus
+    bytes, plus the backend the mesh resolves to. The serve window
+    cache (serve/wincache.py) folds this into its content-addressed
+    key so a posture change — a different RACON_TPU_PALLAS/DTYPES/
+    FUSED/PACK_BASES arming, a different device kind — can never
+    return bytes cached under the old posture."""
+    from ..ops.dtypes import dtype_mode
+    from ..ops.encode import pack_bases_enabled
+    from ..ops.poa_fused import fused_mode
+    from ..ops.poa_pallas import pallas_mode
+
+    try:
+        backend = _backend()
+    except Exception:  # noqa: BLE001 — a backend-less process still
+        # has a well-defined (host) posture
+        backend = "none"
+    return (pallas_mode(), dtype_mode(), fused_mode(),
+            pack_bases_enabled(), backend)
+
+
 class Autotuner:
     """One winner table: load-on-construct, explicit save, dict lookups
     in between. Entries:

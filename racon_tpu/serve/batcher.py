@@ -332,6 +332,14 @@ class WindowBatcher:
         #: released and BEFORE windows are delivered — off the device
         #: hot path, but in time to repair a caught corruption
         self.auditor = None
+        #: the content-addressed window consensus cache
+        #: (serve/wincache.WindowCache) or None; the server wires it
+        #: when RACON_TPU_WINCACHE / --wincache arms it. Consulted
+        #: before a window enters the pooled stream (a hit skips
+        #: device dispatch), populated on iteration completion AFTER
+        #: the audit pass, invalidated on demotion / lane quarantine.
+        #: Isolation jobs (fault plan / strict) bypass it entirely.
+        self.wincache = None
         self.counters = {"iterations": 0, "solo_iterations": 0,
                          "shared_iterations": 0, "jobs": 0, "windows": 0,
                          "max_jobs_in_iteration": 0,
@@ -408,18 +416,57 @@ class WindowBatcher:
         if ticket.total == 0:
             polisher.serve_batch = ticket.batch_info()
             return
-        now = time.monotonic()
-        with self._cond:
-            if self._stop:
-                from ..errors import RaconError
+        # content-addressed cache consult (serve/wincache.py): a hit
+        # carries bytes an earlier dispatch of the SAME content under
+        # the SAME engine key + posture produced — deliver it straight
+        # to this job's thread and keep it out of the pooled stream.
+        # Only the shared path consults: isolation jobs returned above.
+        pend = polisher.windows
+        cache = self.wincache
+        if cache is not None:
+            from ..sched.autotune import posture_key
 
-                raise RaconError("WindowBatcher",
-                                 "batcher is closed (server draining)")
-            self._ensure_feeder_locked()
-            pool = self._pools.setdefault(ticket.key, [])
+            posture = posture_key()
+            hits: list = []
+            pend = []
+            hit_keys: dict[int, tuple] = {}
             for w in polisher.windows:
-                pool.append([next(self._entry_seq), now, ticket, w])
-            self._cond.notify_all()
+                ck = cache.key(w, ticket.key, posture)
+                ent = cache.lookup(ck)
+                if ent is None:
+                    pend.append(w)
+                else:
+                    w.consensus, w.polished = ent
+                    hits.append(w)
+                    hit_keys[id(w)] = ck
+            polisher.serve_cache = {"hits": len(hits),
+                                    "misses": len(pend)}
+            if hits:
+                # the sentinel samples cache-HIT windows too: a
+                # poisoned entry is caught (and the ENTRY evicted +
+                # quarantined) before this job stitches it, the
+                # window repaired with oracle bytes — same output
+                # guarantee as an iteration mismatch
+                self._audit_cache_hits(polisher, hits, hit_keys)
+                ticket.done += len(hits)
+                ticket.remaining -= len(hits)
+                ticket.deliver(hits)
+                if ticket.remaining <= 0:
+                    ticket.finish()
+        now = time.monotonic()
+        if pend:
+            with self._cond:
+                if self._stop:
+                    from ..errors import RaconError
+
+                    raise RaconError(
+                        "WindowBatcher",
+                        "batcher is closed (server draining)")
+                self._ensure_feeder_locked()
+                pool = self._pools.setdefault(ticket.key, [])
+                for w in pend:
+                    pool.append([next(self._entry_seq), now, ticket, w])
+                self._cond.notify_all()
         # consume deliveries ON THIS THREAD: the incremental-stitch
         # callback (and whatever it does — journal writes, frame
         # encodes) bills to this job, never to the feeder; an exception
@@ -784,6 +831,18 @@ class WindowBatcher:
         self._audit([(w, t.polisher)
                      for t, ws in per_ticket.items() for w in ws],
                     lane, it)
+        # populate the content cache AFTER the audit pass: a window the
+        # sentinel caught and repaired ships (and caches) the oracle
+        # bytes — the cache can never be seeded by a caught corruption
+        cache = self.wincache
+        if cache is not None:
+            from ..sched.autotune import posture_key
+
+            posture = posture_key()
+            for t, ws in per_ticket.items():
+                for w in ws:
+                    cache.store(cache.key(w, t.key, posture),
+                                w.consensus, w.polished)
         shared = len(tickets) > 1
         for ticket, ws in per_ticket.items():
             ticket.iterations += 1
@@ -830,6 +889,32 @@ class WindowBatcher:
         with self._cond:
             self.counters["audit_s"] += time.perf_counter() - t0
 
+    def _audit_cache_hits(self, polisher, windows: list,
+                          hit_keys: dict) -> None:
+        """Sentinel pass over one job's cache-HIT windows (runs on the
+        JOB thread — hits never cross a feeder). Mismatch consequences
+        are redirected at the CACHE: the poisoned ENTRY is evicted and
+        its key quarantined (obs/audit.py cache path) instead of
+        demoting an engine or quarantining a lane that never produced
+        these bytes — the populating iteration already had its own
+        audit. Same never-fails-production contract as `_audit`."""
+        auditor = self.auditor
+        if auditor is None or not auditor.armed or not windows:
+            return
+        t0 = time.perf_counter()
+        try:
+            auditor.audit_windows(
+                [(w, polisher) for w in windows], lane_index=-1,
+                iteration=-1, batcher=self, wincache=self.wincache,
+                cache_keys=hit_keys)
+        except Exception as exc:  # noqa: BLE001 — see _audit
+            from ..utils.logger import log_info
+
+            log_info(f"[racon_tpu::audit] warning: cache-hit audit "
+                     f"pass failed ({type(exc).__name__}: {exc})")
+        with self._cond:
+            self.counters["audit_s"] += time.perf_counter() - t0
+
     def flush_lane_engines(self) -> None:
         """Mark EVERY lane's cached (pipeline, engine) pairs stale —
         rebuilt lazily at each lane's next iteration (or re-probe). The
@@ -840,6 +925,12 @@ class WindowBatcher:
         with self._cond:
             for lane in (self._lanes or ()):
                 lane.flush_engines = True
+        # every cached entry was produced under the now-demoted winner
+        # table: the content key cannot tell old-winner bytes from
+        # new-winner bytes (both are supposed to be identical, but the
+        # demotion exists precisely because one of them was not)
+        if self.wincache is not None:
+            self.wincache.invalidate_all("winner-table demotion")
 
     def _fresh_engines_locked(self, lane: _Lane) -> None:
         """Drop the lane's cached engines if flagged stale (caller
@@ -872,6 +963,11 @@ class WindowBatcher:
             lane.flush_engines = True
             self.counters["lane_quarantines"] += 1
             self._cond.notify_all()
+        # a suspect lane may have populated cache entries from its
+        # UNSAMPLED windows — drop them all rather than serve a
+        # corrupt byte stream from memory after the lane drains
+        if self.wincache is not None:
+            self.wincache.invalidate_all(f"lane {index} quarantined")
         if self.auditor is not None:
             self.auditor.lane_event(index, "quarantined")
 
@@ -1000,4 +1096,6 @@ class WindowBatcher:
         out["compile_s"] = round(compile_s, 3)
         out["occupancy"] = stats.snapshot()
         out["pipeline"] = self._merged_pipeline()
+        if self.wincache is not None:
+            out["wincache"] = self.wincache.snapshot()
         return out

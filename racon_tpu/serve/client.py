@@ -112,7 +112,8 @@ _ERROR_TYPES = {"queue-full": QueueFull, "draining": ServerDraining,
 
 class PolishResult:
     __slots__ = ("job_id", "fasta", "metrics", "serve", "trace",
-                 "trace_base_mono", "streamed", "parts", "router")
+                 "trace_base_mono", "streamed", "parts", "router",
+                 "rounds")
 
     def __init__(self, resp: dict):
         self.job_id = resp.get("job_id")
@@ -134,6 +135,10 @@ class PolishResult:
         #: router (shards / requeues / parts / wall_s); {} for a direct
         #: replica submit
         self.router = resp.get("router") or {}
+        #: per-round accounting when the submit asked for rounds=N
+        #: (requested / completed / per_round walls + cache hit
+        #: totals); {} on a plain single-pass job
+        self.rounds = resp.get("rounds") or {}
         self.trace = resp.get("trace")
         #: the server-side recorder's time zero in SERVER perf_counter
         #: terms — merge_trace() needs it to rebase server spans
@@ -267,9 +272,9 @@ class PolishClient:
                deadline_s: float | None = None,
                fault_plan: str | None = None, strict: bool | None = None,
                trace: bool = False, trace_id: str | None = None,
-               tenant: str | None = None, on_progress=None,
-               on_part=None, stream: bool = False, recorder=None,
-               retries: int = 0) -> PolishResult:
+               tenant: str | None = None, rounds: int | None = None,
+               on_progress=None, on_part=None, stream: bool = False,
+               recorder=None, retries: int = 0) -> PolishResult:
         """Polish one input triple on the server. Paths are resolved to
         absolute before they cross the wire (the server's cwd is not the
         client's). `retries` re-submits after `retry_after` on full-queue
@@ -282,7 +287,10 @@ class PolishClient:
         `tenant` names the fair-scheduling bucket this job bills to
         (queue.py weighted DRR); `trace_id` stamps the job's
         server-side spans, journal lines and interleaved frames with a
-        client-chosen correlation id."""
+        client-chosen correlation id. `rounds=N` runs N serve-native
+        polishing rounds — the server feeds round k's stitched contigs
+        back as round k+1's draft without leaving the warm process —
+        and `PolishResult.rounds` carries the per-round accounting."""
         req = {"type": "submit",
                "sequences": os.path.abspath(sequences),
                "overlaps": os.path.abspath(overlaps),
@@ -303,6 +311,8 @@ class PolishClient:
             req["trace_id"] = str(trace_id)
         if tenant:
             req["tenant"] = str(tenant)
+        if rounds is not None:
+            req["rounds"] = int(rounds)
         if on_progress is not None:
             req["progress"] = True
         if stream or on_part is not None:
@@ -487,6 +497,13 @@ def submit_main(argv: list[str]) -> int:
                          "stdout (well-formed but partial); consumers "
                          "MUST check the exit status, which is "
                          "nonzero on any failure")
+    ap.add_argument("--rounds", type=int, default=None,
+                    help="serve-native polishing rounds: the server "
+                         "feeds round k's stitched contigs back as "
+                         "round k+1's draft without leaving the warm "
+                         "process (in-process re-overlap, no external "
+                         "mapper); the result carries per-round wall "
+                         "clocks and window-cache hit counts")
     ap.add_argument("--tenant", default=None,
                     help="fair-scheduling tenant id this job bills to "
                          "(1-64 chars of [A-Za-z0-9._-]; server "
@@ -543,8 +560,8 @@ def submit_main(argv: list[str]) -> int:
             sys.stdout.buffer.flush()
     common = dict(options=options, priority=args.priority,
                   deadline_s=args.deadline, retries=args.retries,
-                  tenant=args.tenant, on_progress=on_progress,
-                  on_part=on_part)
+                  tenant=args.tenant, rounds=args.rounds,
+                  on_progress=on_progress, on_part=on_part)
     trace_doc = None
     try:
         if args.trace_out:
@@ -576,6 +593,16 @@ def submit_main(argv: list[str]) -> int:
         print(f"[racon_tpu::serve] job {result.job_id}: queue wait "
               f"{serve.get('queue_wait_s', 0):.3f}s, exec "
               f"{serve.get('exec_s', 0):.3f}s", file=sys.stderr)
+    if result.rounds:
+        walls = ", ".join(f"r{r['round']}={r['wall_s']:.3f}s"
+                          for r in result.rounds.get("per_round", []))
+        cache = result.rounds.get("cache")
+        tail = (f", cache hits {cache['hits']}/{cache['hits'] + cache['misses']}"
+                if cache else "")
+        print(f"[racon_tpu::serve] rounds "
+              f"{result.rounds.get('completed')}/"
+              f"{result.rounds.get('requested')}: {walls}{tail}",
+              file=sys.stderr)
     if trace_doc is not None:
         try:
             with open(args.trace_out, "w") as fh:

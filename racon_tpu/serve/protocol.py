@@ -53,11 +53,26 @@ byte-identical to the buffered path). ``pong`` responses carry
 sample clients RTT-bracket to merge client- and server-side spans onto
 one timeline.
 
+Iterative rounds (opt-in per submit, README "Iterative rounds & window
+cache"): a ``submit`` may carry ``"rounds": N`` (1..64) asking the
+server to run N serve-native polishing rounds — round k's stitched
+contigs are fed back as round k+1's draft without leaving the warm
+process (in-process re-overlap, ``core/remap.py``). The FASTA returned
+(or streamed: only the FINAL round streams ``result_part`` frames) is
+round N's output, byte-identical to N chained solo runs through
+``Polisher.redraft``. The final ``result`` then adds a ``rounds`` block:
+``{"requested", "completed", "per_round": [{"round", "wall_s",
+"windows", "iterations", "sequences", "cache"?}], "cache": {"hits",
+"misses"}?}`` (the ``cache`` entries appear only on servers with the
+content-addressed window cache armed). Omitting ``rounds`` keeps the
+classic single-pass contract untouched.
+
 Child-job fields (router fan-out, serve/router.py): when a shard-aware
 router splits one client submit across replicas, each child ``submit``
 carries ``parent`` (the router-side parent job id), ``shard`` /
-``shards`` (this child's slot in the contig fan-out) and a derived
-``trace_id`` of ``<parent trace>.s<k>`` — the "." is in the trace-id
+``shards`` (this child's slot in the contig fan-out), the parent's
+``rounds`` field when set (each shard runs its own rounds over its
+contig subset) and a derived ``trace_id`` of ``<parent trace>.s<k>`` — the "." is in the trace-id
 charset precisely so child ids stay valid. Replicas journal the three
 fields on the child's ``received`` line for cross-correlation with the
 router's ledger and otherwise ignore them, which also means a child

@@ -156,7 +156,7 @@ class Job:
                  "priority", "deadline", "fault_plan", "strict",
                  "want_trace", "enqueued_t", "started_t", "response",
                  "event", "stats_ref", "trace_id", "want_progress",
-                 "want_stream", "tenant", "_outbox")
+                 "want_stream", "tenant", "rounds", "_outbox")
 
     def __init__(self, id_: str, sequences: str, overlaps: str,
                  target: str, options: dict, priority: int = 0,
@@ -165,7 +165,8 @@ class Job:
                  strict: bool | None = None, want_trace: bool = False,
                  trace_id: str | None = None,
                  want_progress: bool = False,
-                 want_stream: bool = False, tenant: str = ""):
+                 want_stream: bool = False, tenant: str = "",
+                 rounds: int | None = None):
         self.id = id_
         self.sequences = sequences
         self.overlaps = overlaps
@@ -187,6 +188,12 @@ class Job:
         self.want_stream = bool(want_stream)
         #: fair-scheduling identity ("" = the anonymous shared tenant)
         self.tenant = tenant or ""
+        #: serve-native polishing rounds (None = unspecified = 1): the
+        #: worker loops round k's stitched contigs back in as round
+        #: k+1's draft without leaving the warm process (server.py
+        #: `_run_job`, core/polisher.redraft). The response carries a
+        #: `rounds` accounting block only when the request asked.
+        self.rounds = rounds if rounds is None else max(1, int(rounds))
         self._outbox = DeliveryQueue()
         self.started_t: float | None = None
         self.response: dict | None = None

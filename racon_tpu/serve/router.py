@@ -863,6 +863,9 @@ class PolishRouter:
             queue_wait = 0.0
             exec_max = 0.0
             metrics: dict = {}
+            rounds_req = rounds_comp = 0
+            cache_hits = cache_misses = 0
+            rounds_cached = False
             for resp in merge.results:
                 serve = (resp or {}).get("serve") or {}
                 queue_wait = max(queue_wait,
@@ -872,6 +875,21 @@ class PolishRouter:
                 for mk, mv in ((resp or {}).get("metrics") or {}).items():
                     if isinstance(mv, (int, float)):
                         metrics[mk] = metrics.get(mk, 0) + mv
+                # each shard ran its own rounds over its contig subset:
+                # requested/completed agree across shards (max keeps a
+                # partial pre-rounds replica from zeroing the block),
+                # cache hit/miss totals sum
+                rb = (resp or {}).get("rounds") or {}
+                if rb:
+                    rounds_req = max(rounds_req,
+                                     int(rb.get("requested", 0)))
+                    rounds_comp = max(rounds_comp,
+                                      int(rb.get("completed", 0)))
+                    cache = rb.get("cache")
+                    if cache:
+                        rounds_cached = True
+                        cache_hits += int(cache.get("hits", 0))
+                        cache_misses += int(cache.get("misses", 0))
             out = {"type": "result", "job_id": job_id,
                    "serve": {"queue_wait_s": round(queue_wait, 4),
                              "exec_s": round(exec_max, 4)},
@@ -885,6 +903,14 @@ class PolishRouter:
                 out["trace_id"] = trace_id
             if metrics:
                 out["metrics"] = metrics
+            if rounds_req:
+                # no merged per_round: shard walls overlap in time, so
+                # per-round walls only mean something per replica
+                out["rounds"] = {"requested": rounds_req,
+                                 "completed": rounds_comp}
+                if rounds_cached:
+                    out["rounds"]["cache"] = {"hits": cache_hits,
+                                              "misses": cache_misses}
             if want_stream:
                 out["streamed"] = True
                 out["parts"] = merge.total_routed
@@ -922,7 +948,7 @@ class PolishRouter:
                        "parent": job_id, "shard": k, "shards": n_shards,
                        "trace_id": f"{trace_id or job_id}.s{k}"}
         for key in ("options", "priority", "deadline_s", "fault_plan",
-                    "strict", "tenant"):
+                    "strict", "tenant", "rounds"):
             if req.get(key) is not None:
                 child[key] = req[key]
         if want_progress:

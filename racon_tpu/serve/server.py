@@ -82,6 +82,10 @@ ALLOWED_OPTIONS = frozenset((
 
 DEFAULT_SOCKET = "/tmp/racon_tpu_serve.sock"
 
+#: hard cap on `rounds=N` per submit — polishing converges in 2-4
+#: rounds in practice; a runaway N must not pin a worker forever
+MAX_ROUNDS = 64
+
 
 def _env_int(name: str, default: int) -> int:
     try:
@@ -245,6 +249,48 @@ class ServeConfig:
         self.lane_quarantine = bool(kw.pop(
             "lane_quarantine",
             (env("RACON_TPU_LANE_QUARANTINE") or "1") != "0"))
+        # content-addressed window consensus cache (serve/wincache.py):
+        # off by default; armed, the batcher consults it before a
+        # window enters the pooled stream (a hit skips device dispatch)
+        # and populates it on iteration completion. Strict env parsing,
+        # mirroring the --metrics-port discipline: a typo'd value fails
+        # the start, never silently serves uncached
+        if "wincache" in kw:
+            self.wincache = bool(kw.pop("wincache"))
+        else:
+            raw = env("RACON_TPU_WINCACHE")
+            if raw:
+                try:
+                    self.wincache = bool(int(raw))
+                except ValueError:
+                    raise RaconError(
+                        "ServeConfig",
+                        f"invalid RACON_TPU_WINCACHE value {raw!r} "
+                        "(expected an integer)") from None
+            else:
+                self.wincache = False
+        from .wincache import DEFAULT_MAX_BYTES as _WINCACHE_DEFAULT
+
+        if "wincache_max_bytes" in kw:
+            self.wincache_max_bytes = int(kw.pop("wincache_max_bytes"))
+        else:
+            raw = env("RACON_TPU_WINCACHE_MAX_BYTES")
+            if raw:
+                try:
+                    self.wincache_max_bytes = int(raw)
+                except ValueError:
+                    raise RaconError(
+                        "ServeConfig",
+                        "invalid RACON_TPU_WINCACHE_MAX_BYTES value "
+                        f"{raw!r} (expected an integer)") from None
+            else:
+                self.wincache_max_bytes = _WINCACHE_DEFAULT
+        if self.wincache_max_bytes <= 0:
+            raise RaconError(
+                "ServeConfig",
+                f"invalid wincache_max_bytes "
+                f"{self.wincache_max_bytes} (expected a positive "
+                "integer)")
         self.warmup = kw.pop("warmup", True)
         self.max_frame = kw.pop("max_frame", max_frame_bytes())
         # telemetry exposition: None = no HTTP endpoint (the scrape RPC
@@ -425,6 +471,20 @@ class PolishServer:
                 flight_dir=cfg.flight_dir or None,
                 on_alert=self._on_audit_alert)
             self.batcher.auditor = self.auditor
+        #: content-addressed window consensus cache (serve/wincache.py)
+        #: — armed only when configured; with it off the batcher path,
+        #: the snapshot and the scrape are byte-identical to the
+        #: pre-cache server (test-pinned)
+        if cfg.wincache:
+            from .wincache import WindowCache
+
+            self.batcher.wincache = WindowCache(
+                max_bytes=cfg.wincache_max_bytes)
+        #: serve-native polishing rounds telemetry: jobs that requested
+        #: rounds, rounds completed, live in-flight gauge. The scrape
+        #: renders the families only once a rounds job has been seen
+        self._rounds_lock = threading.Lock()
+        self._rounds = {"jobs": 0, "completed": 0, "inflight": 0}
         #: flight recorder (obs/flight.py): installed at start() unless
         #: a full trace is already armed (then that recorder serves as
         #: the flight source too)
@@ -975,6 +1035,15 @@ class PolishServer:
                 FaultPlan.parse(fault_plan)
             except RaconError as exc:
                 return error_response("bad-request", str(exc))
+        # serve-native polishing rounds: validated here so a typo'd
+        # request fails typed instead of silently polishing once
+        rounds = req.get("rounds")
+        if rounds is not None and (
+                isinstance(rounds, bool) or not isinstance(rounds, int)
+                or not 1 <= rounds <= MAX_ROUNDS):
+            return error_response(
+                "bad-request",
+                f"rounds must be an integer in [1, {MAX_ROUNDS}]")
         with self._job_seq_lock:
             self._job_seq += 1
             job_id = f"j{self._job_seq}"
@@ -986,7 +1055,7 @@ class PolishServer:
                   trace_id=trace_id,
                   want_progress=bool(req.get("progress")),
                   want_stream=bool(req.get("stream")),
-                  tenant=tenant or "")
+                  tenant=tenant or "", rounds=rounds)
         # child-job fields from a serve router (router.py): `parent` is
         # the router-side parent job id, `shard`/`shards` this child's
         # slot in the contig fan-out. Purely observational replica-side
@@ -1006,6 +1075,7 @@ class PolishServer:
                                 priority=job.priority or None,
                                 tenant=job.tenant or None,
                                 deadline_s=req.get("deadline_s"),
+                                rounds=job.rounds,
                                 parent=parent, shard=shard,
                                 shards=shards)
         try:
@@ -1289,9 +1359,74 @@ class PolishServer:
                                  "name": seq.name,
                                  "fasta": part.decode("latin-1")})
 
-            polished = polisher.polish(
-                not opts.get("include_unpolished", False),
-                batcher=self.batcher, on_part=on_part)
+            drop = not opts.get("include_unpolished", False)
+            per_round: list[dict] = []
+            if job.rounds is None:
+                # no rounds requested: the pre-rounds single-pass path,
+                # byte-identical in output, journal and scrape
+                polished = polisher.polish(
+                    drop, batcher=self.batcher, on_part=on_part)
+            else:
+                # serve-native polishing rounds: round k's stitched
+                # contigs loop back as round k+1's draft WITHOUT
+                # leaving the warm process — in-process re-overlap +
+                # re-window (Polisher.redraft -> core/remap.py), warm
+                # engines/jit caches/autotune posture carried across.
+                # Only the FINAL round streams parts: the result_part
+                # contract (and obsreport's parts-streamed receipt)
+                # covers the job's authoritative output, not drafts.
+                rounds = job.rounds
+                with self._rounds_lock:
+                    self._rounds["jobs"] += 1
+                    self._rounds["inflight"] += 1
+                try:
+                    with tempfile.TemporaryDirectory(
+                            prefix=f"racon_serve_rounds_{job.id}_") \
+                            as workdir:
+                        for rnd in range(1, rounds + 1):
+                            final = rnd == rounds
+                            if self.journal is not None:
+                                self.journal.record(
+                                    "round-started", job=job.id,
+                                    trace=job.trace_id, round=rnd,
+                                    of=rounds)
+                            rt0 = time.perf_counter()
+                            polished = polisher.polish(
+                                drop, batcher=self.batcher,
+                                on_part=on_part if final else None)
+                            wall = time.perf_counter() - rt0
+                            batch = getattr(polisher, "serve_batch",
+                                            None) or {}
+                            info = {"round": rnd,
+                                    "wall_s": round(wall, 4),
+                                    "windows": batch.get("windows"),
+                                    "iterations": batch.get(
+                                        "iterations"),
+                                    "sequences": len(polished)}
+                            cache = getattr(polisher, "serve_cache",
+                                            None)
+                            if cache is not None:
+                                info["cache"] = dict(cache)
+                            per_round.append(info)
+                            self.hists.observe(f"serve.round_{rnd}",
+                                               wall)
+                            if self.journal is not None:
+                                self.journal.record(
+                                    "round-finished", job=job.id,
+                                    trace=job.trace_id, round=rnd,
+                                    of=rounds, wall_s=round(wall, 4),
+                                    sequences=len(polished),
+                                    cache_hits=(cache or {}).get(
+                                        "hits"))
+                            with self._rounds_lock:
+                                self._rounds["completed"] += 1
+                            if not final:
+                                polisher.redraft(polished, workdir,
+                                                 tag=f"r{rnd}")
+                                polisher.initialize()
+                finally:
+                    with self._rounds_lock:
+                        self._rounds["inflight"] -= 1
         # the response body comes from `polished`, NOT from the parts
         # collected in the callback: ContigStreamer swallows on_part
         # exceptions (streaming is decoration), so a callback bug may
@@ -1305,6 +1440,20 @@ class PolishServer:
                           "exec_s": round(time.perf_counter() - t0, 4),
                           "batch": getattr(polisher, "serve_batch",
                                            None)}}
+        if job.rounds is not None:
+            # rounds accounting block — present ONLY when the request
+            # asked for rounds (a plain submit's response shape is
+            # unchanged). Cache totals summed across rounds when the
+            # window cache is armed.
+            block = {"requested": job.rounds,
+                     "completed": len(per_round),
+                     "per_round": per_round}
+            caches = [i["cache"] for i in per_round if i.get("cache")]
+            if caches:
+                block["cache"] = {
+                    "hits": sum(c["hits"] for c in caches),
+                    "misses": sum(c["misses"] for c in caches)}
+            resp["rounds"] = block
         if job.want_stream:
             # the bytes already streamed as result_part frames; the
             # final frame carries the stats, not a second copy of the
@@ -1500,6 +1649,45 @@ class PolishServer:
                     "audit-sentinel lane health: 1 healthy, 0 "
                     "quarantined, 0.5 degraded (failed re-probe, "
                     "last serving lane)")
+        # content-addressed window cache families (serve/wincache.py)
+        # — rendered ONLY when the cache is armed, so a cache-off
+        # scrape stays byte-identical to the pre-cache exposition
+        # (test-pinned). The labeled ops family federates through
+        # FleetAggregator like any labeled series.
+        wc = self.batcher.wincache
+        if wc is not None:
+            c = wc.snapshot()
+            counters["serve.wincache.ops"] = obs_prom.Labeled(
+                [({"op": "eviction"}, c["evictions"]),
+                 ({"op": "hit"}, c["hits"]),
+                 ({"op": "invalidation"}, c["invalidations"]),
+                 ({"op": "miss"}, c["misses"]),
+                 ({"op": "put"}, c["puts"]),
+                 ({"op": "quarantined"}, c["quarantined"])],
+                "window consensus cache operations by outcome (a hit "
+                "skips device dispatch entirely)")
+            counters["serve.wincache.hit_bytes"] = (
+                c["hit_bytes"], "consensus bytes served straight from "
+                "the cache instead of a device iteration")
+            gauges["serve.wincache.bytes"] = (
+                c["bytes"], "resident cache payload bytes (LRU-bounded "
+                "by max_bytes)")
+            gauges["serve.wincache.entries"] = c["entries"]
+            gauges["serve.wincache.max_bytes"] = c["max_bytes"]
+        # serve-native rounds families — rendered only once a rounds
+        # job has been seen (same armed-only discipline)
+        with self._rounds_lock:
+            r = dict(self._rounds)
+        if r["jobs"]:
+            counters["serve.rounds_jobs"] = (
+                r["jobs"], "jobs that requested serve-native "
+                "polishing rounds (rounds=N on the submit frame)")
+            counters["serve.rounds_completed"] = (
+                r["completed"], "polishing rounds completed across "
+                "all rounds jobs")
+            gauges["serve.rounds_inflight"] = (
+                r["inflight"], "rounds jobs currently executing "
+                "(each loops drafts in-process between rounds)")
         # SLO burn-rate view (obs/fleet.py tracker, fed by the queue's
         # on_slo hook)
         burn = self.burn.state()
@@ -1633,6 +1821,19 @@ def serve_main(argv: list[str]) -> int:
                          "companions RACON_TPU_AUDIT_DEMOTE / "
                          "RACON_TPU_LANE_QUARANTINE gate the mismatch "
                          "consequences)")
+    ap.add_argument("--wincache", action="store_true", default=None,
+                    help="arm the content-addressed window cache: "
+                         "windows whose (content, engine parameters, "
+                         "kernel posture) key was already polished "
+                         "skip device dispatch entirely and reuse the "
+                         "stored consensus (RACON_TPU_WINCACHE; "
+                         "biggest win with rounds=N where later "
+                         "rounds converge; output stays "
+                         "byte-identical, audit-compatible)")
+    ap.add_argument("--wincache-max-bytes", type=int, default=None,
+                    help="window-cache capacity bound in bytes, "
+                         "LRU-evicted (RACON_TPU_WINCACHE_MAX_BYTES, "
+                         "default 64 MiB)")
     ap.add_argument("--no-warmup", action="store_true",
                     help="skip the synthetic warmup job (first real "
                          "request pays the compiles)")
@@ -1716,6 +1917,10 @@ def serve_main(argv: list[str]) -> int:
         kw["worker_lanes"] = args.worker_lanes
     if args.audit_rate is not None:
         kw["audit_rate"] = args.audit_rate
+    if args.wincache:
+        kw["wincache"] = True
+    if args.wincache_max_bytes is not None:
+        kw["wincache_max_bytes"] = args.wincache_max_bytes
     if args.gather_ms is not None:
         # deprecated alias: ServeConfig warns and maps it to max_wait_s
         kw["gather_window_s"] = args.gather_ms / 1000.0

@@ -1,0 +1,725 @@
+"""Serve-native polishing rounds + content-addressed window cache.
+
+The ISSUE-16 acceptance pins, in dependency order:
+
+  - a `rounds=N` submit's FASTA is BYTE-IDENTICAL to N chained solo
+    runs through `Polisher.redraft` — unix socket, TCP, and through
+    the shard-aware router at 2 replicas, with the window cache off
+    AND on (the cache is a dispatch skip, never an answer change);
+  - the response's `rounds` accounting block (requested / completed /
+    per-round walls), the journal's balanced `round-started` /
+    `round-finished` pairs, and the armed-only scrape families;
+  - the cache invalidates on winner-table demotion and lane
+    quarantine, and the identity-audit sentinel catches a DELIBERATELY
+    POISONED cache entry: the entry is evicted + its key quarantined
+    (no engine demotion, no lane quarantine — the device never
+    produced the bytes), the window repaired with oracle bytes, and
+    the job output still byte-identical;
+  - unit pins for core/remap.py (the in-process re-overlap mapper),
+    serve/wincache.py (LRU bound, quarantine, strict env parsing),
+    sched/autotune.posture_key, and the perfgate / obsreport /
+    servetop / fleet satellite surfaces.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+import types
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+from racon_tpu.core.polisher import PolisherType, create_polisher
+from racon_tpu.core.remap import (DEFAULT_K, build_index, remap_overlaps,
+                                  remap_read, revcomp, write_fasta,
+                                  write_paf)
+from racon_tpu.core.window import WindowType, create_window
+from racon_tpu.errors import RaconError
+from racon_tpu.obs.journal import read_journal
+from racon_tpu.sched.autotune import posture_key
+from racon_tpu.serve import (PolishClient, PolishRouter, PolishServer,
+                             make_synth_dataset)
+from racon_tpu.serve.client import ServeError
+from racon_tpu.serve.wincache import (WindowCache, window_content_digest,
+                                      wincache_from_env)
+
+N_ROUNDS = 3
+
+
+# --------------------------------------------------------------- fixtures
+@pytest.fixture(scope="module")
+def dataset(tmp_path_factory):
+    """Two independent contigs, so the router test shards 2 ways."""
+    return make_synth_dataset(str(tmp_path_factory.mktemp("rounds_data")),
+                              contigs=2)
+
+
+def chained_solo(paths, n: int) -> bytes:
+    """N polishing rounds the reference way: polish, re-draft through
+    Polisher.redraft (the SAME entry the serve rounds loop calls),
+    polish again — the byte-identity oracle for every rounds pin."""
+    with tempfile.TemporaryDirectory(prefix="rounds_chain_") as wd:
+        p = create_polisher(*paths, PolisherType.kC, 500, 10.0, 0.3,
+                            num_threads=2)
+        p.initialize()
+        polished = None
+        for rnd in range(1, n + 1):
+            polished = p.polish(True)
+            if rnd < n:
+                p.redraft(polished, wd, tag=f"r{rnd}")
+                p.initialize()
+    return b"".join(b">" + s.name.encode() + b"\n" + s.data + b"\n"
+                    for s in polished)
+
+
+@pytest.fixture(scope="module")
+def chained3(dataset):
+    return chained_solo(dataset, N_ROUNDS)
+
+
+# --------------------------------------------------- rounds byte identity
+def test_rounds_identity_unix_cache_off(dataset, chained3, tmp_path):
+    srv = PolishServer(socket_path=str(tmp_path / "s.sock"),
+                       workers=2, warmup=False).start()
+    try:
+        cli = PolishClient(socket_path=srv.config.socket_path)
+        res = cli.submit(*dataset, rounds=N_ROUNDS)
+        assert res.fasta == chained3
+        block = res.rounds
+        assert block["requested"] == N_ROUNDS
+        assert block["completed"] == N_ROUNDS
+        assert [p["round"] for p in block["per_round"]] == [1, 2, 3]
+        for p in block["per_round"]:
+            assert p["wall_s"] >= 0.0 and p["sequences"] >= 1
+            assert "cache" not in p  # cache off: no cache accounting
+        assert "cache" not in block
+        # rounds=1 is the single-pass result; a plain submit carries
+        # no rounds block at all (response shape unchanged)
+        r1 = cli.submit(*dataset, rounds=1)
+        plain = cli.submit(*dataset)
+        assert r1.fasta == plain.fasta
+        assert plain.rounds == {}
+        assert r1.rounds["completed"] == 1
+        # cache off: the scrape exposes NO wincache families (the
+        # armed-only discipline — byte-identical to pre-cache)
+        assert "wincache" not in cli.scrape()
+    finally:
+        srv.drain(timeout=15)
+
+
+def test_rounds_identity_cached_and_resubmit(dataset, chained3,
+                                             tmp_path):
+    srv = PolishServer(socket_path=str(tmp_path / "s.sock"),
+                       workers=2, warmup=False, wincache=True).start()
+    try:
+        cli = PolishClient(socket_path=srv.config.socket_path)
+        res = cli.submit(*dataset, rounds=N_ROUNDS)
+        assert res.fasta == chained3
+        cache = res.rounds["cache"]
+        assert cache["hits"] + cache["misses"] > 0
+        # converged later rounds hit entries round 1 populated
+        assert cache["hits"] > 0
+        # identical resubmit: EVERY window hits — zero device work
+        res2 = cli.submit(*dataset, rounds=N_ROUNDS)
+        assert res2.fasta == chained3
+        assert res2.rounds["cache"]["misses"] == 0
+        assert res2.rounds["cache"]["hits"] > 0
+        snap = srv.batcher.wincache.snapshot()
+        assert snap["entries"] > 0 and snap["hit_rate"] > 0.0
+        # armed families in the scrape
+        text = cli.scrape()
+        assert "racon_tpu_serve_wincache_ops_total" in text
+        assert 'op="hit"' in text
+        assert "racon_tpu_serve_rounds_inflight 0" in text
+        assert "racon_tpu_serve_rounds_jobs_total 2" in text
+        assert ("racon_tpu_serve_rounds_completed_total "
+                f"{2 * N_ROUNDS}") in text
+    finally:
+        srv.drain(timeout=15)
+
+
+def test_rounds_identity_tcp(dataset, chained3):
+    srv = PolishServer(port=0, workers=2, warmup=False,
+                       wincache=True).start()
+    try:
+        cli = PolishClient(port=srv.config.port)
+        res = cli.submit(*dataset, rounds=N_ROUNDS)
+        assert res.fasta == chained3
+        assert res.rounds["completed"] == N_ROUNDS
+    finally:
+        srv.drain(timeout=15)
+
+
+@pytest.mark.parametrize("cached", [False, True])
+def test_rounds_identity_through_router(dataset, chained3, tmp_path,
+                                        cached):
+    """2-replica router: each shard runs its own rounds over its
+    contig subset; the merge is byte-identical to the chained solo
+    bytes and carries the aggregated rounds block (no per_round — the
+    shard walls overlap in time)."""
+    kw = dict(workers=2, warmup=False)
+    if cached:
+        kw["wincache"] = True
+    reps = [PolishServer(socket_path=str(tmp_path / f"rep{i}.sock"),
+                         **kw).start() for i in range(2)]
+    router = PolishRouter(
+        replicas=",".join(r.config.socket_path for r in reps),
+        socket_path=str(tmp_path / "router.sock"),
+        health_interval_s=0.2).start()
+    try:
+        cli = PolishClient(socket_path=router.config.socket_path)
+        res = cli.submit(*dataset, rounds=N_ROUNDS)
+        assert res.fasta == chained3
+        assert res.rounds["requested"] == N_ROUNDS
+        assert res.rounds["completed"] == N_ROUNDS
+        assert "per_round" not in res.rounds
+        if cached:
+            assert res.rounds["cache"]["hits"] >= 0  # summed block
+        else:
+            assert "cache" not in res.rounds
+    finally:
+        router.drain()
+        for r in reps:
+            r.drain(timeout=15)
+
+
+def test_rounds_validation(dataset, tmp_path):
+    """A typo'd rounds value is a typed bad-request, not a queued job
+    that fails later — and booleans don't sneak in as integers."""
+    srv = PolishServer(socket_path=str(tmp_path / "s.sock"),
+                       workers=1, warmup=False).start()
+    try:
+        cli = PolishClient(socket_path=srv.config.socket_path)
+        for bad in (0, 65, -1):
+            with pytest.raises(ServeError) as exc_info:
+                cli.submit(*dataset, rounds=bad)
+            assert exc_info.value.code == "bad-request"
+        for bad in (True, "two", 1.5):
+            with pytest.raises(ServeError) as exc_info:
+                cli.request({"type": "submit",
+                             "sequences": dataset[0],
+                             "overlaps": dataset[1],
+                             "target": dataset[2], "rounds": bad})
+            assert exc_info.value.code == "bad-request"
+    finally:
+        srv.drain(timeout=15)
+
+
+def test_rounds_journal_boundaries(dataset, tmp_path):
+    """Each round journals a started/finished pair; obsreport's
+    check_rounds sees them balanced and --check stays rc 0."""
+    import obsreport
+
+    jpath = str(tmp_path / "journal.jsonl")
+    srv = PolishServer(socket_path=str(tmp_path / "s.sock"),
+                       workers=1, warmup=False, journal=jpath).start()
+    try:
+        cli = PolishClient(socket_path=srv.config.socket_path)
+        cli.submit(*dataset, rounds=N_ROUNDS)
+    finally:
+        srv.drain(timeout=15)
+    entries = read_journal(jpath)
+    started = [e for e in entries if e.get("event") == "round-started"]
+    finished = [e for e in entries
+                if e.get("event") == "round-finished"]
+    assert len(started) == N_ROUNDS and len(finished) == N_ROUNDS
+    assert [e["round"] for e in started] == [1, 2, 3]
+    assert all(e["of"] == N_ROUNDS for e in started)
+    assert all(e["wall_s"] >= 0.0 for e in finished)
+    recv = next(e for e in entries if e.get("event") == "received")
+    assert recv["rounds"] == N_ROUNDS
+    assert obsreport.main(["--journal", jpath, "--check",
+                           "--flight-dir",
+                           str(tmp_path / "none")]) == 0
+    assert obsreport.check_rounds(entries) == []
+
+
+# ------------------------------------------------- cache invalidation
+def test_cache_invalidated_on_quarantine_and_demotion(dataset,
+                                                      chained3,
+                                                      tmp_path):
+    """Lane quarantine and winner-table demotion both flush the cache
+    (the producer's identity is no longer trusted) — and polishing
+    after the flush still reproduces the chained bytes."""
+    srv = PolishServer(socket_path=str(tmp_path / "s.sock"),
+                       workers=2, warmup=False, wincache=True).start()
+    try:
+        cli = PolishClient(socket_path=srv.config.socket_path)
+        cli.submit(*dataset, rounds=N_ROUNDS)
+        wc = srv.batcher.wincache
+        assert wc.snapshot()["entries"] > 0
+        srv.batcher.flush_lane_engines()  # what a demotion calls
+        snap = wc.snapshot()
+        assert snap["entries"] == 0 and snap["invalidations"] == 1
+        cli.submit(*dataset)  # repopulate
+        assert wc.snapshot()["entries"] > 0
+        srv.batcher.quarantine_lane(0)
+        snap = wc.snapshot()
+        assert snap["entries"] == 0 and snap["invalidations"] == 2
+        res = cli.submit(*dataset, rounds=N_ROUNDS)
+        assert res.fasta == chained3
+    finally:
+        srv.drain(timeout=15)
+
+
+# ------------------------------------------- audit catches poisoned entry
+def test_audit_catches_poisoned_cache_entry(dataset, tmp_path):
+    """THE cache-safety pin: corrupt every cached consensus behind the
+    server's back, resubmit with the sentinel at rate 1.0 — each hit's
+    shadow re-execution catches the divergence, quarantines + evicts
+    the ENTRY (no engine demotion, no lane quarantine: the device
+    never produced those bytes), repairs the window with oracle bytes,
+    and the job's FASTA is byte-identical to the clean run."""
+    jpath = str(tmp_path / "journal.jsonl")
+    srv = PolishServer(socket_path=str(tmp_path / "s.sock"),
+                       workers=1, warmup=False, wincache=True,
+                       audit_rate=1.0, journal=jpath).start()
+    try:
+        cli = PolishClient(socket_path=srv.config.socket_path)
+        clean = cli.submit(*dataset)
+        assert srv.auditor.snapshot()["mismatches"] == 0
+        wc = srv.batcher.wincache
+        with wc._lock:
+            assert wc._entries
+            for key, (cons, pol) in list(wc._entries.items()):
+                flip = b"T" if cons[:1] != b"T" else b"A"
+                wc._entries[key] = (flip + cons[1:], pol)
+        res = cli.submit(*dataset)
+        # repaired: output unharmed despite the poisoned entries
+        assert res.fasta == clean.fasta
+        audit = srv.auditor.snapshot()
+        assert audit["mismatches"] > 0
+        assert audit["repaired"] >= audit["mismatches"]
+        assert audit["demotions"] == 0  # the entry took the blame
+        snap = srv.batcher.snapshot()
+        assert all(l["health"] == 1.0 and not l["quarantined"]
+                   for l in snap["lanes"])
+        cache = wc.snapshot()
+        assert cache["quarantined"] >= audit["mismatches"]
+        # the journal carries the typed verdict, lane-labeled "cache"
+        mism = [e for e in read_journal(jpath)
+                if e.get("event") == "audit-mismatch"]
+        assert mism and all(e["lane"] == "cache"
+                            and e["cache"] == "entry-quarantined"
+                            for e in mism)
+        # a condemned key stays refused: the same content re-dispatches
+        res3 = cli.submit(*dataset)
+        assert res3.fasta == clean.fasta
+        assert srv.auditor.snapshot()["mismatches"] == \
+            audit["mismatches"]
+    finally:
+        srv.drain(timeout=15)
+
+
+# --------------------------------------------------------- wincache units
+def _win(seed: int = 0, length: int = 40, type_=WindowType.kNGS):
+    import random
+
+    rng = random.Random(seed)
+    bb = "".join(rng.choice("ACGT") for _ in range(length))
+    w = create_window(0, seed, type_, bb.encode(), b"!" * length)
+    w.add_layer(bb.encode(), None, 0, length - 1)
+    return w
+
+
+def test_content_digest_keys_content_and_type():
+    assert window_content_digest(_win(1)) == window_content_digest(
+        _win(1))
+    assert window_content_digest(_win(1)) != window_content_digest(
+        _win(2))
+    assert window_content_digest(_win(1)) != window_content_digest(
+        _win(1, type_=WindowType.kTGS))
+
+
+def test_posture_key_shape_and_stability():
+    key = posture_key()
+    assert isinstance(key, tuple) and len(key) == 5
+    assert key == posture_key()
+    # a different posture must produce a different cache key for the
+    # same content under the same engine parameters
+    w, ek = _win(3), ("engine", 1)
+    k1 = WindowCache.key(w, ek, posture=("0", "auto", "0", True, "cpu"))
+    k2 = WindowCache.key(w, ek, posture=("1", "auto", "0", True, "cpu"))
+    assert k1 != k2
+    assert WindowCache.key(w, ("engine", 2), k1[2]) != k1
+
+
+def test_wincache_lru_eviction_and_counters():
+    wc = WindowCache(max_bytes=600)  # ~2 entries of 100B + overhead
+    for i in range(3):
+        wc.store((i,), bytes(100), True)
+    snap = wc.snapshot()
+    assert snap["entries"] == 2 and snap["evictions"] == 1
+    assert wc.lookup((0,)) is None          # evicted oldest
+    assert wc.lookup((1,)) is not None
+    assert wc.lookup((2,)) is not None
+    wc.lookup((1,))  # refreshes recency: (2,) is now the LRU entry
+    wc.store((3,), bytes(100), True)
+    assert wc.lookup((2,)) is None and wc.lookup((1,)) is not None
+    snap = wc.snapshot()
+    assert snap["hits"] == 4 and snap["misses"] == 2
+    assert snap["hit_bytes"] == 400
+    assert snap["bytes"] <= wc.max_bytes
+
+
+def test_wincache_quarantine_refuses_restore():
+    wc = WindowCache()
+    wc.store(("k",), b"bytes", True)
+    wc.quarantine(("k",))
+    assert wc.lookup(("k",)) is None
+    wc.store(("k",), b"bytes", True)        # a poisoned producer retries
+    assert wc.lookup(("k",)) is None
+    assert wc.quarantined(("k",))
+    snap = wc.snapshot()
+    assert snap["quarantined"] == 1 and snap["entries"] == 0
+    # invalidate_all drops entries but keeps the condemnation
+    wc.store(("ok",), b"x", True)
+    assert wc.invalidate_all("test") == 1
+    assert wc.snapshot()["entries"] == 0
+    wc.store(("k",), b"bytes", True)
+    assert wc.lookup(("k",)) is None
+
+
+def test_wincache_env_strict(monkeypatch):
+    monkeypatch.delenv("RACON_TPU_WINCACHE", raising=False)
+    monkeypatch.delenv("RACON_TPU_WINCACHE_MAX_BYTES", raising=False)
+    assert wincache_from_env() is None
+    monkeypatch.setenv("RACON_TPU_WINCACHE", "0")
+    assert wincache_from_env() is None
+    monkeypatch.setenv("RACON_TPU_WINCACHE", "1")
+    wc = wincache_from_env()
+    assert isinstance(wc, WindowCache)
+    monkeypatch.setenv("RACON_TPU_WINCACHE_MAX_BYTES", "4096")
+    assert wincache_from_env().max_bytes == 4096
+    # strict: a typo fails loudly, naming the variable — never a
+    # silently uncached server
+    monkeypatch.setenv("RACON_TPU_WINCACHE", "yes")
+    with pytest.raises(RaconError, match="RACON_TPU_WINCACHE"):
+        wincache_from_env()
+    monkeypatch.setenv("RACON_TPU_WINCACHE", "1")
+    for bad in ("64MiB", "0", "-1"):
+        monkeypatch.setenv("RACON_TPU_WINCACHE_MAX_BYTES", bad)
+        with pytest.raises(RaconError,
+                           match="RACON_TPU_WINCACHE_MAX_BYTES"):
+            wincache_from_env()
+
+
+# ------------------------------------------------------------ remap units
+def _seq(name: str, data: bytes):
+    return types.SimpleNamespace(name=name, data=data)
+
+
+def _genome(seed: int = 7, n: int = 600) -> bytes:
+    import random
+
+    rng = random.Random(seed)
+    return bytes(rng.choice(b"ACGT") for _ in range(n))
+
+
+def test_revcomp():
+    assert revcomp(b"AAACCC") == b"GGGTTT"
+    assert revcomp(b"ACGTN") == b"NACGT"
+    assert revcomp(revcomp(b"GATTACA")) == b"GATTACA"
+
+
+def test_remap_read_forward_and_tagged_name():
+    g = _genome()
+    target = _seq("ctg1 LN:i:600 RC:i:12 XC:f:0.99", g)
+    index = build_index([target])
+    read = _seq("r0", g[100:300])
+    row = remap_read(read, [target], index)
+    assert row is not None
+    f = row.split("\t")
+    # PAF target name must be the TAG-STRIPPED first token (a FASTA
+    # re-parse keeps only that; a tagged name would drop every row)
+    assert f[5] == "ctg1"
+    assert f[0] == "r0" and f[4] == "+"
+    q_len, q0, q1 = int(f[1]), int(f[2]), int(f[3])
+    t_len, t0, t1 = int(f[6]), int(f[7]), int(f[8])
+    assert q_len == 200 and t_len == 600
+    assert 0 <= q0 < q1 <= q_len
+    assert 100 <= t0 < t1 <= 300  # anchors on the true diagonal
+    assert int(f[9]) <= int(f[10])  # matches <= alignment length
+
+
+def test_remap_read_reverse_strand_coordinates():
+    g = _genome()
+    target = _seq("ctg1", g)
+    index = build_index([target])
+    read = _seq("r1", revcomp(g[250:450]))
+    row = remap_read(read, [target], index)
+    assert row is not None
+    f = row.split("\t")
+    assert f[4] == "-"
+    # '-' rows carry query coordinates in the FORWARD read frame
+    q_len, q0, q1 = int(f[1]), int(f[2]), int(f[3])
+    t0, t1 = int(f[7]), int(f[8])
+    assert 0 <= q0 < q1 <= q_len
+    assert 250 <= t0 < t1 <= 450
+
+
+def test_remap_overlaps_deterministic_and_drops_unanchored():
+    g = _genome()
+    targets = [_seq("a", g[:300]), _seq("b", g[300:])]
+    reads = [_seq("r0", g[50:250]),
+             _seq("r1", g[350:550]),
+             _seq("junk", _genome(seed=99, n=200))]  # anchors nowhere
+    rows = remap_overlaps(reads, targets)
+    assert rows == remap_overlaps(reads, targets)  # deterministic
+    names = [r.split("\t")[0] for r in rows]
+    assert names == ["r0", "r1"]
+    assert rows[0].split("\t")[5] == "a"
+    assert rows[1].split("\t")[5] == "b"
+
+
+def test_remap_write_helpers(tmp_path):
+    paf = write_paf(["a\t1", "b\t2"], str(tmp_path / "o.paf"))
+    assert open(paf).read() == "a\t1\nb\t2\n"
+    fa = write_fasta([_seq("c1 LN:i:4", b"ACGT")],
+                     str(tmp_path / "d.fasta"))
+    assert open(fa, "rb").read() == b">c1 LN:i:4\nACGT\n"
+
+
+def test_repeat_filter_drops_flooded_kmers():
+    poly = _seq("t", b"A" * 200)
+    index = build_index([poly], max_occ=16)
+    assert index == {}  # one k-mer, 200-15+1 occurrences: dropped
+    read = _seq("r", b"A" * 60)
+    assert remap_read(read, [poly], index) is None
+
+
+# ------------------------------------------------------- perfgate pins
+def _write(path, doc):
+    with open(path, "w") as fh:
+        json.dump(doc, fh)
+    return str(path)
+
+
+def rounds_artifact(speedup=2.0, identical=True, hit=0.4, resub=1.0,
+                    mismatches=0):
+    art = {"mode": "rounds", "jobs": 3,
+           "rounds": {"requested": 4, "completed": 4,
+                      "round2_speedup_x": speedup},
+           "cache": {"identical": identical, "hit_rate": hit,
+                     "hits": 20, "misses": 30,
+                     "resubmit": {"hit_rate": resub,
+                                  "speedup_x": 3.0}},
+           "audit": {"rate": 0.05, "mismatches": mismatches,
+                     "repaired": mismatches},
+           "pass": True}
+    return art
+
+
+def test_perfgate_rounds_gates(tmp_path, capsys):
+    import perfgate
+
+    art = _write(tmp_path / "R.json", rounds_artifact(speedup=2.0))
+    # absolute cache gates alone carry the verdict (no implicit
+    # baseline needed), and the explicit floor gates alongside
+    assert perfgate.main(["--artifact", art]) == 0
+    assert perfgate.main(["--artifact", art,
+                          "--round2-speedup-min", "1.5"]) == 0
+    err = capsys.readouterr().err
+    assert "cache.identical" in err and "rounds.round2_speedup_x" in err
+    assert perfgate.main(["--artifact", art,
+                          "--round2-speedup-min", "2.5"]) == 1
+
+
+def test_perfgate_rounds_identity_and_hit_rate_fail(tmp_path):
+    import perfgate
+
+    art = _write(tmp_path / "R.json",
+                 rounds_artifact(identical=False))
+    assert perfgate.main(["--artifact", art]) == 1
+    art = _write(tmp_path / "R2.json",
+                 rounds_artifact(hit=0.0, resub=0.0))
+    assert perfgate.main(["--artifact", art]) == 1
+    art = _write(tmp_path / "R3.json", rounds_artifact(mismatches=2))
+    assert perfgate.main(["--artifact", art]) == 1
+    # first cached pass near zero is fine when the resubmit proves the
+    # cache engaged
+    art = _write(tmp_path / "R4.json",
+                 rounds_artifact(hit=0.0, resub=1.0))
+    assert perfgate.main(["--artifact", art]) == 0
+
+
+def test_perfgate_round2_min_mandatory_names_key(tmp_path, capsys):
+    import perfgate
+
+    # an artifact without the gated key is a BROKEN gate naming it
+    art = rounds_artifact()
+    del art["rounds"]["round2_speedup_x"]
+    path = _write(tmp_path / "R.json", art)
+    assert perfgate.main(["--artifact", path]) == 2
+    assert "rounds.round2_speedup_x" in capsys.readouterr().err
+    # ... and so is requesting the floor over a non-rounds artifact
+    synth = _write(tmp_path / "S.json",
+                   {"mode": "synth",
+                    "synth": {"windows_per_s": 6.0}})
+    assert perfgate.main(["--artifact", synth,
+                          "--windows-per-s-min", "5.0",
+                          "--round2-speedup-min", "1.0"]) == 2
+    assert "rounds.round2_speedup_x" in capsys.readouterr().err
+
+
+def test_repo_rounds_artifact_passes():
+    """Acceptance half: the committed rounds artifact gates green with
+    the speedup floor the CI invocation uses."""
+    import subprocess
+
+    art = os.path.join(REPO, "SERVEBENCH_rounds_r16.json")
+    if not os.path.isfile(art):
+        pytest.skip("no SERVEBENCH_rounds artifact in this checkout")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "perfgate.py"),
+         "--artifact", art, "--round2-speedup-min", "1.0"],
+        capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stderr
+    doc = json.load(open(art))
+    assert doc["pass"] and doc["cache"]["identical"]
+    assert max(doc["cache"]["hit_rate"],
+               doc["cache"]["resubmit"]["hit_rate"]) > 0.0
+
+
+# ------------------------------------------------------- obsreport pins
+def _journal(tmp_path, events):
+    path = tmp_path / "j.jsonl"
+    t = time.time()
+    with open(path, "w") as fh:
+        for i, e in enumerate(events):
+            fh.write(json.dumps(dict(e, t=t + i * 0.01)) + "\n")
+    return str(path)
+
+
+def _lifecycle(job, rounds_events):
+    return ([{"event": "received", "job": job},
+             {"event": "admitted", "job": job},
+             {"event": "started", "job": job}]
+            + rounds_events
+            + [{"event": "finished", "job": job, "sequences": 0}])
+
+
+def test_obsreport_unbalanced_rounds_is_red(tmp_path, capsys):
+    import obsreport
+
+    path = _journal(tmp_path, _lifecycle("j1", [
+        {"event": "round-started", "job": "j1", "round": 1, "of": 2},
+        {"event": "round-finished", "job": "j1", "round": 1, "of": 2},
+        {"event": "round-started", "job": "j1", "round": 2, "of": 2},
+    ]))
+    rc = obsreport.main(["--journal", path, "--check",
+                         "--flight-dir", str(tmp_path / "none")])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "2 round-started events vs 1 round-finished" in out
+
+
+def test_obsreport_rotation_window_tolerated():
+    import obsreport
+
+    # round lines whose `received` fell out of the rotation window are
+    # history loss, not a lifecycle bug — same tolerance as the other
+    # checks
+    entries = [{"event": "round-finished", "job": "old", "round": 3,
+                "of": 3}]
+    assert obsreport.check_rounds(entries) == []
+    entries = [{"event": "received", "job": "j"},
+               {"event": "round-started", "job": "j", "round": 1},
+               {"event": "round-finished", "job": "j", "round": 1}]
+    assert obsreport.check_rounds(entries) == []
+
+
+# ------------------------------------------- servetop + fleet satellite
+def _wincache_scrape():
+    from racon_tpu.obs import prom
+
+    return prom.render(
+        {"serve.batch.iterations": 5,
+         "serve.wincache.ops": prom.Labeled(
+             [({"op": "eviction"}, 2), ({"op": "hit"}, 30),
+              ({"op": "invalidation"}, 1), ({"op": "miss"}, 10),
+              ({"op": "put"}, 12), ({"op": "quarantined"}, 1)]),
+         "serve.wincache.hit_bytes": 8192,
+         "serve.rounds_jobs": 4, "serve.rounds_completed": 12},
+        {"serve.queue_depth": 0, "serve.inflight": 1,
+         "serve.worker_lanes": 1,
+         "serve.wincache.bytes": 4096, "serve.wincache.entries": 9,
+         "serve.wincache.max_bytes": 1 << 26,
+         "serve.rounds_inflight": 1})
+
+
+def test_servetop_cache_cell_and_rounds_suffix():
+    import servetop
+
+    from racon_tpu.obs import prom
+
+    parsed = prom.parse(_wincache_scrape())
+    cell = servetop.cache_cell(parsed)
+    assert cell == {"hit_pct": 75.0, "hits": 30, "bytes": 4096,
+                    "entries": 9, "evictions": 2, "quarantined": 1}
+    # a cache-off replica renders no cell
+    plain = prom.parse(prom.render({"serve.batch.iterations": 5}, {}))
+    assert servetop.cache_cell(plain) is None
+
+    class _RS:
+        endpoint = "r0"
+        ok = True
+        draining = False
+        error = None
+        scrape_s = 0.001
+
+    rs = _RS()
+    rs.parsed = parsed
+    row = servetop.replica_row(rs, {}, 0.0)
+    assert row["cache"]["hits"] == 30
+
+    class _Snap:
+        replicas = [rs]
+        poll_s = 0.01
+        counters = parsed.counters
+        gauges = parsed.gauges
+        counter_series = parsed.counter_series
+        gauge_series = parsed.gauge_series
+
+    screen = servetop.render_screen(_Snap(), {}, [row], {}, 0.0)
+    assert "wincache" in screen and "hit%" in screen
+    line = servetop.fleet_line(_Snap(), {}, {}, 0.0)
+    assert "rounds 1 infl (12r/4j)" in line
+    # no rounds job seen anywhere -> no suffix (armed-only)
+    class _Plain:
+        replicas = []
+        poll_s = 0.01
+        counters = plain.counters
+        gauges = plain.gauges
+        counter_series = plain.counter_series
+        gauge_series = plain.gauge_series
+
+    assert "rounds" not in servetop.fleet_line(_Plain(), {}, {}, 0.0)
+
+
+def test_fleet_federates_wincache_families():
+    from racon_tpu.obs import prom
+    from racon_tpu.obs.fleet import (FleetAggregator, FleetSnapshot,
+                                     ReplicaSample)
+
+    snap = FleetSnapshot()
+    for k in range(2):
+        rs = ReplicaSample(f"r{k}")
+        rs.parsed = prom.parse(_wincache_scrape())
+        rs.ok = True
+        snap.replicas.append(rs)
+    FleetAggregator._merge(snap)
+    series = snap.counter_series["racon_tpu_serve_wincache_ops_total"]
+    by_op = {labels["op"]: v for labels, v in series.values()}
+    assert by_op["hit"] == 60 and by_op["miss"] == 20
+    assert snap.counters[
+        "racon_tpu_serve_wincache_hit_bytes_total"] == 16384
+    assert snap.counters["racon_tpu_serve_rounds_jobs_total"] == 8
+    assert snap.gauges["racon_tpu_serve_rounds_inflight"] == 2
+    assert snap.gauges["racon_tpu_serve_wincache_bytes"] == 8192

@@ -30,7 +30,13 @@ results lifecycle: every successfully `finished` job must carry exactly
 one `part-streamed` event per output contig (the server journals one
 per stitched part — continuous batching stitches EVERY serve job
 incrementally), so a lost or duplicated part shows up as a red check,
-not a silent hole in the stream."""
+not a silent hole in the stream — and the iterative-rounds lifecycle:
+a `rounds=N` job journals a `round-started` / `round-finished` pair
+per round (annotation events, rendered in the job's timeline with the
+round's wall clock and window-cache hit count), and `--check` pins
+the two counts equal per job, so a round that died mid-loop (or a
+duplicated boundary line) is a red check, not a plausible-looking
+timeline."""
 
 from __future__ import annotations
 
@@ -171,6 +177,7 @@ def main(argv=None) -> int:
 
     problems = check_consistency(entries)
     problems += check_parts_streamed(entries)
+    problems += check_rounds(entries)
     for p in problems:
         print(f"consistency: {p}", file=out)
     print(f"consistency: {'OK' if not problems else 'FAIL'} "
@@ -212,6 +219,41 @@ def check_parts_streamed(entries: list[dict]) -> list[str]:
             problems.append(
                 f"job {job}: {n_parts} part-streamed events for "
                 f"{n_seqs} output sequences")
+    return problems
+
+
+def check_rounds(entries: list[dict]) -> list[str]:
+    """Iterative-rounds invariant: every `round-started` a job journals
+    must be balanced by exactly one `round-finished` (the server emits
+    the pair around each round of a `rounds=N` job). An unbalanced
+    count means a round died mid-loop without its boundary line — or a
+    duplicated/lost journal write. Jobs whose `received` line fell out
+    of the journal's rotation window are skipped (the same tolerance
+    check_consistency and check_parts_streamed apply): their early
+    round lines may be in the discarded generation."""
+    started: dict[str, int] = {}
+    finished: dict[str, int] = {}
+    received: set[str] = set()
+    for e in entries:
+        job = e.get("job")
+        if not job:
+            continue
+        if e.get("event") == "received":
+            received.add(str(job))
+        elif e.get("event") == "round-started":
+            started[str(job)] = started.get(str(job), 0) + 1
+        elif e.get("event") == "round-finished":
+            finished[str(job)] = finished.get(str(job), 0) + 1
+    problems: list[str] = []
+    for job in sorted(set(started) | set(finished)):
+        if job not in received:
+            continue
+        n_started = started.get(job, 0)
+        n_finished = finished.get(job, 0)
+        if n_started != n_finished:
+            problems.append(
+                f"job {job}: {n_started} round-started events vs "
+                f"{n_finished} round-finished")
     return problems
 
 

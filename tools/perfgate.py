@@ -56,6 +56,19 @@ Metric extraction understands both artifact shapes:
     RELATIVELY only against an explicit `--against` router artifact —
     there is no implicit baseline for a replica-count sweep.
 
+  - servebench `--rounds` artifacts (`"mode": "rounds"`) carry
+    `rounds` / `cache` blocks (serve-native iterative polishing with
+    the content-addressed window cache): `cache.identical` — the
+    cached rounds FASTA byte-equal to the cache-off bytes — gates
+    whenever the block is present, as does a NONZERO `cache.hit_rate`
+    (a cache that never engaged measured nothing) and, when the
+    artifact carries an audit block, `audit.mismatches` == 0;
+    `rounds.round2_speedup_x` (mean no-cache round-2+ wall over mean
+    cached round-2+ wall) gates ABSOLUTELY against
+    `--round2-speedup-min` (mandatory once requested, rc 2 naming the
+    dotted key when absent). Like router sweeps, rounds artifacts have
+    no implicit baseline.
+
   - synthbench `--json` artifacts (`"mode": "synth"`):
     `synth.windows_per_s`, HIGHER is better — gated ABSOLUTELY against
     `--windows-per-s-min` (the kernel-plane regression floor) and
@@ -221,6 +234,23 @@ def extract(doc: dict, path: str = "<artifact>") -> dict:
         if isinstance(inner.get("mesh"), dict):
             out["mesh"] = inner["mesh"]
         return out
+    if inner.get("mode") == "rounds":
+        # servebench --rounds artifact: the round-2+ speedup of the
+        # content-addressed window cache, HIGHER is better. No implicit
+        # baseline (the cache-off arm inside the artifact IS the
+        # comparison) — the cache block's identity/hit-rate gates carry
+        # the verdict; --round2-speedup-min adds the absolute floor.
+        value = _lookup(inner, "rounds.round2_speedup_x")
+        if value is None:
+            raise GateError(
+                f"{path}: artifact lacks gated metric "
+                "'rounds.round2_speedup_x'")
+        out = {"name": "rounds round-2+ cache speedup",
+               "value": float(value), "unit": "x",
+               "higher_better": True, "kind": "rounds"}
+        if isinstance(inner.get("mesh"), dict):
+            out["mesh"] = inner["mesh"]
+        return out
     if inner.get("mode") == "synth":
         # synthbench --json artifact: windows_per_s, HIGHER is better.
         # No implicit baseline exists for it (the published BASELINE
@@ -300,6 +330,11 @@ def resolve_baseline(cand: dict, args, candidate_path: str) -> tuple:
         # router block's absolute gates carry the verdict
         raise GateError("router artifact has no implicit baseline "
                         "(use --router-scaling-min and/or --against)")
+    if cand.get("kind") == "rounds":
+        # the cache-off arm inside the artifact is the comparison
+        # point; the cache block's absolute gates carry the verdict
+        raise GateError("rounds artifact has no implicit baseline "
+                        "(use --round2-speedup-min and/or --against)")
     if cand.get("kind") == "synth":
         # a published sample-workload baseline is not comparable with a
         # synthetic-scale run; synth artifacts gate absolutely and/or
@@ -510,6 +545,65 @@ def router_checks(doc: dict, args,
     return checks
 
 
+def cache_checks(doc: dict, args,
+                 candidate_path: str) -> list[tuple[str, bool, str]]:
+    """Window-cache gates for servebench --rounds artifacts:
+    (name, ok, detail) triples. Whenever the artifact carries a
+    `cache` block: `cache.identical` must be true (cached rounds must
+    reproduce the cache-off bytes exactly — the cache is a dispatch
+    skip, never an answer change), `cache.hit_rate` must be NONZERO
+    when recorded (an artifact whose cache never engaged measured
+    nothing), and `audit.mismatches` must be zero when the sentinel
+    rode the cached run. `--round2-speedup-min X` additionally gates
+    `rounds.round2_speedup_x` >= X, mandatory once requested — an
+    artifact without the key exits 2 naming it."""
+    explicit = args.round2_speedup_min is not None
+    inner = doc.get("parsed", doc)
+    cache = inner.get("cache") if isinstance(inner, dict) else None
+    if not isinstance(cache, dict):
+        if explicit:
+            raise GateError(
+                f"{candidate_path}: artifact lacks gated metric "
+                "'rounds.round2_speedup_x' (--round2-speedup-min "
+                "gates servebench --rounds artifacts)")
+        return []
+    identical = bool(cache.get("identical"))
+    checks = [("cache.identical", identical,
+               "cached rounds FASTA byte-identical to cache-off"
+               if identical else
+               "cached rounds FASTA DIVERGED from the cache-off "
+               "bytes")]
+    hit_rate = cache.get("hit_rate")
+    if hit_rate is not None:
+        # the first cached pass may legitimately sit near zero on a
+        # non-converging workload; the resubmit rate is the floor that
+        # proves the cache engaged at all
+        resub = _lookup(cache, "resubmit.hit_rate")
+        best = max(float(hit_rate), float(resub or 0.0))
+        checks.append(("cache.hit_rate", best > 0.0,
+                       f"{best:g} > 0"
+                       + ("" if best > 0.0 else
+                          " (the cache never engaged)")))
+    mism = _lookup(inner, "audit.mismatches")
+    if mism is not None:
+        checks.append(("audit.mismatches", float(mism) == 0.0,
+                       f"{mism:g} == 0"
+                       + ("" if not mism else
+                          " (sentinel mismatch over cached rounds = "
+                          "a poisoned entry reached output)")))
+    if explicit:
+        speedup = _lookup(inner, "rounds.round2_speedup_x")
+        if speedup is None:
+            raise GateError(
+                f"{candidate_path}: artifact lacks gated metric "
+                "'rounds.round2_speedup_x'")
+        limit = float(args.round2_speedup_min)
+        checks.append(("rounds.round2_speedup_x",
+                       float(speedup) >= limit,
+                       f"{speedup:g} >= {limit:g}"))
+    return checks
+
+
 def fused_checks(cand: dict, args,
                  candidate_path: str) -> list[tuple[str, float, float]]:
     """Host-overhead gate for artifacts carrying a `fused` block
@@ -635,6 +729,11 @@ def run(args) -> int:
             # (identity + requeues, plus --router-scaling-min): no
             # baseline needed unless a relative --against was asked for
             reference, ref_desc, ref = None, "", None
+        elif cand.get("kind") == "rounds" and not args.against:
+            # rounds artifacts carry the cache-off arm internally:
+            # identity + hit-rate gates (plus --round2-speedup-min)
+            # are absolute, no external baseline required
+            reference, ref_desc, ref = None, "", None
         else:
             raise
     # mesh comparability resolves BEFORE any relative verdict prints: a
@@ -693,6 +792,12 @@ def run(args) -> int:
               f"(limit {limit:g}s, {kind})", file=sys.stderr)
     for name, check_ok, detail in router_checks(doc, args,
                                                 candidate_path):
+        failures += 0 if check_ok else 1
+        print(f"[perfgate] {'PASS' if check_ok else 'FAIL'}: "
+              f"{os.path.basename(candidate_path)} {name} ({detail})",
+              file=sys.stderr)
+    for name, check_ok, detail in cache_checks(doc, args,
+                                               candidate_path):
         failures += 0 if check_ok else 1
         print(f"[perfgate] {'PASS' if check_ok else 'FAIL'}: "
               f"{os.path.basename(candidate_path)} {name} ({detail})",
@@ -788,6 +893,17 @@ def main(argv=None) -> int:
                          "Router artifacts are also always gated on "
                          "router.identical and router.requeues == 0 "
                          "whenever the block is present")
+    ap.add_argument("--round2-speedup-min", type=float, default=None,
+                    help="absolute floor on the window-cache round-2+ "
+                         "speedup (rounds.round2_speedup_x: mean "
+                         "no-cache round-2+ wall over mean cached "
+                         "round-2+ wall, servebench --rounds "
+                         "artifacts); mandatory once passed — an "
+                         "artifact without the key exits 2 naming the "
+                         "dotted key. Rounds artifacts are also always "
+                         "gated on cache.identical, a nonzero "
+                         "cache.hit_rate and audit.mismatches == 0 "
+                         "whenever those keys are present")
     ap.add_argument("--scale-balance-max", type=float, default=None,
                     help="per-shard useful-cell balance bound (max/min) "
                          "for synthbench --scale-curve artifacts "

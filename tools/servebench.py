@@ -58,6 +58,22 @@ tools/perfgate.py gates at the <2% observability budget and at ZERO
 mismatches (a mismatch on a clean bench workload is a corruption bug,
 and also fails the bench directly).
 
+ROUNDS MODE (`--rounds N`): serve-native iterative polishing with the
+content-addressed window cache. One warm cache-OFF server runs a
+`rounds=N` job (the no-cache per-round walls and the byte-identity
+reference), then one warm cache-ON server (serve/wincache.py armed,
+optionally with the audit sentinel riding at `--audit-rate`) runs the
+SAME job twice — the first submit measures convergence hits (later
+rounds re-polish windows whose content already stabilized, so they
+skip device dispatch), the second measures the identical-resubmit
+ceiling (everything hits). The artifact gains `rounds` (per-round
+walls cache-on vs cache-off, `round2_speedup_x` = mean no-cache
+round-2+ wall over mean cached round-2+ wall) and `cache`
+(`identical` byte-equality cache-on vs cache-off, hit rates, the
+cache snapshot) blocks; tools/perfgate.py gates `cache.identical`
+whenever the block is present and `rounds.round2_speedup_x` via
+`--round2-speedup-min`.
+
 OPEN-LOOP ARRIVAL MODE (`--qps`, optionally a `--qps-curve` sweep):
 instead of firing the whole wave at once (closed-loop, back-pressure
 hides the queueing), jobs arrive by a Poisson process at the target
@@ -456,6 +472,172 @@ def run_router_bench(args, PolishClient, PolishServer) -> int:
     return 0
 
 
+def run_rounds_bench(args, PolishClient, PolishServer) -> int:
+    """`--rounds N`: iterative serve-native polishing with and without
+    the content-addressed window cache. Three submits, two warm
+    servers:
+
+      1. cache OFF, `rounds=N`  -> byte-identity reference + the
+         no-cache per-round walls;
+      2. cache ON,  `rounds=N`  -> convergence hits: rounds whose
+         windows stopped changing skip device dispatch;
+      3. cache ON,  `rounds=N` again -> the identical-resubmit
+         ceiling (every window hits, zero device iterations).
+
+    Gates (exit status): all three FASTAs byte-identical, every submit
+    completed all N rounds, the cached run saw a NONZERO hit rate, and
+    — when `--audit-rate` armed the sentinel on the cached server —
+    zero audit mismatches. The `--json` artifact carries `rounds` /
+    `cache` blocks for tools/perfgate.py (`cache.identical`,
+    `rounds.round2_speedup_x` via `--round2-speedup-min`)."""
+    n = max(1, args.rounds)
+    fail: list[str] = []
+    with tempfile.TemporaryDirectory(prefix="racon_roundsbench_") as tmp:
+        print(f"[servebench] rounds bench: {n} rounds, cache off vs "
+              f"on (+ resubmit)", file=sys.stderr)
+        paths = build_dataset(tmp, args.genome_kb, args.coverage,
+                              args.read_len, args.seed,
+                              contigs=args.contigs)
+        base_kw = dict(workers=args.workers, warmup=False,
+                       job_threads=args.threads,
+                       tpu_poa_batches=args.tpupoa_batches,
+                       tpu_aligner_batches=args.tpualigner_batches)
+
+        off = PolishServer(socket_path=os.path.join(tmp, "off.sock"),
+                           **base_kw)
+        off.warmup(paths=paths)
+        off.start()
+        try:
+            r_off = PolishClient(
+                socket_path=off.config.socket_path).submit(
+                *paths, rounds=n)
+        finally:
+            off.drain(timeout=30)
+
+        on_kw = dict(base_kw, wincache=True)
+        if args.audit_rate is not None:
+            on_kw["audit_rate"] = args.audit_rate
+        on = PolishServer(socket_path=os.path.join(tmp, "on.sock"),
+                          **on_kw)
+        on.warmup(paths=paths)
+        on.start()
+        try:
+            client = PolishClient(socket_path=on.config.socket_path)
+            r_on = client.submit(*paths, rounds=n)
+            r_on2 = client.submit(*paths, rounds=n)
+            cache_snap = on.batcher.wincache.snapshot()
+            audit_snap = (on.auditor.snapshot()
+                          if on.auditor is not None else None)
+        finally:
+            on.drain(timeout=30)
+
+    identical = (r_on.fasta == r_off.fasta
+                 and r_on2.fasta == r_off.fasta)
+    if not identical:
+        fail.append("cached rounds FASTA diverged from the cache-off "
+                    "bytes")
+    for tag, r in (("off", r_off), ("on", r_on), ("resubmit", r_on2)):
+        if r.rounds.get("completed") != n:
+            fail.append(f"{tag} submit completed "
+                        f"{r.rounds.get('completed')}/{n} rounds")
+
+    def _walls(res):
+        return [p["wall_s"] for p in res.rounds.get("per_round", [])]
+
+    def _rate(res):
+        c = res.rounds.get("cache") or {}
+        total = c.get("hits", 0) + c.get("misses", 0)
+        return round(c.get("hits", 0) / total, 4) if total else 0.0
+
+    off_w, on_w, on2_w = _walls(r_off), _walls(r_on), _walls(r_on2)
+    # round-2+ speedup: round 1 always pays full dispatch (and, warmed
+    # on the bench's own shapes, may hit warmup-populated entries) —
+    # the cache's claim is about LATER rounds, where converged windows
+    # repeat verbatim
+    off_r2 = statistics.mean(off_w[1:]) if len(off_w) > 1 else None
+    on_r2 = statistics.mean(on_w[1:]) if len(on_w) > 1 else None
+    speedup = (round(off_r2 / max(on_r2, 1e-9), 3)
+               if off_r2 is not None and on_r2 is not None else None)
+    resub_x = (round(statistics.mean(off_w)
+                     / max(statistics.mean(on2_w), 1e-9), 3)
+               if off_w and on2_w else None)
+    hit_rate, hit_rate2 = _rate(r_on), _rate(r_on2)
+    if hit_rate2 <= 0.0:
+        fail.append("cached resubmit saw a zero hit rate — the cache "
+                    "never engaged")
+    if audit_snap is not None and audit_snap["mismatches"]:
+        fail.append(f"audit sentinel caught "
+                    f"{audit_snap['mismatches']} mismatches with the "
+                    "window cache armed")
+
+    print(f"[servebench] rounds x{n} cache-off walls: "
+          + " ".join(f"{w:.2f}" for w in off_w), file=sys.stderr)
+    print(f"[servebench] rounds x{n} cache-on  walls: "
+          + " ".join(f"{w:.2f}" for w in on_w)
+          + f"  (hit rate {hit_rate * 100:.1f}%)", file=sys.stderr)
+    print(f"[servebench] rounds x{n} resubmit  walls: "
+          + " ".join(f"{w:.2f}" for w in on2_w)
+          + f"  (hit rate {hit_rate2 * 100:.1f}%)", file=sys.stderr)
+    if speedup is not None:
+        print(f"[servebench] round-2+ mean: {off_r2:.3f}s no-cache vs "
+              f"{on_r2:.3f}s cached — x{speedup:.2f} "
+              f"[{'OK' if speedup > 1.0 else 'FAIL'}]; resubmit "
+              f"x{resub_x:.2f}", file=sys.stderr)
+    if audit_snap is not None:
+        print(f"[servebench] audit over cached rounds: "
+              f"{audit_snap['audited']} audited "
+              f"({audit_snap['mismatches']} mismatches) "
+              f"[{'OK' if not audit_snap['mismatches'] else 'FAIL'}]",
+              file=sys.stderr)
+    print(f"[servebench] identity cache-on vs cache-off: "
+          f"[{'OK' if identical else 'FAIL'}]", file=sys.stderr)
+
+    if args.json:
+        rounds_block = {
+            "requested": n,
+            "completed": r_on.rounds.get("completed"),
+            "per_round": r_on.rounds.get("per_round"),
+            "per_round_nocache": r_off.rounds.get("per_round"),
+            "round2plus_nocache_mean_s": (round(off_r2, 4)
+                                          if off_r2 is not None
+                                          else None),
+            "round2plus_cached_mean_s": (round(on_r2, 4)
+                                         if on_r2 is not None
+                                         else None),
+            "round2_speedup_x": speedup,
+        }
+        cache_block = {
+            "identical": identical,
+            "hit_rate": hit_rate,
+            "resubmit": {"hit_rate": hit_rate2,
+                         "per_round": r_on2.rounds.get("per_round"),
+                         "speedup_x": resub_x},
+            "snapshot": cache_snap,
+        }
+        cb = r_on.rounds.get("cache") or {}
+        cache_block.update(hits=cb.get("hits"), misses=cb.get("misses"))
+        artifact = {"mode": "rounds", "jobs": 3,
+                    "rounds": rounds_block, "cache": cache_block,
+                    "pass": not fail}
+        if audit_snap is not None:
+            artifact["audit"] = {"rate": args.audit_rate,
+                                 "windows": audit_snap["windows"],
+                                 "sampled": audit_snap["sampled"],
+                                 "audited": audit_snap["audited"],
+                                 "mismatches": audit_snap["mismatches"],
+                                 "repaired": audit_snap["repaired"]}
+        with open(args.json, "w") as fh:
+            json.dump(artifact, fh, indent=2, sort_keys=True)
+        print(f"[servebench] wrote {args.json}", file=sys.stderr)
+
+    if fail:
+        for f in fail:
+            print(f"[servebench] FAIL: {f}", file=sys.stderr)
+        return 1
+    print("[servebench] PASS", file=sys.stderr)
+    return 0
+
+
 def run_openloop(client, paths, qps: float, n_jobs: int,
                  seed: int) -> dict:
     """One open-loop wave: Poisson arrivals at `qps`, every job
@@ -610,6 +792,15 @@ def main(argv=None) -> int:
                          "byte-identity vs a direct submit, scaling_x) "
                          "that tools/perfgate.py gates via "
                          "router.identical and --router-scaling-min")
+    ap.add_argument("--rounds", type=int, default=None,
+                    help="rounds bench mode: run a rounds=N iterative "
+                         "polish on a cache-off and a cache-on warm "
+                         "server (plus an identical resubmit) and "
+                         "report per-round walls, cache hit rates and "
+                         "the round-2+ speedup — the artifact gains "
+                         "`rounds` / `cache` blocks that "
+                         "tools/perfgate.py gates via cache.identical "
+                         "and --round2-speedup-min")
     ap.add_argument("--fleet-poll-s", type=float, default=0.25,
                     help="fleet mode: aggregator poll interval during "
                          "the wave (default 0.25s)")
@@ -663,6 +854,9 @@ def main(argv=None) -> int:
 
     if args.router is not None:
         return run_router_bench(args, PolishClient, PolishServer)
+
+    if args.rounds is not None:
+        return run_rounds_bench(args, PolishClient, PolishServer)
 
     cold_n = args.cold_runs if args.cold_runs is not None \
         else min(args.jobs, 3)

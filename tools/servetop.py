@@ -27,7 +27,14 @@ scrapes through obs/fleet.py, and redraws one screen per poll:
     families): one cell per replica with the sentinel's sampled/s rate,
     confirmed mismatches, online winner demotions and the worst lane
     health, plus [ALERT] while racon_tpu_audit_alert is up — the live
-    silent-data-corruption view.
+    silent-data-corruption view;
+  - CACHE rows (rendered only when a replica armed the content-
+    addressed window cache, serve/wincache.py): per replica the hit
+    rate, resident bytes/entries, LRU evictions and quarantined
+    entries — the dispatch-skip economics at a glance;
+  - a ROUNDS suffix on the fleet line (rendered only once some replica
+    ran a rounds=N job): iterative-rounds jobs in flight right now
+    plus the lifetime completed-rounds/jobs counters.
 
 On a TTY the screen redraws in place; on a pipe it degrades to one
 summary line per poll (greppable, CI-friendly). `--once` polls once
@@ -90,6 +97,25 @@ def audit_cell(p, prev: dict, dt: float) -> dict | None:
             "alert": bool(p.gauges.get("racon_tpu_audit_alert", 0))}
 
 
+def cache_cell(p) -> dict | None:
+    """One replica's window-cache cell from the wincache scrape
+    families, or None when the replica doesn't expose them (cache
+    unarmed — the families are armed-only, like the audit ones)."""
+    if p is None or "racon_tpu_serve_wincache_bytes" not in p.gauges:
+        return None
+    ops = {labels.get("op"): v for labels, v in
+           p.counter_series.get("racon_tpu_serve_wincache_ops_total",
+                                {}).values()}
+    hits = ops.get("hit", 0)
+    lookups = hits + ops.get("miss", 0)
+    return {"hit_pct": hits / lookups * 100.0 if lookups else 0.0,
+            "hits": int(hits),
+            "bytes": int(_g(p, "racon_tpu_serve_wincache_bytes")),
+            "entries": int(_g(p, "racon_tpu_serve_wincache_entries")),
+            "evictions": int(ops.get("eviction", 0)),
+            "quarantined": int(ops.get("quarantined", 0))}
+
+
 def replica_row(rs, prev: dict, dt: float) -> dict:
     """One replica's console row, with rates from the previous poll."""
     p = rs.parsed
@@ -112,7 +138,8 @@ def replica_row(rs, prev: dict, dt: float) -> dict:
             "lanes_busy": lanes_busy, "lanes": lanes_total,
             "compiles": int(_c(p, G + "compiles_total")),
             "scrape_ms": rs.scrape_s * 1e3,
-            "audit": audit_cell(p, prev, dt)}
+            "audit": audit_cell(p, prev, dt),
+            "cache": cache_cell(p)}
 
 
 def tenant_rows(snap) -> list[dict]:
@@ -155,7 +182,8 @@ def fleet_line(snap, burn: dict, prev: dict, dt: float) -> str:
             f"{' [FIRING]' if burn.get('firing') else ''}"
             f"  iters {int(iters)} ({rate:.1f}/s)"
             f"  compiles {int(snap.counters.get(G + 'compiles_total', 0))}"
-            + _fleet_audit(snap) + _fleet_router(snap))
+            + _fleet_audit(snap) + _fleet_rounds(snap)
+            + _fleet_router(snap))
 
 
 def _fleet_audit(snap) -> str:
@@ -169,6 +197,21 @@ def _fleet_audit(snap) -> str:
     return (f"  audit {mism} mism"
             + ("  [AUDIT-ALERT]"
                if snap.gauges.get("racon_tpu_audit_alert", 0) else ""))
+
+
+def _fleet_rounds(snap) -> str:
+    """Iterative-rounds suffix (empty until some replica ran a
+    rounds=N job — the families are armed-only): rounds jobs in flight
+    now, plus the lifetime completed-rounds / rounds-jobs counters."""
+    if "racon_tpu_serve_rounds_inflight" not in snap.gauges:
+        return ""
+    inflight = int(snap.gauges.get("racon_tpu_serve_rounds_inflight",
+                                   0))
+    jobs = int(snap.counters.get("racon_tpu_serve_rounds_jobs_total",
+                                 0))
+    done = int(snap.counters.get(
+        "racon_tpu_serve_rounds_completed_total", 0))
+    return f"  rounds {inflight} infl ({done}r/{jobs}j)"
 
 
 def _fleet_router(snap) -> str:
@@ -226,6 +269,17 @@ def render_screen(snap, burn: dict, rows: list[dict], prev: dict,
         lines.append("")
         lines.append("autotune  " + "  ".join(
             f"{tag}={n}" for tag, n in tunes))
+    cache_rows = [(r["endpoint"], r["cache"]) for r in rows
+                  if r.get("cache")]
+    if cache_rows:
+        lines.append("")
+        lines.append(f"{'wincache':<36} {'hit%':>6} {'MiB':>7} "
+                     f"{'entr':>5} {'evict':>5} {'quar':>4}")
+        for endpoint, c in cache_rows:
+            lines.append(
+                f"{endpoint:<36} {c['hit_pct']:>6.1f} "
+                f"{c['bytes'] / (1 << 20):>7.2f} {c['entries']:>5} "
+                f"{c['evictions']:>5} {c['quarantined']:>4}")
     audit_rows = [(r["endpoint"], r["audit"]) for r in rows
                   if r.get("audit")]
     if audit_rows:
