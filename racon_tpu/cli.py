@@ -18,6 +18,7 @@ HELP = """\
 usage: racon_tpu [options ...] <sequences> <overlaps> <target sequences>
        racon_tpu serve [serve options ...]
        racon_tpu submit [submit options ...] <sequences> <overlaps> <target>
+       racon_tpu cancel --socket SOCK (--job-id ID | --trace-id ID)
        racon_tpu router [router options ...]
        racon_tpu fleet [fleet options ...]
 
@@ -37,6 +38,12 @@ usage: racon_tpu [options ...] <sequences> <overlaps> <target sequences>
                 `--tenant` names the fair-scheduling bucket, and
                 `--trace-out t.json` writes one merged client+server
                 Chrome trace of the request
+        cancel  cancel a queued or running job by --job-id or
+                --trace-id (name jobs via `submit --trace-id`): queued
+                jobs dequeue with a typed `cancelled` error to their
+                submitter, running jobs withdraw at the next
+                iteration/round boundary; through the router the
+                cancel fans out to the job's shards
         router  shard-aware front-end over N warm serve replicas: one
                 submit is split by contig across routable replicas
                 (wrapper partition math, output byte-identical to a
@@ -424,6 +431,10 @@ def main(argv: list[str] | None = None) -> int:
         from .serve.client import submit_main
 
         return submit_main(argv[1:])
+    if argv and argv[0] == "cancel":
+        from .serve.client import cancel_main
+
+        return cancel_main(argv[1:])
     if argv and argv[0] == "router":
         from .serve.router import router_main
 

@@ -32,8 +32,9 @@ benchmarks: tools/servebench.py; failure matrix: tools/faultcheck.py
 """
 
 from .batcher import WindowBatcher
-from .client import (JobFailed, PolishClient, PolishResult, QueueFull,
-                     ServeError, ServerDraining, TenantQuota)
+from .client import (DeadlineDoomed, JobCancelled, JobFailed,
+                     PolishClient, PolishResult, QueueFull, ServeError,
+                     ServerDraining, TenantQuota)
 from .queue import Job, JobQueue
 from .router import PolishRouter, RouterConfig
 from .server import PolishServer, ServeConfig, make_synth_dataset
@@ -42,4 +43,5 @@ __all__ = ["WindowBatcher", "PolishClient", "PolishResult", "PolishServer",
            "PolishRouter", "RouterConfig",
            "ServeConfig", "Job", "JobQueue", "ServeError", "QueueFull",
            "ServerDraining", "TenantQuota", "JobFailed",
+           "JobCancelled", "DeadlineDoomed",
            "make_synth_dataset"]

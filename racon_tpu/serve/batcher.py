@@ -68,6 +68,26 @@ PipelineStats) accumulates in the counters, rides the
 `serve.iteration` trace span and the `serve.iteration_host` histogram
 — the dispatch-overhead number servebench and the scrape expose.
 
+PREEMPTION (the QoS layer, serve/server.py `--preempt`): a running
+job's NOT-YET-DISPATCHED pooled windows can be withdrawn between
+iterations (`withdraw_job` — the entries move, tuples intact, into a
+parked store keyed by serve job id; the job's consumer thread keeps
+waiting on its ticket, its already-delivered windows and ContigStreamer
+state untouched) and later returned (`resume_job` — the entries rejoin
+their pools carrying their ORIGINAL arrival sequence, so the oldest-
+window guarantee and byte-identity both survive: per-window consensus
+is independent of batch composition, and a resumed job's output is
+exactly what an undisturbed run would have produced). `cancel_job`
+rides the same ticket-error withdrawal seam an iteration failure uses:
+the ticket dies typed (`queue.JobCancelledError`), the feeder drops its
+pooled windows at the next scan, and the job's own thread re-raises to
+the worker. The iteration-boundary speculative deadline-abort
+(`abort_margin` + the polisher's `serve_deadline`) extrapolates the
+remaining windows' finish time from this job's observed per-window rate
+after every delivered batch and raises `queue.DeadlineDoomed` when the
+deadline is provably lost — device time stops burning within one
+iteration, not at job completion.
+
 WORKER LANES (`worker_lanes` / RACON_TPU_WORKER_LANES / `serve
 --worker-lanes`, default 1 = the single-feeder behavior): the device
 list partitions into K contiguous SUB-MESHES (parallel.mesh
@@ -326,6 +346,24 @@ class WindowBatcher:
         self._feeders: list[threading.Thread | None] = []
         self._stop = False
         self._held = False
+        #: QoS preemption state (all `_cond`-guarded). `_withdrawn`:
+        #: serve job ids whose pooled windows are currently parked —
+        #: consulted at pooling time too, so a window arriving AFTER
+        #: the withdraw parks directly instead of racing the feeder.
+        #: `_parked`: job id -> list of (engine_key, pool_entry), the
+        #: withdrawn entries verbatim (original arrival_seq preserved:
+        #: resume restores the oldest-window ordering exactly).
+        #: `_job_tickets`: serve job id -> live tickets, the handle
+        #: cancel_job uses to kill a running job through the ticket-
+        #: error seam.
+        self._withdrawn: set[str] = set()
+        self._parked: dict[str, list] = {}
+        self._job_tickets: dict[str, list] = {}
+        #: speculative deadline-abort margin (seconds) or None = off;
+        #: the server wires it from RACON_TPU_SERVE_ABORT_MARGIN /
+        #: --abort-margin. Consulted on the JOB thread at iteration
+        #: boundaries against the polisher's `serve_deadline`.
+        self.abort_margin: float | None = None
         #: the identity-audit sentinel (obs/audit.WindowAuditor) or
         #: None; the server wires it when RACON_TPU_AUDIT_RATE > 0.
         #: Audits run on the feeder thread AFTER the lane lock is
@@ -454,6 +492,7 @@ class WindowBatcher:
                 if ticket.remaining <= 0:
                     ticket.finish()
         now = time.monotonic()
+        job_id = getattr(polisher, "serve_job_id", None)
         if pend:
             with self._cond:
                 if self._stop:
@@ -463,9 +502,22 @@ class WindowBatcher:
                         "WindowBatcher",
                         "batcher is closed (server draining)")
                 self._ensure_feeder_locked()
-                pool = self._pools.setdefault(ticket.key, [])
-                for w in pend:
-                    pool.append([next(self._entry_seq), now, ticket, w])
+                if job_id is not None:
+                    self._job_tickets.setdefault(
+                        job_id, []).append(ticket)
+                entries = [[next(self._entry_seq), now, ticket, w]
+                           for w in pend]
+                if job_id is not None and job_id in self._withdrawn:
+                    # the job was preempted before these windows
+                    # pooled (an iterative-rounds job re-entering, or
+                    # a withdraw racing the submit): park them
+                    # directly — never let a preempted job's windows
+                    # slip into the next extraction
+                    self._parked.setdefault(job_id, []).extend(
+                        (ticket.key, e) for e in entries)
+                else:
+                    self._pools.setdefault(ticket.key,
+                                           []).extend(entries)
                 self._cond.notify_all()
         # consume deliveries ON THIS THREAD: the incremental-stitch
         # callback (and whatever it does — journal writes, frame
@@ -473,32 +525,85 @@ class WindowBatcher:
         # from it propagates and fails THIS job loudly, exactly like
         # the isolation path above — a stitch bug must not silently
         # truncate a "successful" result
+        deadline = getattr(polisher, "serve_deadline", None)
+        t_run0 = time.perf_counter()
         try:
-            while True:
-                ws = ticket.take(timeout=0.1)
-                if ws is not None:
+            try:
+                while True:
+                    ws = ticket.take(timeout=0.1)
+                    if ws is not None:
+                        if on_windows is not None:
+                            on_windows(ws)
+                        self._doomed_check(ticket, deadline, t_run0)
+                        continue
+                    if ticket.event.is_set():
+                        break
+                while True:  # feeder set event after its last deliver
+                    ws = ticket.take()
+                    if ws is None:
+                        break
                     if on_windows is not None:
                         on_windows(ws)
-                    continue
-                if ticket.event.is_set():
-                    break
-            while True:  # feeder set the event after its last deliver
-                ws = ticket.take()
-                if ws is None:
-                    break
-                if on_windows is not None:
-                    on_windows(ws)
-        except BaseException as exc:
-            # mark the ticket dead so the feeder WITHDRAWS its
-            # remaining pooled windows instead of burning device
-            # iterations on a job whose client already got an error
-            with self._cond:
-                if ticket.error is None:
-                    ticket.error = exc
-            raise
+            except BaseException as exc:
+                # mark the ticket dead so the feeder WITHDRAWS its
+                # remaining pooled windows instead of burning device
+                # iterations on a job whose client already got an error
+                with self._cond:
+                    if ticket.error is None:
+                        ticket.error = exc
+                raise
+        finally:
+            if job_id is not None:
+                with self._cond:
+                    ts = self._job_tickets.get(job_id)
+                    if ts is not None:
+                        try:
+                            ts.remove(ticket)
+                        except ValueError:
+                            pass
+                        if not ts:
+                            del self._job_tickets[job_id]
+                    # a ticket leaving errored while preempted strands
+                    # its parked entries (nothing will resume a dead
+                    # job) — drop them here; an unerrored ticket never
+                    # reaches this point with entries still parked
+                    parked = self._parked.get(job_id)
+                    if parked:
+                        parked[:] = [pe for pe in parked
+                                     if pe[1][2] is not ticket]
+                        if not parked:
+                            del self._parked[job_id]
+                            self._withdrawn.discard(job_id)
         if ticket.error is not None:
             raise ticket.error
         polisher.serve_batch = ticket.batch_info()
+
+    def _doomed_check(self, ticket: _Ticket, deadline: float | None,
+                      t0: float) -> None:
+        """Iteration-boundary speculative deadline-abort (runs on the
+        JOB thread after each delivered batch): extrapolate the
+        remaining windows' finish from this job's observed per-window
+        rate; when even that optimistic estimate (the queue ahead of
+        us is ignored) overshoots the deadline by more than the
+        configured margin, the job is provably doomed — fail it typed
+        NOW instead of burning device iterations on a result the
+        client will discard. `deadline` is the queue's absolute
+        perf_counter deadline (Job.deadline, stamped on the polisher
+        as `serve_deadline`)."""
+        margin = self.abort_margin
+        if deadline is None or margin is None:
+            return
+        done, remaining = ticket.done, ticket.remaining
+        if done <= 0 or remaining <= 0:
+            return
+        now = time.perf_counter()
+        predicted_s = (now - t0) / done * remaining
+        remaining_s = deadline - now
+        if predicted_s > remaining_s + margin:
+            from .queue import DeadlineDoomed
+
+            raise DeadlineDoomed(predicted_s, remaining_s,
+                                 phase="mid-run")
 
     # ----------------------------------------------------------- lanes
     def _lanes_locked(self) -> list[_Lane]:
@@ -1061,6 +1166,86 @@ class WindowBatcher:
             self.counters["max_windows_in_iteration"] = max(
                 self.counters["max_windows_in_iteration"], windows)
 
+    # ------------------------------------------------- preemption / QoS
+    def withdraw_job(self, job_id: str) -> int:
+        """Preempt a running job: move its not-yet-dispatched pooled
+        windows into the parked store (tuples verbatim — original
+        arrival sequence preserved for the resume) and mark the job
+        withdrawn so windows it pools LATER (iterative rounds, a
+        racing submit) park directly. Windows already inside an
+        extracted iteration complete and deliver normally — preemption
+        is a between-iterations operation, which is exactly what keeps
+        the job's ContigStreamer state intact and its eventual output
+        byte-identical. Returns the number of entries parked. Safe on
+        ids the batcher has never seen (the withdrawn mark still
+        guards future pooling)."""
+        with self._cond:
+            self._withdrawn.add(job_id)
+            parked = self._parked.setdefault(job_id, [])
+            n = 0
+            for key, pool in list(self._pools.items()):
+                keep = []
+                for e in pool:
+                    if getattr(e[2].polisher, "serve_job_id",
+                               None) == job_id:
+                        parked.append((key, e))
+                        n += 1
+                    else:
+                        keep.append(e)
+                if len(keep) != len(pool):
+                    if keep:
+                        self._pools[key] = keep
+                    else:
+                        del self._pools[key]
+            if not parked:
+                del self._parked[job_id]
+            return n
+
+    def resume_job(self, job_id: str) -> int:
+        """Return a preempted job's parked windows to their pools and
+        clear its withdrawn mark. The entries rejoin carrying their
+        ORIGINAL arrival sequence, so the feeder's oldest-window
+        guarantee treats them with their true age — a resumed job goes
+        back to the front of the line it already earned, and the
+        packing it lands in cannot change its bytes (per-window
+        consensus is batch-composition-independent). Returns the
+        number of entries returned."""
+        with self._cond:
+            self._withdrawn.discard(job_id)
+            parked = self._parked.pop(job_id, [])
+            for key, e in parked:
+                self._pools.setdefault(key, []).append(e)
+            if parked:
+                self._cond.notify_all()
+            return len(parked)
+
+    def cancel_job(self, job_id: str) -> bool:
+        """Cancel a RUNNING job through the ticket-error withdrawal
+        seam (the same path a failed shared iteration uses): its live
+        tickets die with a typed `queue.JobCancelledError`, the feeder
+        drops their still-pooled windows at its next scan, parked
+        entries are purged, and the job's own consumer thread re-raises
+        to the worker — which answers the client with the typed
+        `cancelled` terminal. Returns False when the job has no live
+        ticket here (isolation/solo jobs never pool; the server falls
+        back to its round-boundary cancel flag)."""
+        from .queue import JobCancelledError
+
+        with self._cond:
+            tickets = list(self._job_tickets.get(job_id) or ())
+            if not tickets:
+                return False
+            exc = JobCancelledError("running")
+            for t in tickets:
+                if t.error is None:
+                    t.error = exc
+            self._parked.pop(job_id, None)
+            self._withdrawn.discard(job_id)
+            self._cond.notify_all()
+        for t in tickets:
+            t.finish()
+        return True
+
     # ------------------------------------------------------- test hooks
     def hold(self) -> None:
         """Pause the feeder BEFORE it extracts its next iteration
@@ -1090,6 +1275,12 @@ class WindowBatcher:
                  "quarantined": l.quarantined,
                  "reprobes": l.reprobes}
                 for l in (self._lanes or ())]
+            # armed-only (byte-identity when QoS is unconfigured):
+            # surfaced only while a preemption is actually in flight
+            if self._withdrawn or self._parked:
+                out["withdrawn_jobs"] = len(self._withdrawn)
+                out["parked_windows"] = sum(
+                    len(v) for v in self._parked.values())
         stats = self._merged_stats()
         compiles, compile_s = self._compile_totals(stats)
         out["compiles"] = compiles

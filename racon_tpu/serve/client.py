@@ -13,6 +13,11 @@ strings:
     ServerDraining  server is shutting down, resubmit elsewhere
     JobFailed       the job ran and failed; `error_type` names the
                     errors.py class (DeviceError, DeviceTimeout, ...)
+    JobCancelled    the job was cancelled (cancel RPC / cancel-on-
+                    timeout) before finishing
+    DeadlineDoomed  the server speculatively aborted: predicted finish
+                    past the deadline by more than its abort margin
+                    (carries `predicted_s` / `remaining_s`)
     ServeError      anything else typed (bad-request, bad-frame, ...)
 
 `racon_tpu submit ...` (cli.py) is the CLI face: same three positional
@@ -106,8 +111,26 @@ class JobFailed(ServeError):
         self.error_type = response.get("error_type", "RaconError")
 
 
+class JobCancelled(ServeError):
+    """The job was cancelled before it finished — by an explicit
+    `cancel` RPC or by this client's own `cancel_on_timeout`."""
+
+
+class DeadlineDoomed(ServeError):
+    """The server aborted speculatively: the predicted finish exceeds
+    the job's deadline by more than the server's abort margin (at
+    admission or mid-run)."""
+
+    def __init__(self, code, message, response):
+        super().__init__(code, message, response)
+        self.predicted_s = float(response.get("predicted_s", 0.0))
+        self.remaining_s = float(response.get("remaining_s", 0.0))
+
+
 _ERROR_TYPES = {"queue-full": QueueFull, "draining": ServerDraining,
-                "tenant-quota": TenantQuota, "job-failed": JobFailed}
+                "tenant-quota": TenantQuota, "job-failed": JobFailed,
+                "cancelled": JobCancelled,
+                "deadline-doomed": DeadlineDoomed}
 
 
 class PolishResult:
@@ -274,7 +297,8 @@ class PolishClient:
                trace: bool = False, trace_id: str | None = None,
                tenant: str | None = None, rounds: int | None = None,
                on_progress=None, on_part=None, stream: bool = False,
-               recorder=None, retries: int = 0) -> PolishResult:
+               recorder=None, retries: int = 0,
+               cancel_on_timeout: bool = False) -> PolishResult:
         """Polish one input triple on the server. Paths are resolved to
         absolute before they cross the wire (the server's cwd is not the
         client's). `retries` re-submits after `retry_after` on full-queue
@@ -290,7 +314,19 @@ class PolishClient:
         client-chosen correlation id. `rounds=N` runs N serve-native
         polishing rounds — the server feeds round k's stitched contigs
         back as round k+1's draft without leaving the warm process —
-        and `PolishResult.rounds` carries the per-round accounting."""
+        and `PolishResult.rounds` carries the per-round accounting.
+        `cancel_on_timeout=True` (needs a client `timeout`) frees the
+        server side when this client gives up: a socket timeout while
+        the job is queued or running sends a `cancel` for the job's
+        trace id on a FRESH connection — without it the abandoned job
+        keeps its queue and quota slots until the worker pops it —
+        then raises `JobCancelled`; the full-queue retry loop likewise
+        stops retrying once the elapsed wall time would exceed the
+        timeout budget."""
+        if cancel_on_timeout and not trace_id:
+            # the cancel RPC needs a handle the client knows BEFORE
+            # the result frame arrives: mint the correlation id
+            trace_id = uuid.uuid4().hex[:16]
         req = {"type": "submit",
                "sequences": os.path.abspath(sequences),
                "overlaps": os.path.abspath(overlaps),
@@ -318,6 +354,7 @@ class PolishClient:
         if stream or on_part is not None:
             req["stream"] = True
         attempt = 0
+        t_first = time.perf_counter()
         while True:
             try:
                 return PolishResult(
@@ -326,8 +363,32 @@ class PolishClient:
             except QueueFull as exc:
                 if attempt >= retries:
                     raise
+                delay = _retry_delay(exc.retry_after)
+                if self.timeout is not None and \
+                        (time.perf_counter() - t_first + delay
+                         > self.timeout):
+                    # the client-side budget is spent: stop the backoff
+                    # loop instead of overshooting it (the reject means
+                    # the server holds NO state for this job — there is
+                    # nothing to cancel)
+                    raise
                 attempt += 1
-                time.sleep(_retry_delay(exc.retry_after))
+                time.sleep(delay)
+            except TimeoutError:
+                # the socket timed out with the job possibly queued or
+                # running server-side: without a cancel it keeps its
+                # queue and quota slots until the worker pops it
+                if not cancel_on_timeout:
+                    raise
+                try:
+                    self.cancel(trace_id=trace_id)
+                except (ServeError, OSError):
+                    pass  # best-effort: the job may have just finished
+                raise JobCancelled(
+                    "cancelled",
+                    f"client timeout after {self.timeout}s: sent "
+                    f"cancel for trace {trace_id}",
+                    {"trace_id": trace_id}) from None
 
     def submit_traced(self, sequences: str, overlaps: str, target: str,
                       *, trace_out: str | None = None, on_progress=None,
@@ -352,6 +413,24 @@ class PolishClient:
             with open(trace_out, "w") as fh:
                 json.dump(doc, fh)
         return result, doc
+
+    def cancel(self, job_id: str | None = None,
+               trace_id: str | None = None) -> dict:
+        """Cancel a queued or running job by id and/or trace id, on a
+        FRESH connection (so it works while the submitting connection
+        is blocked waiting for the result). Queued jobs are dequeued —
+        their waiting submitter receives a typed `cancelled` error;
+        running jobs are withdrawn at the next iteration/round
+        boundary. Returns the server's ok body ({"cancelled":
+        "queued"|"running", "job_id"}); raises ServeError code
+        `unknown-job` when nothing matches (e.g. the job already
+        finished)."""
+        req: dict = {"type": "cancel"}
+        if job_id:
+            req["job_id"] = job_id
+        if trace_id:
+            req["trace_id"] = trace_id
+        return self.request(req)
 
     def ping(self) -> dict:
         return self.request({"type": "ping"})
@@ -479,6 +558,12 @@ def submit_main(argv: list[str]) -> int:
                          "flight-recorder dump)")
     ap.add_argument("--retries", type=int, default=0,
                     help="re-submit after retry_after on queue-full")
+    ap.add_argument("--cancel-on-timeout", action="store_true",
+                    help="with --timeout: when the client socket times "
+                         "out, send a cancel for this job on a fresh "
+                         "connection so it frees its queue/quota slot "
+                         "(and its device time if running) instead of "
+                         "lingering server-side until popped")
     ap.add_argument("--progress", action="store_true",
                     help="stream live progress to stderr while the job "
                          "runs: queue position while pending, then "
@@ -508,6 +593,12 @@ def submit_main(argv: list[str]) -> int:
                     help="fair-scheduling tenant id this job bills to "
                          "(1-64 chars of [A-Za-z0-9._-]; server "
                          "weights via RACON_TPU_SERVE_TENANT_WEIGHTS)")
+    ap.add_argument("--trace-id", default=None,
+                    help="name this job with a caller-chosen trace id "
+                         "so another terminal can `racon_tpu cancel "
+                         "--trace-id ID` it while this submit blocks "
+                         "(also the correlation key in the journal "
+                         "and flight-recorder artifacts)")
     ap.add_argument("--trace-out", metavar="PATH", default=None,
                     help="end-to-end trace: record client-side spans, "
                          "fetch the job's server-side spans, and write "
@@ -561,7 +652,9 @@ def submit_main(argv: list[str]) -> int:
     common = dict(options=options, priority=args.priority,
                   deadline_s=args.deadline, retries=args.retries,
                   tenant=args.tenant, rounds=args.rounds,
-                  on_progress=on_progress, on_part=on_part)
+                  trace_id=args.trace_id,
+                  on_progress=on_progress, on_part=on_part,
+                  cancel_on_timeout=args.cancel_on_timeout)
     trace_doc = None
     try:
         if args.trace_out:
@@ -614,4 +707,44 @@ def submit_main(argv: list[str]) -> int:
             print(f"[racon_tpu::serve] warning: could not write trace "
                   f"to {args.trace_out} ({exc}); polished FASTA is "
                   "unaffected", file=sys.stderr)
+    return 0
+
+
+def cancel_main(argv: list[str]) -> int:
+    """`racon_tpu cancel` entry point: cancel a queued or running job
+    on a live server (or through the router, which fans the cancel out
+    to the job's shards) by job id or trace id."""
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="racon_tpu cancel",
+        description="cancel a queued or running job on a running "
+                    "`racon_tpu serve` instance (or through the "
+                    "router) by --job-id or --trace-id")
+    ap.add_argument("--socket", default=None)
+    ap.add_argument("--port", type=int, default=None)
+    ap.add_argument("--timeout", type=float, default=None,
+                    help="socket timeout in seconds (default: none)")
+    ap.add_argument("--job-id", default=None)
+    ap.add_argument("--trace-id", default=None,
+                    help="the id passed to `submit --trace-id` (or "
+                         "minted by --cancel-on-timeout)")
+    args = ap.parse_args(argv)
+    if not args.job_id and not args.trace_id:
+        print("[racon_tpu::serve] error: cancel needs --job-id or "
+              "--trace-id", file=sys.stderr)
+        return 1
+    client = PolishClient(socket_path=args.socket, port=args.port,
+                          timeout=args.timeout)
+    try:
+        body = client.cancel(job_id=args.job_id,
+                             trace_id=args.trace_id)
+    except (ServeError, OSError) as exc:
+        print(f"[racon_tpu::serve] error: {exc}", file=sys.stderr)
+        return 1
+    extra = (f", {body['shards_cancelled']} shard(s) cancelled"
+             if "shards_cancelled" in body else "")
+    print(f"[racon_tpu::serve] cancelled {body.get('cancelled')} job "
+          f"{body.get('job_id', args.trace_id)}{extra}",
+          file=sys.stderr)
     return 0

@@ -24,7 +24,8 @@ Malformed-input discipline (the server must outlive every bad client):
     answered — the handler cleans up the connection quietly.
 
 Request types: ``submit`` / ``ping`` / ``stats`` / ``healthz`` /
-``scrape`` / ``debug`` / ``shutdown``. Response types: ``result`` /
+``scrape`` / ``debug`` / ``cancel`` / ``shutdown``. Response types:
+``result`` /
 ``pong`` / ``stats`` / ``healthz`` (``ok`` false while draining — the
 RPC twin of the HTTP endpoint's 503) / ``metrics`` (Prometheus text in
 ``text``) / ``debug``
@@ -32,6 +33,18 @@ RPC twin of the HTTP endpoint's 503) / ``metrics`` (Prometheus text in
 machine-readable ``code``; ``queue-full`` errors carry ``retry_after``
 seconds, ``job-failed`` errors carry ``error_type`` from the errors.py
 taxonomy).
+
+Cancellation & QoS (README "QoS & preemption"): a ``cancel`` request
+carries ``job_id`` and/or ``trace_id`` and answers ``{"type": "ok",
+"cancelled": "queued"|"running", "job_id"}`` — a queued job is
+dequeued (its waiting submitter receives a typed ``cancelled`` error
+response through its own connection), a running job is withdrawn at
+the next iteration/round boundary and fails typed ``cancelled``; an
+unmatched id answers ``error`` code ``unknown-job``. A submit whose
+deadline is provably unmeetable (server started with an abort margin)
+is refused typed ``deadline-doomed`` with ``predicted_s`` /
+``remaining_s``; the same code can arrive mid-run when the
+iteration-boundary estimate says the deadline is lost.
 
 Trace context, live progress and streamed results (all opt-in per
 submit, README "Serving"): a ``submit`` may carry a client-minted
@@ -73,7 +86,13 @@ carries ``parent`` (the router-side parent job id), ``shard`` /
 ``shards`` (this child's slot in the contig fan-out), the parent's
 ``rounds`` field when set (each shard runs its own rounds over its
 contig subset) and a derived ``trace_id`` of ``<parent trace>.s<k>`` — the "." is in the trace-id
-charset precisely so child ids stay valid. Replicas journal the three
+charset precisely so child ids stay valid. The parent's QoS fields
+ride every child frame too: ``priority`` and ``tenant`` verbatim, and
+``deadline_s`` as the REMAINING parent budget recomputed at each
+dispatch attempt (a requeued shard inherits what is left of the
+parent's deadline, never a reset one); a parent-level cancel or
+deadline-abort fans ``cancel`` frames out to all sibling shards by
+child trace id. Replicas journal the three
 fields on the child's ``received`` line for cross-correlation with the
 router's ledger and otherwise ignore them, which also means a child
 submit sent to a pre-router replica is handled as a plain job (unknown

@@ -96,6 +96,37 @@ class Draining(AdmissionError):
         super().__init__("server is draining; not admitting jobs")
 
 
+class DeadlineDoomed(AdmissionError):
+    """Speculative deadline-abort (RACON_TPU_SERVE_ABORT_MARGIN): the
+    service-time EMA predicts this job cannot finish inside its own
+    deadline (plus the configured margin), so it is failed FAST at the
+    door — a typed `deadline-doomed` error instead of queue time plus
+    device time that the deadline would throw away anyway. Raised again
+    mid-run (by the batcher's iteration-boundary estimate) when the
+    remaining-work projection says an admitted job's deadline is lost."""
+
+    def __init__(self, predicted_s: float, remaining_s: float,
+                 phase: str = "admission"):
+        super().__init__(
+            f"deadline doomed at {phase}: predicted finish in "
+            f"{predicted_s:.2f}s exceeds the {remaining_s:.2f}s left "
+            "before the deadline")
+        self.predicted_s = predicted_s
+        self.remaining_s = remaining_s
+        self.phase = phase
+
+
+class JobCancelledError(Exception):
+    """A client (or the router, on behalf of a doomed parent) cancelled
+    this job via the `cancel` RPC. For a QUEUED job the queue consumes
+    it directly; for a RUNNING job the batcher's withdrawal seam raises
+    this through the job's consensus loop within one iteration."""
+
+    def __init__(self, state: str = "running"):
+        super().__init__(f"job cancelled while {state}")
+        self.state = state
+
+
 class DeadlineExpired(Exception):
     def __init__(self, waited: float):
         super().__init__(
@@ -156,7 +187,8 @@ class Job:
                  "priority", "deadline", "fault_plan", "strict",
                  "want_trace", "enqueued_t", "started_t", "response",
                  "event", "stats_ref", "trace_id", "want_progress",
-                 "want_stream", "tenant", "rounds", "_outbox")
+                 "want_stream", "tenant", "rounds", "cancelled",
+                 "_outbox")
 
     def __init__(self, id_: str, sequences: str, overlaps: str,
                  target: str, options: dict, priority: int = 0,
@@ -194,6 +226,10 @@ class Job:
         #: `_run_job`, core/polisher.redraft). The response carries a
         #: `rounds` accounting block only when the request asked.
         self.rounds = rounds if rounds is None else max(1, int(rounds))
+        #: cancel-RPC flag for RUNNING jobs the batcher cannot reach
+        #: (isolation/solo paths never pool): the worker checks it at
+        #: round boundaries and fails the job typed `cancelled`
+        self.cancelled = False
         self._outbox = DeliveryQueue()
         self.started_t: float | None = None
         self.response: dict | None = None
@@ -273,13 +309,28 @@ class JobQueue:
 
     def __init__(self, maxsize: int, workers: int = 1, hists=None,
                  tenant_weights: dict | None = None,
-                 tenant_quota: int = 0):
+                 tenant_quota: int = 0, tenant_burst: int = 0,
+                 abort_margin: float | None = None):
         self.maxsize = max(1, int(maxsize))
         self.workers = max(1, int(workers))
         self.tenant_weights = dict(tenant_weights or {})
         #: hard cap on QUEUED jobs per tenant (0 = off): admission-time
         #: protection weights cannot give — see TenantQuotaExceeded
         self.tenant_quota = max(0, int(tenant_quota))
+        #: burst-token bucket capacity per tenant (0 = off): lets a
+        #: tenant briefly exceed `tenant_quota` by spending banked
+        #: tokens, refilled at its DRR weight in tokens/second — so a
+        #: gold tenant re-earns burst headroom faster than a free one
+        self.tenant_burst = max(0, int(tenant_burst))
+        #: tenant -> [tokens, last_refill_monotonic]
+        self._burst: dict[str, list] = {}
+        self.burst_admits = 0
+        #: speculative deadline-abort margin in seconds (None = off):
+        #: a deadline-carrying submit whose EMA-predicted finish
+        #: overshoots its deadline by more than this is rejected typed
+        #: (`deadline-doomed`) instead of admitted to die later
+        self.abort_margin = (None if abort_margin is None
+                             else max(0.0, float(abort_margin)))
         #: live queued count per tenant (quota enforcement; jobs leave
         #: the count at pop time, expired included)
         self._queued_by_tenant: dict[str, int] = {}
@@ -354,6 +405,41 @@ class JobQueue:
             tenant, {"admitted": 0, "completed": 0, "failed": 0,
                      "expired": 0})
 
+    def _burst_take_locked(self, tenant: str) -> bool:
+        """Spend one burst token for `tenant` if its bucket (capacity
+        `tenant_burst`, refilled at the tenant's DRR weight per second,
+        starting full) holds one; caller holds the lock."""
+        now = time.monotonic()
+        bucket = self._burst.get(tenant)
+        if bucket is None:
+            bucket = self._burst[tenant] = [float(self.tenant_burst),
+                                            now]
+        tokens = min(float(self.tenant_burst),
+                     bucket[0] + (now - bucket[1]) * self.weight(tenant))
+        bucket[1] = now
+        if tokens >= 1.0:
+            bucket[0] = tokens - 1.0
+            self.burst_admits += 1
+            return True
+        bucket[0] = tokens
+        return False
+
+    def _doomed_check_locked(self, job: Job) -> None:
+        """Speculative deadline-abort at admission: with `abort_margin`
+        armed, reject a deadline-carrying job whose EMA-predicted
+        finish (work at-or-above its priority class ahead of it, plus
+        itself, over the worker drain rate) overshoots the deadline by
+        more than the margin. Priority-aware on purpose: a gold job is
+        never doomed by a lower-class flood it would pop past."""
+        if self.abort_margin is None or job.deadline is None:
+            return
+        ahead = sum(c.count for p, c in self._classes.items()
+                    if p >= job.priority)
+        predicted_s = (self._ema_service_s * (ahead + 1) / self.workers)
+        remaining_s = job.deadline - time.perf_counter()
+        if predicted_s > remaining_s + self.abort_margin:
+            raise DeadlineDoomed(predicted_s, remaining_s)
+
     def submit(self, job: Job) -> None:
         with self._lock:
             self.counters["submitted"] += 1
@@ -364,7 +450,9 @@ class JobQueue:
                 self.counters["rejected_full"] += 1
                 raise QueueFull(self._retry_after_locked())
             queued = self._queued_by_tenant.get(job.tenant, 0)
-            if self.tenant_quota and queued >= self.tenant_quota:
+            if (self.tenant_quota and queued >= self.tenant_quota
+                    and not (self.tenant_burst
+                             and self._burst_take_locked(job.tenant))):
                 self.counters["rejected_quota"] += 1
                 # backoff until one of THIS tenant's queued jobs drains,
                 # from the same service-time EMA the full-queue hint uses
@@ -373,6 +461,7 @@ class JobQueue:
                 raise TenantQuotaExceeded(
                     job.tenant, self.tenant_quota,
                     min(max(est, self.RETRY_MIN), self.RETRY_MAX))
+            self._doomed_check_locked(job)
             self._queued_by_tenant[job.tenant] = queued + 1
             self.counters["admitted"] += 1
             self._tenant_counter_locked(job.tenant)["admitted"] += 1
@@ -597,6 +686,61 @@ class JobQueue:
                     return i
         return None
 
+    # ---------------------------------------------------------- cancel
+    def cancel(self, job_id: str | None = None,
+               trace_id: str | None = None) -> Job | None:
+        """Remove a QUEUED job by id (or client-minted trace id — the
+        handle a router holds for its child shards), wake its waiter
+        with a typed `cancelled` error, and free its queue + quota
+        slots immediately. Returns the job, or None when nothing queued
+        matches (already running, finished, or unknown — the caller
+        distinguishes). Accounted like an expiry: the job left the
+        queue without running, so the tenant ledger stays balanced."""
+        with self._lock:
+            job: Job | None = None
+            for j in self._iter_queued_locked():
+                if ((job_id is not None and j.id == job_id)
+                        or (trace_id is not None
+                            and j.trace_id == trace_id)):
+                    job = j
+                    break
+            if job is None:
+                return None
+            cls = self._classes[job.priority]
+            q = cls.tenants[job.tenant]
+            q.remove(job)
+            cls.count -= 1
+            self._count -= 1
+            self._version += 1
+            left = self._queued_by_tenant.get(job.tenant, 0) - 1
+            if left > 0:
+                self._queued_by_tenant[job.tenant] = left
+            else:
+                self._queued_by_tenant.pop(job.tenant, None)
+            if not q:
+                self._retire_tenant(cls.tenants, cls.rr, cls.deficit,
+                                    job.tenant)
+            if cls.count == 0:
+                del self._classes[job.priority]
+            self.counters["expired"] += 1
+            self._tenant_counter_locked(job.tenant)["expired"] += 1
+            exc = JobCancelledError("queued")
+            job.response = {"type": "error", "code": "cancelled",
+                            "message": str(exc), "job_id": job.id}
+            self._notify("cancelled", job, state="queued",
+                         waited_s=round(
+                             time.perf_counter() - job.enqueued_t, 4))
+            job.finish()
+            return job
+
+    def highest_queued_priority(self) -> int | None:
+        """Highest priority class with queued work, or None when empty
+        — the resume gate for preempted jobs (server.py): a parked job
+        resumes only when nothing strictly above it is still waiting."""
+        with self._lock:
+            prios = [p for p, c in self._classes.items() if c.count > 0]
+            return max(prios) if prios else None
+
     # ----------------------------------------------------------- drain
     def drain(self) -> None:
         """Stop admitting; queued jobs keep flowing to workers."""
@@ -649,6 +793,13 @@ class JobQueue:
                            if oldest is not None else 0.0),
                        ema_service_s=round(self._ema_service_s, 4),
                        tenants=tenants)
+            # armed-only keys: an unconfigured server's stats payload
+            # stays byte-identical to the pre-QoS shape
+            if self.tenant_burst:
+                out["tenant_burst"] = self.tenant_burst
+                out["burst_admits"] = self.burst_admits
+            if self.abort_margin is not None:
+                out["abort_margin_s"] = self.abort_margin
         if recent:
             n = len(recent)
             out["recent"] = {

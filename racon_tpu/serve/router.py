@@ -97,7 +97,8 @@ DEFAULT_ROUTER_SOCKET = "/tmp/racon_tpu_router.sock"
 #: older obsreport reading a router journal never reds out on them.
 ROUTER_EVENTS = frozenset((
     "router-start", "router-stop", "shard-dispatched", "shard-finished",
-    "part-routed", "requeued", "replica-down", "replica-up"))
+    "part-routed", "requeued", "replica-down", "replica-up",
+    "cancelled", "siblings-cancelled"))
 
 #: trace-id charset (mirrors PolishServer._TRACE_ID_OK — "." is legal,
 #: which is what makes the `<parent>.s<k>` child ids valid replica-side)
@@ -259,6 +260,11 @@ class _JobMerge:
         self.done = [False] * n_shards
         self.results: list[dict | None] = [None] * n_shards
         self.failure: _ShardFailure | None = None
+        #: shards currently in flight on a replica: shard k ->
+        #: (ReplicaState, child trace id) — the sibling-cancel fan-out
+        #: reads this to reach every other shard's replica by child
+        #: trace id when one shard's failure dooms the whole parent
+        self.dispatched: dict[int, tuple] = {}
         self._emit_part = emit_part
         self._on_routed = on_routed
         self._cursor_shard = 0
@@ -338,6 +344,9 @@ class PolishRouter:
         self._conn_lock = threading.Lock()
         self._state_lock = threading.Lock()
         self._job_seq = 0
+        #: active fan-outs by router job id -> (trace_id, merge): the
+        #: parent-level cancel RPC resolves its target here
+        self._active: dict[str, tuple] = {}
         self._inflight_jobs = 0
         self._requeued_outstanding = 0
         self._draining = threading.Event()
@@ -579,6 +588,8 @@ class PolishRouter:
             return {"type": "metrics",
                     "content_type": obs_prom.CONTENT_TYPE,
                     "text": self.prometheus_text()}
+        if rtype == "cancel":
+            return self._cancel_parent(req)
         if rtype == "shutdown":
             threading.Thread(target=self.drain,
                              name="racon-tpu-router-drain",
@@ -710,6 +721,65 @@ class PolishRouter:
                              daemon=True)
         t.start()
 
+    # ------------------------------------------------------------------ qos
+    def _cancel_parent(self, req: dict) -> dict:
+        """Parent-level cancel: mark the fan-out failed (first-wins, so
+        a later shard failure cannot overwrite the typed `cancelled`)
+        and fan `cancel` frames out to every in-flight child shard by
+        child trace id — the shard threads unblock within one replica
+        iteration with typed `cancelled` responses."""
+        job_id = req.get("job_id")
+        trace_id = req.get("trace_id")
+        if not job_id and not trace_id:
+            return error_response(
+                "bad-request", "cancel needs job_id or trace_id")
+        with self._state_lock:
+            entry = self._active.get(job_id or "")
+            if entry is None and trace_id:
+                for jid, (tid, m) in self._active.items():
+                    if tid == trace_id:
+                        job_id, entry = jid, (tid, m)
+                        break
+        if entry is None:
+            return error_response(
+                "unknown-job", "no active router job matches",
+                job_id=job_id, trace_id=trace_id)
+        tid, merge = entry
+        merge.fail(_ShardFailure(
+            "cancelled", f"job {job_id} cancelled by client"))
+        if self.journal is not None:
+            self.journal.record("cancelled", job=job_id, trace=tid)
+        n = self._cancel_siblings(merge, job_id, tid,
+                                  cause_shard=None, code="cancelled")
+        return {"type": "ok", "cancelled": "running",
+                "job_id": job_id, "shards_cancelled": n}
+
+    def _cancel_siblings(self, merge: _JobMerge, job_id: str,
+                         trace_id: str | None,
+                         cause_shard: int | None, code: str) -> int:
+        """Best-effort cancel RPC to every OTHER in-flight shard's
+        replica (by child trace id): a parent doomed by one shard's
+        deadline-abort or by a client cancel must stop burning device
+        time on its siblings within one iteration, not at their natural
+        end."""
+        with merge.lock:
+            targets = [(k, rep, ctid)
+                       for k, (rep, ctid) in merge.dispatched.items()
+                       if k != cause_shard]
+        for k, replica, child_trace in targets:
+            try:
+                replica.client(
+                    timeout=self.config.probe_timeout_s).cancel(
+                    trace_id=child_trace)
+            except (ServeError, ProtocolError, OSError):
+                continue  # already finished, or the replica is gone
+        if targets and self.journal is not None:
+            self.journal.record(
+                "siblings-cancelled", job=job_id, trace=trace_id,
+                by_shard=cause_shard, code=code,
+                cancelled=len(targets))
+        return len(targets)
+
     # --------------------------------------------------------------- submit
     def _read_target_contigs(self, path: str) -> list:
         from ..io.parsers import create_sequence_parser
@@ -773,6 +843,15 @@ class PolishRouter:
         want_stream = bool(req.get("stream"))
         want_progress = bool(req.get("progress"))
         t0 = time.perf_counter()
+        # the parent's deadline is pinned ABSOLUTE here: every shard
+        # dispatch (first or requeued) derives its child deadline_s
+        # from what REMAINS of this instant's budget, never a reset one
+        deadline_t = None
+        if req.get("deadline_s") is not None:
+            try:
+                deadline_t = t0 + float(req["deadline_s"])
+            except (TypeError, ValueError):
+                deadline_t = None
         if self.journal is not None:
             self.journal.record("received", job=job_id, trace=trace_id,
                                 tenant=req.get("tenant"),
@@ -834,13 +913,15 @@ class PolishRouter:
 
             merge = _JobMerge(n_shards, emit_part=emit_part,
                               on_routed=on_routed)
+            with self._state_lock:
+                self._active[job_id] = (trace_id, merge)
             threads = []
             for k in range(n_shards):
                 t = threading.Thread(
                     target=self._run_shard,
                     args=(req, job_id, trace_id, k, n_shards,
                           shard_targets[k], merge, conn, send_lock,
-                          want_progress),
+                          want_progress, deadline_t),
                     name=f"racon-tpu-router-{job_id}-s{k}", daemon=True)
                 t.start()
                 threads.append(t)
@@ -927,6 +1008,7 @@ class PolishRouter:
             return out
         finally:
             with self._state_lock:
+                self._active.pop(job_id, None)
                 self._inflight_jobs = max(0, self._inflight_jobs - 1)
             if workdir is not None:
                 shutil.rmtree(workdir, ignore_errors=True)
@@ -934,12 +1016,17 @@ class PolishRouter:
     def _run_shard(self, req: dict, job_id: str, trace_id: str | None,
                    k: int, n_shards: int, shard_target: str,
                    merge: _JobMerge, conn: socket.socket,
-                   send_lock: threading.Lock,
-                   want_progress: bool) -> None:
+                   send_lock: threading.Lock, want_progress: bool,
+                   deadline_t: float | None = None) -> None:
         """One shard's dispatch loop: submit to the least-loaded
         routable replica, stream parts into the merge, and on replica
         loss requeue to a healthy one (journal-backed, dedupe by the
-        merge ledger) up to `shard_retries` times."""
+        merge ledger) up to `shard_retries` times. QoS rides every
+        attempt: `deadline_t` is the parent's ABSOLUTE deadline, so the
+        child's `deadline_s` is recomputed to the REMAINING budget at
+        each dispatch (a requeued shard inherits what is left, never a
+        reset deadline), and a typed `cancelled`/`deadline-doomed`
+        child failure fans cancels out to the sibling shards."""
         child: dict = {"type": "submit",
                        "sequences": req["sequences"],
                        "overlaps": req["overlaps"],
@@ -947,7 +1034,7 @@ class PolishRouter:
                        "stream": True,
                        "parent": job_id, "shard": k, "shards": n_shards,
                        "trace_id": f"{trace_id or job_id}.s{k}"}
-        for key in ("options", "priority", "deadline_s", "fault_plan",
+        for key in ("options", "priority", "fault_plan",
                     "strict", "tenant", "rounds"):
             if req.get(key) is not None:
                 child[key] = req[key]
@@ -975,6 +1062,25 @@ class PolishRouter:
                         0, self._requeued_outstanding - 1)
 
         while True:
+            if merge.failure is not None:
+                # another shard (or a parent-level cancel) already
+                # doomed the job: do not dispatch more device work
+                settle()
+                return
+            if deadline_t is not None:
+                remaining = deadline_t - time.perf_counter()
+                if remaining <= 0:
+                    merge.fail(_ShardFailure(
+                        "deadline-doomed",
+                        f"shard {k}: parent deadline budget exhausted "
+                        f"before dispatch",
+                        remaining_s=round(remaining, 3)))
+                    self._cancel_siblings(merge, job_id, trace_id, k,
+                                          "deadline-doomed")
+                    settle()
+                    return
+                # requeued shards inherit the REMAINING parent budget
+                child["deadline_s"] = round(remaining, 4)
             replica = self._pick_replica(exclude)
             if replica is None:
                 if time.monotonic() < wait_deadline \
@@ -994,6 +1100,8 @@ class PolishRouter:
                                     trace=trace_id, shard=k,
                                     replica=replica.spec,
                                     attempt=losses + busy_waits)
+            with merge.lock:
+                merge.dispatched[k] = (replica, child["trace_id"])
             lost = False
             try:
                 resp = replica.client().request(
@@ -1035,11 +1143,18 @@ class PolishRouter:
                 else:
                     merge.fail(_ShardFailure(
                         exc.code, f"shard {k}: {exc}"))
+                    if exc.code in ("cancelled", "deadline-doomed"):
+                        # a doomed or cancelled child dooms the parent:
+                        # stop the sibling shards within one iteration
+                        self._cancel_siblings(merge, job_id, trace_id,
+                                              k, exc.code)
                     settle()
                     return
             except (ProtocolError, OSError):
                 lost = True
             finally:
+                with merge.lock:
+                    merge.dispatched.pop(k, None)
                 self._release_replica(replica)
             if not lost:
                 return  # unreachable, but keeps the loop shape honest

@@ -67,8 +67,8 @@ from ..utils.logger import log_info
 from .batcher import WindowBatcher
 from .protocol import (ProtocolError, error_response, max_frame_bytes,
                        recv_frame, send_frame)
-from .queue import (Draining, Job, JobQueue, QueueFull,
-                    TenantQuotaExceeded)
+from .queue import (DeadlineDoomed, Draining, Job, JobCancelledError,
+                    JobQueue, QueueFull, TenantQuotaExceeded)
 
 #: request option keys a submit may carry; anything else is rejected
 #: with `bad-request` (a typo'd knob must not silently polish with
@@ -193,6 +193,67 @@ class ServeConfig:
         self.tenant_quota = max(0, kw.pop(
             "tenant_quota",
             _env_int("RACON_TPU_SERVE_TENANT_QUOTA", 0)))
+        # QoS layer (queue.py + batcher.py + the cancel RPC), all off
+        # by default — with none of the three configured, every serve
+        # surface is byte-identical to the pre-QoS server (test-
+        # pinned). Strict env parsing throughout, mirroring the
+        # --metrics-port / RACON_TPU_WINCACHE discipline: a typo'd
+        # operator value fails the start, never silently serves with
+        # QoS half-armed.
+        # preempt: a newly admitted higher-priority job may preempt a
+        # running lower-priority one (its not-yet-dispatched windows
+        # park between iterations; it resumes byte-identically when
+        # capacity frees)
+        if "preempt" in kw:
+            self.preempt = bool(kw.pop("preempt"))
+        else:
+            raw = env("RACON_TPU_SERVE_PREEMPT")
+            if raw:
+                try:
+                    self.preempt = bool(int(raw))
+                except ValueError:
+                    raise RaconError(
+                        "ServeConfig",
+                        f"invalid RACON_TPU_SERVE_PREEMPT value "
+                        f"{raw!r} (expected an integer)") from None
+            else:
+                self.preempt = False
+        # abort_margin: speculative deadline-abort margin in seconds
+        # (None = off) — both at admission (queue EMA) and mid-run
+        # (batcher iteration-boundary estimate)
+        if "abort_margin" in kw:
+            raw_am = kw.pop("abort_margin")
+            self.abort_margin = (None if raw_am is None
+                                 else max(0.0, float(raw_am)))
+        else:
+            raw = env("RACON_TPU_SERVE_ABORT_MARGIN")
+            if raw:
+                try:
+                    self.abort_margin = max(0.0, float(raw))
+                except ValueError:
+                    raise RaconError(
+                        "ServeConfig",
+                        "invalid RACON_TPU_SERVE_ABORT_MARGIN value "
+                        f"{raw!r} (expected a number of seconds)") \
+                        from None
+            else:
+                self.abort_margin = None
+        # tenant_burst: token-bucket capacity letting a tenant briefly
+        # exceed its hard quota, refilled at its DRR weight per second
+        if "tenant_burst" in kw:
+            self.tenant_burst = max(0, int(kw.pop("tenant_burst")))
+        else:
+            raw = env("RACON_TPU_SERVE_TENANT_BURST")
+            if raw:
+                try:
+                    self.tenant_burst = max(0, int(raw))
+                except ValueError:
+                    raise RaconError(
+                        "ServeConfig",
+                        "invalid RACON_TPU_SERVE_TENANT_BURST value "
+                        f"{raw!r} (expected an integer)") from None
+            else:
+                self.tenant_burst = 0
         explicit_max_wait = "max_wait_s" in kw
         self.max_wait_s = max(0.0, kw.pop(
             "max_wait_s",
@@ -449,11 +510,27 @@ class PolishServer:
         self.queue = JobQueue(cfg.queue_depth, workers=cfg.workers,
                               hists=self.hists,
                               tenant_weights=cfg.tenant_weights,
-                              tenant_quota=cfg.tenant_quota)
+                              tenant_quota=cfg.tenant_quota,
+                              tenant_burst=cfg.tenant_burst,
+                              abort_margin=cfg.abort_margin)
         self.batcher = WindowBatcher(
             iteration_windows=cfg.iteration_windows,
             max_wait_s=cfg.max_wait_s,
             worker_lanes=cfg.worker_lanes)
+        #: iteration-boundary speculative abort rides the batcher's
+        #: consume loop (None keeps that check compiled out entirely)
+        self.batcher.abort_margin = cfg.abort_margin
+        #: QoS runtime state (all under `_qos_lock`): every RUNNING
+        #: job by id (the cancel RPC's running-job lookup), the jobs
+        #: currently parked by preemption, and the lifetime QoS
+        #: counters. Counters live here (not in queue.counters) so the
+        #: scrape can render them armed-only — queue counters render
+        #: unconditionally and would break byte-identity when off.
+        self._qos_lock = threading.Lock()
+        self._running_jobs: dict[str, Job] = {}
+        self._preempted: dict[str, Job] = {}
+        self.qos = {"preemptions": 0, "aborted_doomed": 0,
+                    "cancelled": 0}
         self.batcher.hists = self.hists
         self.batcher.pipeline_stats.hists = self.hists
         self.batcher.scheduler.stats.hists = self.hists
@@ -640,7 +717,18 @@ class PolishServer:
                 {"phase": "start",
                  "queue_wait_s": fields.get("queue_wait_s")})
         if self.journal is not None:
-            if event in ("admitted", "expired"):
+            if event == "cancelled":
+                # fired UNDER the queue mutex by queue.cancel: stage
+                # the typed annotation AND the legal terminal (the job
+                # never started, so it leaves as an expiry with the
+                # reason pinned — `failed` would trip the journal's
+                # ran-without-started check)
+                self.journal.stage(event, job=job.id,
+                                   trace=job.trace_id, **fields)
+                self.journal.stage("expired", job=job.id,
+                                   trace=job.trace_id,
+                                   reason="cancelled")
+            elif event in ("admitted", "expired"):
                 self.journal.stage(event, job=job.id,
                                    trace=job.trace_id, **fields)
             else:
@@ -977,6 +1065,8 @@ class PolishServer:
                     resp["audit_ack"] = self.auditor.ack()
                 resp["audit"] = self.auditor.snapshot()
             return resp
+        if rtype == "cancel":
+            return self._cancel(req)
         if rtype == "shutdown":
             threading.Thread(target=self.drain,
                              name="racon-tpu-serve-drain",
@@ -1097,11 +1187,33 @@ class PolishServer:
             return error_response("tenant-quota", str(exc),
                                   retry_after=round(exc.retry_after, 3),
                                   tenant=job.tenant, job_id=job_id)
+        except DeadlineDoomed as exc:
+            # speculative abort at the door: the EMA says this job
+            # cannot finish inside its own deadline — fail fast, typed,
+            # before it costs queue time or device time. Terminal is
+            # `expired` (the job never ran), the typed annotation pins
+            # the why.
+            with self._qos_lock:
+                self.qos["aborted_doomed"] += 1
+            if self.journal is not None:
+                self.journal.record(
+                    "deadline-doomed", job=job.id, trace=trace_id,
+                    phase="admission",
+                    predicted_s=round(exc.predicted_s, 3),
+                    remaining_s=round(exc.remaining_s, 3))
+                self.journal.record("expired", job=job.id,
+                                    trace=trace_id,
+                                    reason="deadline-doomed")
+            return error_response(
+                "deadline-doomed", str(exc), job_id=job_id,
+                predicted_s=round(exc.predicted_s, 3),
+                remaining_s=round(exc.remaining_s, 3))
         except Draining as exc:
             if self.journal is not None:
                 self.journal.record("rejected-draining", job=job.id,
                                     trace=trace_id)
             return error_response("draining", str(exc), job_id=job_id)
+        self._maybe_preempt(job)
         # `admitted` is STAGED by the queue's on_event hook under the
         # submit lock (ordering vs `started` fixed at stage time, no
         # disk I/O behind the queue mutex); flushed below once the job
@@ -1185,93 +1297,263 @@ class PolishServer:
                 if self._stop_workers.is_set() and not len(self.queue):
                     return
                 continue
-            with self._idle:
-                self._inflight += 1
-            t0 = time.perf_counter()
-            try:
-                resp = self._run_job(job)
-                ok = True
-            except Exception as exc:
-                # per-job failure isolation: the job answers typed, the
-                # server and its warm engines survive
-                resp = error_response(
-                    "job-failed", str(exc), job_id=job.id,
-                    error_type=type(exc).__name__,
-                    queue_wait_s=round(job.queue_wait_s, 4))
-                ok = False
-            job.response = resp
-            try:
-                # fold the job's own latency histograms (align phase,
-                # solo rounds, polisher phases, compiles) into the
-                # lifetime scrape view — on FAILURE too: the
-                # pathological jobs are exactly the ones the p99s must
-                # not exclude. (Shared batch rounds already observe
-                # into the server set directly.)
-                if job.stats_ref is not None \
-                        and job.stats_ref.hists is not None:
-                    self.hists.merge(job.stats_ref.hists)
-                service_s = time.perf_counter() - t0
-                # latency exemplar: the job-latency bucket this job
-                # lands in remembers WHO it was (trace id) and — for a
-                # failed / deadline-missed job — the flight dump the
-                # worker is about to write, so a fleet p99 bucket
-                # clicks through to the exact job's Chrome trace. The
-                # dump path is deterministic (_flight_dump names it
-                # identically below).
-                exemplar = None
-                if self.exemplars_enabled:
-                    exemplar = {"trace_id": job.trace_id or job.id,
-                                "job": job.id}
-                    will_miss = (job.deadline is not None
-                                 and time.perf_counter() > job.deadline)
-                    if (not ok or will_miss) and self.config.flight_dir:
-                        reason = ("job-failed" if not ok
-                                  else "deadline-miss")
-                        exemplar["flight"] = os.path.join(
-                            self.config.flight_dir,
-                            f"flight_{job.id}_{reason}.json")
-                missed = self.queue.task_done(job, ok, service_s,
-                                              exemplar=exemplar)
-                if self.journal is not None:
-                    batch = ((resp.get("serve") or {}).get("batch")
-                             if ok else None) or {}
-                    if batch:
-                        self.journal.record(
-                            "iterations", job=job.id,
-                            trace=job.trace_id,
-                            iterations=batch.get("iterations"),
-                            shared=batch.get("shared_iterations"),
-                            windows=batch.get("windows"))
-                    if missed:
-                        self.journal.record("deadline-miss", job=job.id,
-                                            trace=job.trace_id)
+            self._process_one(job)
+
+    def _surge_worker(self) -> None:
+        """One-shot worker spawned by a preemption: the victim's
+        worker thread stays blocked in its consensus consume loop (its
+        windows are parked, not failed), so the capacity the preemption
+        freed needs a thread to spend it on the high-priority job —
+        which the queue's priority-first pop hands over next."""
+        job = self.queue.pop(timeout=1.0)
+        if job is not None:
+            self._process_one(job)
+
+    def _process_one(self, job: Job) -> None:
+        with self._idle:
+            self._inflight += 1
+        with self._qos_lock:
+            self._running_jobs[job.id] = job
+        t0 = time.perf_counter()
+        try:
+            resp = self._run_job(job)
+            ok = True
+        except JobCancelledError as exc:
+            # typed terminal for the cancel RPC's running-job path: the
+            # batcher's withdrawal seam (or the round-boundary flag)
+            # raised this through the job's own thread
+            if self.journal is not None:
+                self.journal.record("cancelled", job=job.id,
+                                    trace=job.trace_id,
+                                    state="running")
+            resp = error_response(
+                "cancelled", str(exc), job_id=job.id,
+                error_type=type(exc).__name__,
+                queue_wait_s=round(job.queue_wait_s, 4))
+            ok = False
+        except DeadlineDoomed as exc:
+            # mid-run speculative abort: the iteration-boundary
+            # estimate said the deadline is provably lost — the job
+            # fails typed within one iteration instead of at the end
+            with self._qos_lock:
+                self.qos["aborted_doomed"] += 1
+            if self.journal is not None:
+                self.journal.record(
+                    "deadline-doomed", job=job.id, trace=job.trace_id,
+                    phase=exc.phase,
+                    predicted_s=round(exc.predicted_s, 3),
+                    remaining_s=round(exc.remaining_s, 3))
+            resp = error_response(
+                "deadline-doomed", str(exc), job_id=job.id,
+                error_type=type(exc).__name__,
+                predicted_s=round(exc.predicted_s, 3),
+                remaining_s=round(exc.remaining_s, 3),
+                queue_wait_s=round(job.queue_wait_s, 4))
+            ok = False
+        except Exception as exc:
+            # per-job failure isolation: the job answers typed, the
+            # server and its warm engines survive
+            resp = error_response(
+                "job-failed", str(exc), job_id=job.id,
+                error_type=type(exc).__name__,
+                queue_wait_s=round(job.queue_wait_s, 4))
+            ok = False
+        job.response = resp
+        try:
+            # fold the job's own latency histograms (align phase,
+            # solo rounds, polisher phases, compiles) into the
+            # lifetime scrape view — on FAILURE too: the
+            # pathological jobs are exactly the ones the p99s must
+            # not exclude. (Shared batch rounds already observe
+            # into the server set directly.)
+            if job.stats_ref is not None \
+                    and job.stats_ref.hists is not None:
+                self.hists.merge(job.stats_ref.hists)
+            service_s = time.perf_counter() - t0
+            # latency exemplar: the job-latency bucket this job
+            # lands in remembers WHO it was (trace id) and — for a
+            # failed / deadline-missed job — the flight dump the
+            # worker is about to write, so a fleet p99 bucket
+            # clicks through to the exact job's Chrome trace. The
+            # dump path is deterministic (_flight_dump names it
+            # identically below).
+            exemplar = None
+            if self.exemplars_enabled:
+                exemplar = {"trace_id": job.trace_id or job.id,
+                            "job": job.id}
+                will_miss = (job.deadline is not None
+                             and time.perf_counter() > job.deadline)
+                if (not ok or will_miss) and self.config.flight_dir:
+                    reason = ("job-failed" if not ok
+                              else "deadline-miss")
+                    exemplar["flight"] = os.path.join(
+                        self.config.flight_dir,
+                        f"flight_{job.id}_{reason}.json")
+            missed = self.queue.task_done(job, ok, service_s,
+                                          exemplar=exemplar)
+            if self.journal is not None:
+                batch = ((resp.get("serve") or {}).get("batch")
+                         if ok else None) or {}
+                if batch:
                     self.journal.record(
-                        "finished" if ok else "failed",
-                        job=job.id, trace=job.trace_id,
-                        service_s=round(service_s, 4),
-                        sequences=resp.get("sequences"),
-                        error_type=resp.get("error_type"))
-                if not ok or missed:
-                    # post-mortem artifact: the flight ring windowed to
-                    # this job, with its stage stats riding along
-                    # (obs/flight.py). Written BEFORE the waiter is
-                    # unblocked, so a client reacting to its error
-                    # response finds the dump already listed by `debug`
-                    self._flight_dump(
-                        job,
-                        "job-failed" if not ok else "deadline-miss",
-                        resp)
-            except Exception as exc:  # noqa: BLE001
-                # telemetry accounting must never kill the worker or
-                # strand the waiter blocked on job.event
-                log_info(f"[racon_tpu::serve] warning: post-job "
-                         f"telemetry failed ({type(exc).__name__}: "
-                         f"{exc})")
-            finally:
-                job.finish()
-            with self._idle:
-                self._inflight -= 1
-                self._idle.notify_all()
+                        "iterations", job=job.id,
+                        trace=job.trace_id,
+                        iterations=batch.get("iterations"),
+                        shared=batch.get("shared_iterations"),
+                        windows=batch.get("windows"))
+                if missed:
+                    self.journal.record("deadline-miss", job=job.id,
+                                        trace=job.trace_id)
+                self.journal.record(
+                    "finished" if ok else "failed",
+                    job=job.id, trace=job.trace_id,
+                    service_s=round(service_s, 4),
+                    sequences=resp.get("sequences"),
+                    error_type=resp.get("error_type"))
+            if not ok or missed:
+                # post-mortem artifact: the flight ring windowed to
+                # this job, with its stage stats riding along
+                # (obs/flight.py). Written BEFORE the waiter is
+                # unblocked, so a client reacting to its error
+                # response finds the dump already listed by `debug`
+                self._flight_dump(
+                    job,
+                    "job-failed" if not ok else "deadline-miss",
+                    resp)
+        except Exception as exc:  # noqa: BLE001
+            # telemetry accounting must never kill the worker or
+            # strand the waiter blocked on job.event
+            log_info(f"[racon_tpu::serve] warning: post-job "
+                     f"telemetry failed ({type(exc).__name__}: "
+                     f"{exc})")
+        finally:
+            job.finish()
+        self._qos_job_done(job)
+        with self._idle:
+            self._inflight -= 1
+            self._idle.notify_all()
+
+    # ---------------------------------------------------------------- qos
+    def _qos_job_done(self, job: Job) -> None:
+        """Post-terminal QoS bookkeeping: drop the job from the
+        running set, clean any parked state it left in the batcher
+        (a job can terminate WHILE preempted — cancelled, or finished
+        because all of its windows were already in flight when the
+        withdrawal landed), then hand freed capacity to the
+        highest-priority parked job."""
+        with self._qos_lock:
+            self._running_jobs.pop(job.id, None)
+            if not self.config.preempt:
+                return
+            was_parked = self._preempted.pop(job.id, None) is not None
+        if was_parked:
+            # releases the withdrawn mark and any still-parked entries
+            # so the pools never leak a dead job's windows
+            self.batcher.resume_job(job.id)
+            if self.journal is not None:
+                self.journal.record("resumed", job=job.id,
+                                    trace=job.trace_id,
+                                    reason="terminal")
+        self._maybe_resume()
+
+    def _maybe_preempt(self, job: Job) -> None:
+        """A newly admitted job preempts the lowest-priority running
+        job of a strictly lower class: the victim's not-yet-dispatched
+        pooled windows are parked between iterations (completed
+        windows stay — ContigStreamer tolerates the gap) and a surge
+        worker thread spends the freed capacity on the new job.
+        Fault-injected and strict jobs run the solo path and are
+        never victims."""
+        if not self.config.preempt:
+            return
+        with self._qos_lock:
+            active = [j for jid, j in self._running_jobs.items()
+                      if jid not in self._preempted]
+            if len(active) < self.config.workers:
+                return  # free capacity: no need to take any back
+            victims = [j for j in active
+                       if j.priority < job.priority
+                       and j.fault_plan is None and not j.strict]
+            if not victims:
+                return
+            victim = min(victims, key=lambda j: j.priority)
+            self._preempted[victim.id] = victim
+            self.qos["preemptions"] += 1
+        parked = self.batcher.withdraw_job(victim.id)
+        if self.journal is not None:
+            self.journal.record(
+                "preempted", job=victim.id, trace=victim.trace_id,
+                by=job.id, priority=victim.priority,
+                by_priority=job.priority, windows=parked)
+        log_info(f"[racon_tpu::serve] preempted job {victim.id} "
+                 f"(class {victim.priority}) for {job.id} "
+                 f"(class {job.priority}): {parked} windows parked")
+        threading.Thread(target=self._surge_worker,
+                         name="racon-tpu-serve-surge",
+                         daemon=True).start()
+
+    def _maybe_resume(self) -> None:
+        """Resume the highest-priority parked job once capacity frees
+        — unless a strictly higher class is still waiting in the
+        queue, which keeps its claim on the freed slot."""
+        if not self.config.preempt:
+            return
+        top = self.queue.highest_queued_priority()
+        with self._qos_lock:
+            if not self._preempted:
+                return
+            active = len(self._running_jobs) - len(self._preempted)
+            if active >= self.config.workers:
+                return
+            cand = max(self._preempted.values(),
+                       key=lambda j: j.priority)
+            if top is not None and top > cand.priority:
+                return
+            del self._preempted[cand.id]
+        n = self.batcher.resume_job(cand.id)
+        if self.journal is not None:
+            self.journal.record("resumed", job=cand.id,
+                                trace=cand.trace_id, windows=n)
+        log_info(f"[racon_tpu::serve] resumed job {cand.id}: "
+                 f"{n} windows back in pool")
+
+    def _cancel(self, req: dict) -> dict:
+        """Cancel RPC: dequeue a pending job (typed `cancelled`
+        response delivered through its queue slot) or withdraw a
+        running one (the batcher fails its tickets; solo/isolated
+        jobs see the round-boundary flag instead)."""
+        job_id = req.get("job_id")
+        trace_id = req.get("trace_id")
+        if not job_id and not trace_id:
+            return error_response(
+                "bad-request", "cancel needs job_id or trace_id")
+        job = self.queue.cancel(job_id=job_id, trace_id=trace_id)
+        if job is not None:
+            with self._qos_lock:
+                self.qos["cancelled"] += 1
+            if self.journal is not None:
+                self.journal.flush_staged()
+            return {"type": "ok", "cancelled": "queued",
+                    "job_id": job.id}
+        with self._qos_lock:
+            running = self._running_jobs.get(job_id or "")
+            if running is None and trace_id:
+                for j in self._running_jobs.values():
+                    if j.trace_id == trace_id:
+                        running = j
+                        break
+            if running is not None:
+                self.qos["cancelled"] += 1
+        if running is None:
+            return error_response(
+                "unknown-job", "no queued or running job matches",
+                job_id=job_id, trace_id=trace_id)
+        # round-boundary fallback for solo/isolated jobs the pools
+        # never see; the pooled path fails the tickets directly
+        running.cancelled = True
+        pooled = self.batcher.cancel_job(running.id)
+        return {"type": "ok", "cancelled": "running",
+                "job_id": running.id, "pooled": pooled}
 
     def _run_job(self, job: Job) -> dict:
         from ..core.polisher import PolisherType, create_polisher
@@ -1333,6 +1615,12 @@ class PolishServer:
             # into the OWNING job's timeline
             polisher.serve_trace_id = job.trace_id
             polisher.serve_job_id = job.id
+            # the absolute deadline rides the polisher so the batcher's
+            # iteration-boundary doomed check can see it (mid-run
+            # speculative abort, RACON_TPU_SERVE_ABORT_MARGIN)
+            polisher.serve_deadline = job.deadline
+            if job.cancelled:
+                raise JobCancelledError("running")
             if job.want_progress:
                 polisher.progress_hook = job.notify_progress
             polisher.initialize()
@@ -1385,6 +1673,10 @@ class PolishServer:
                             as workdir:
                         for rnd in range(1, rounds + 1):
                             final = rnd == rounds
+                            if job.cancelled:
+                                # round-boundary cancel fallback for
+                                # solo/isolated jobs the pools miss
+                                raise JobCancelledError("running")
                             if self.journal is not None:
                                 self.journal.record(
                                     "round-started", job=job.id,
@@ -1427,6 +1719,12 @@ class PolishServer:
                 finally:
                     with self._rounds_lock:
                         self._rounds["inflight"] -= 1
+        if job.cancelled:
+            # a cancel that landed mid-run on a solo/isolated job has
+            # no pooled tickets for the batcher to fail — honour it
+            # here, before the completed work ships: cancel means the
+            # bytes are unwanted, not that the run must have crashed
+            raise JobCancelledError("running")
         # the response body comes from `polished`, NOT from the parts
         # collected in the callback: ContigStreamer swallows on_part
         # exceptions (streaming is decoration), so a callback bug may
@@ -1688,6 +1986,34 @@ class PolishServer:
             gauges["serve.rounds_inflight"] = (
                 r["inflight"], "rounds jobs currently executing "
                 "(each loops drafts in-process between rounds)")
+        # QoS families (preemption / doomed-abort / cancel) — rendered
+        # ONLY when a QoS knob is armed or an event has fired, so a
+        # QoS-off scrape stays byte-identical to the pre-QoS
+        # exposition (test-pinned)
+        with self._qos_lock:
+            qos = dict(self.qos)
+            preempted_now = len(self._preempted)
+        cfg = self.config
+        if (cfg.preempt or cfg.abort_margin is not None
+                or cfg.tenant_burst > 0 or any(qos.values())):
+            counters["serve.preemptions"] = (
+                qos["preemptions"], "running jobs preempted by a "
+                "higher priority class (windows parked, resumed "
+                "byte-identically when capacity frees)")
+            counters["serve.aborted_doomed"] = (
+                qos["aborted_doomed"], "jobs failed fast with "
+                "deadline-doomed (predicted finish past the deadline "
+                "by more than the abort margin)")
+            counters["serve.cancelled"] = (
+                qos["cancelled"], "jobs cancelled via the cancel RPC "
+                "(queued or running)")
+            gauges["serve.preempted_inflight"] = (
+                preempted_now, "jobs currently parked by preemption "
+                "(their completed windows are kept)")
+            if cfg.tenant_burst > 0:
+                counters["serve.burst_admits"] = (
+                    q.get("burst_admits", 0), "admissions over the "
+                    "hard tenant quota paid for by burst tokens")
         # SLO burn-rate view (obs/fleet.py tracker, fed by the queue's
         # on_slo hook)
         burn = self.burn.state()
@@ -1721,7 +2047,17 @@ class PolishServer:
         q = self.queue.snapshot()
         latency = self.hists.get("job.latency")
         deadlined = q["deadline_hit"] + q["deadline_miss"]
-        return {"uptime_s": round(time.perf_counter() - self._t_start, 3),
+        # QoS view — present only when armed or an event fired (the
+        # same discipline as the scrape families), so a QoS-off stats
+        # body is byte-identical to pre-QoS output
+        with self._qos_lock:
+            qos = dict(self.qos)
+            qos["preempted_inflight"] = len(self._preempted)
+        cfg = self.config
+        qos_armed = (cfg.preempt or cfg.abort_margin is not None
+                     or cfg.tenant_burst > 0
+                     or any(v for k, v in qos.items()))
+        out = {"uptime_s": round(time.perf_counter() - self._t_start, 3),
                 "warm": self._warm,
                 "inflight": inflight,
                 "draining": self._draining.is_set(),
@@ -1748,6 +2084,10 @@ class PolishServer:
                              "events": self.journal.events,
                              "dropped": self.journal.dropped}
                             if self.journal is not None else None)}
+        if qos_armed:
+            qos["preempt"] = cfg.preempt
+            out["qos"] = qos
+        return out
 
     @property
     def address(self) -> str:
@@ -1834,6 +2174,27 @@ def serve_main(argv: list[str]) -> int:
                     help="window-cache capacity bound in bytes, "
                          "LRU-evicted (RACON_TPU_WINCACHE_MAX_BYTES, "
                          "default 64 MiB)")
+    ap.add_argument("--preempt", action="store_true", default=None,
+                    help="arm priority preemption: a newly admitted "
+                         "higher-priority job parks the pooled windows "
+                         "of a running lower-class job between device "
+                         "iterations, resuming it byte-identically "
+                         "when capacity frees (RACON_TPU_SERVE_PREEMPT"
+                         ", default off)")
+    ap.add_argument("--abort-margin", type=float, default=None,
+                    help="speculative deadline-abort margin in "
+                         "seconds: fail a job fast with "
+                         "deadline-doomed when its predicted finish "
+                         "exceeds the deadline by more than this, at "
+                         "admission and at iteration boundaries "
+                         "(RACON_TPU_SERVE_ABORT_MARGIN, default off)")
+    ap.add_argument("--tenant-burst", type=int, default=None,
+                    help="per-tenant burst tokens on top of the hard "
+                         "quota: a tenant may exceed --tenant-quota by "
+                         "up to this many queued jobs, tokens refilled "
+                         "at its DRR weight per second "
+                         "(RACON_TPU_SERVE_TENANT_BURST, default 0 = "
+                         "off)")
     ap.add_argument("--no-warmup", action="store_true",
                     help="skip the synthetic warmup job (first real "
                          "request pays the compiles)")
@@ -1921,6 +2282,12 @@ def serve_main(argv: list[str]) -> int:
         kw["wincache"] = True
     if args.wincache_max_bytes is not None:
         kw["wincache_max_bytes"] = args.wincache_max_bytes
+    if args.preempt:
+        kw["preempt"] = True
+    if args.abort_margin is not None:
+        kw["abort_margin"] = args.abort_margin
+    if args.tenant_burst is not None:
+        kw["tenant_burst"] = args.tenant_burst
     if args.gather_ms is not None:
         # deprecated alias: ServeConfig warns and maps it to max_wait_s
         kw["gather_window_s"] = args.gather_ms / 1000.0

@@ -1,0 +1,715 @@
+"""Preemptive QoS tests (queue + batcher + server + router) — the
+ISSUE's pinned contracts:
+
+  - a preempted-then-resumed job's polished FASTA is byte-identical to
+    the solo path, over a unix socket, TCP, and a 2-replica router
+    (window cache on and off) — window withdrawal parks entries with
+    their ORIGINAL arrival sequence, so per-window consensus and the
+    oldest-window guarantee both survive the round trip;
+  - speculative deadline-abort fails a doomed job typed
+    (`deadline-doomed`, carrying predicted/remaining seconds) at
+    ADMISSION and again MID-RUN at an iteration boundary, and the
+    admission check is priority-aware (a gold job is never doomed by a
+    lower-class backlog it would pop past);
+  - the `cancel` RPC reaches queued jobs (dequeued, typed response
+    through the waiting submitter), running jobs (ticket-error
+    withdrawal / round-boundary flag), and — through the router — a
+    parent cancel or a doomed child fans cancels to the sibling shards;
+  - requeued router shards inherit the REMAINING parent deadline
+    budget, never a reset one;
+  - burst tokens let a tenant briefly exceed its hard quota, refilled
+    at its DRR weight;
+  - `pack_iteration` never starves the oldest window under
+    preempt/resume churn (withdrawn entries keep their original age);
+  - with no QoS knob armed, the scrape, journal and stats surfaces are
+    byte-identical to the pre-QoS server (no new families, no new
+    events, no `qos` block).
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import socket
+import sys
+import threading
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "tools"))
+
+from obsreport import check_preemptions
+from racon_tpu.core.polisher import PolisherType, create_polisher
+from racon_tpu.obs.journal import check_consistency, read_journal
+from racon_tpu.sched import pack_iteration
+from racon_tpu.serve import (PolishClient, PolishRouter, PolishServer,
+                             make_synth_dataset)
+from racon_tpu.serve.client import DeadlineDoomed as ClientDoomed
+from racon_tpu.serve.client import JobCancelled, ServeError
+from racon_tpu.serve.protocol import ProtocolError, recv_frame, send_frame
+from racon_tpu.serve.queue import (DeadlineDoomed, Job, JobQueue,
+                                   TenantQuotaExceeded)
+
+QOS_EVENTS = {"preempted", "resumed", "cancelled", "deadline-doomed"}
+
+
+# --------------------------------------------------------------- fixtures
+@pytest.fixture(scope="module")
+def dataset(tmp_path_factory):
+    return make_synth_dataset(str(tmp_path_factory.mktemp("qos_data")))
+
+
+@pytest.fixture(scope="module")
+def dataset2(tmp_path_factory):
+    """Two independent contigs — enough to shard across 2 replicas."""
+    return make_synth_dataset(str(tmp_path_factory.mktemp("qos_data2")),
+                              contigs=2)
+
+
+def polish_solo(paths) -> bytes:
+    p = create_polisher(*paths, PolisherType.kC, 500, 10.0, 0.3,
+                        num_threads=2)
+    p.initialize()
+    return b"".join(b">" + s.name.encode() + b"\n" + s.data + b"\n"
+                    for s in p.polish())
+
+
+@pytest.fixture(scope="module")
+def solo_bytes(dataset):
+    return polish_solo(dataset)
+
+
+@pytest.fixture(scope="module")
+def solo2(dataset2):
+    return polish_solo(dataset2)
+
+
+def _serve_pair(tmp_path_factory, transport, **kw):
+    kw.setdefault("warmup", False)
+    if transport == "tcp":
+        srv = PolishServer(port=0, **kw).start()
+        return srv, PolishClient(port=srv.config.port)
+    sock = str(tmp_path_factory.mktemp("qos_sock") / "s.sock")
+    srv = PolishServer(socket_path=sock, **kw).start()
+    return srv, PolishClient(socket_path=sock)
+
+
+def _wait_for(cond, deadline_s: float = 60.0, msg: str = "condition"):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < deadline_s:
+        if cond():
+            return
+        time.sleep(0.01)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+def _job(id_: str, priority: int = 0, deadline_s: float | None = None,
+         tenant: str = "", trace_id: str | None = None) -> Job:
+    return Job(id_, "s.fa", "o.paf", "t.fa", {}, priority=priority,
+               deadline_s=deadline_s, tenant=tenant, trace_id=trace_id)
+
+
+# ------------------------------------------------------- burst-token unit
+def test_burst_tokens_admit_over_quota_and_refill_at_weight():
+    q = JobQueue(32, workers=1, tenant_quota=1, tenant_burst=2)
+    q.submit(_job("j0", tenant="t"))          # the hard quota slot
+    q.submit(_job("j1", tenant="t"))          # burst token 1 (bucket
+    q.submit(_job("j2", tenant="t"))          # starts FULL), token 2
+    assert q.burst_admits == 2
+    with pytest.raises(TenantQuotaExceeded):
+        q.submit(_job("j3", tenant="t"))      # bucket empty
+    # refill rides the tenant's DRR weight (tokens/second), capped at
+    # capacity: back-date the bucket 3s -> 3 * weight(1.0) earned, but
+    # only `tenant_burst` bankable
+    q._burst["t"] = [0.0, time.monotonic() - 3.0]
+    q.submit(_job("j4", tenant="t"))
+    assert q.burst_admits == 3
+    snap = q.snapshot()
+    assert snap["burst_admits"] == 3          # armed-only snapshot field
+    # an unarmed queue never grows the field (surface identity)
+    assert "burst_admits" not in JobQueue(4).snapshot()
+
+
+# -------------------------------------------------- doomed-admission unit
+def test_doomed_admission_is_priority_aware():
+    q = JobQueue(32, workers=1, abort_margin=0.0)
+    q._ema_service_s = 5.0
+    for i in range(3):                        # low-class backlog
+        q.submit(_job(f"free{i}", priority=0))
+    # gold sees NOTHING at-or-above its class ahead: predicted = one
+    # service time = 5s <= 12s remaining -> admitted
+    q.submit(_job("gold", priority=5, deadline_s=12.0))
+    # the same deadline at priority 0 queues behind 4 jobs:
+    # predicted = 5 * 5 / 1 = 25s > 12s -> doomed, typed with both sides
+    with pytest.raises(DeadlineDoomed) as exc:
+        q.submit(_job("late", priority=0, deadline_s=12.0))
+    assert exc.value.phase == "admission"
+    assert exc.value.predicted_s == pytest.approx(25.0, rel=0.01)
+    assert exc.value.remaining_s <= 12.0
+    # unarmed queue admits the identical job (default byte-identity)
+    q2 = JobQueue(32, workers=1)
+    q2._ema_service_s = 5.0
+    for i in range(3):
+        q2.submit(_job(f"f{i}", priority=0))
+    q2.submit(_job("late2", priority=0, deadline_s=12.0))
+
+
+# ------------------------------------------------------- queue.cancel unit
+def test_queue_cancel_dequeues_and_answers_typed():
+    q = JobQueue(8)
+    q.submit(_job("keep", trace_id="t-keep"))
+    victim = _job("gone", trace_id="t-gone")
+    q.submit(victim)
+    assert q.cancel(job_id="nope") is None
+    got = q.cancel(trace_id="t-gone")
+    assert got is victim
+    assert got.event.is_set()                 # waiter woken immediately
+    assert got.response["type"] == "error"
+    assert got.response["code"] == "cancelled"
+    assert q.counters["expired"] == 1         # accounted like an expiry
+    assert len(q) == 1
+    assert q.pop(timeout=0.2).id == "keep"    # survivor still pops
+
+
+# --------------------------------------------- no-starvation under churn
+def test_pack_iteration_never_starves_oldest_under_preempt_churn():
+    """Property-style: drive pack_iteration through seeded random
+    arrive/withdraw/resume churn — withdrawn items re-enter with their
+    ORIGINAL age, exactly what batcher.resume_job restores — and the
+    globally-oldest pooled item must ship in EVERY iteration."""
+    rng = random.Random(0xC0FFEE)
+    next_age = 0
+    pool: list[tuple[int, int]] = []          # (shape, age)
+    parked: list[tuple[int, int]] = []
+    for _ in range(300):
+        for _ in range(rng.randrange(0, 6)):  # arrivals
+            pool.append((rng.randrange(0, 50), next_age))
+            next_age += 1
+        if pool and rng.random() < 0.4:       # preempt: park a slice
+            k = rng.randrange(1, len(pool) + 1)
+            rng.shuffle(pool)
+            parked.extend(pool[:k])
+            del pool[:k]
+        if parked and rng.random() < 0.6:     # resume: original ages
+            k = rng.randrange(1, len(parked) + 1)
+            pool.extend(parked[:k])
+            del parked[:k]
+        if not pool:
+            continue
+        cap = rng.choice([1, 2, 3, 8])
+        lanes = rng.choice([1, 1, 2, 4])
+        batch, rest = pack_iteration(pool, cap,
+                                     shape_key=lambda it: it[0],
+                                     age_key=lambda it: it[1],
+                                     lane_multiple=lanes)
+        assert batch, "non-empty pool must yield a batch"
+        oldest = min(pool, key=lambda it: it[1])
+        assert oldest in batch, (
+            f"oldest item {oldest} starved (cap={cap}, lanes={lanes})")
+        assert sorted(batch + rest) == sorted(pool)  # nothing lost
+        pool = rest
+
+
+# ------------------------------------- preempt/resume byte identity (e2e)
+@pytest.mark.parametrize("transport,wincache", [("unix", False),
+                                                ("unix", True),
+                                                ("tcp", False),
+                                                ("tcp", True)])
+def test_preempt_resume_byte_identity(dataset, solo_bytes,
+                                      tmp_path_factory, tmp_path,
+                                      transport, wincache):
+    """A running free-tenant job preempted by a gold job resumes and
+    still produces byte-identical FASTA, on both transports, cache on
+    and off; the journal balances every `preempted` with a `resumed`."""
+    journal = str(tmp_path / "qos.jsonl")
+    srv, cl = _serve_pair(tmp_path_factory, transport, workers=1,
+                          preempt=True, wincache=wincache,
+                          journal=journal)
+    results: dict[str, bytes] = {}
+    errors: list[Exception] = []
+
+    def go(tag, **kw):
+        try:
+            results[tag] = cl.submit(*dataset, tenant=tag, **kw).fasta
+        except Exception as exc:  # noqa: BLE001 — asserted below
+            errors.append(exc)
+
+    try:
+        srv.batcher.hold()
+        t_free = threading.Thread(target=go, args=("free",))
+        t_free.start()
+        _wait_for(lambda: len(srv._running_jobs) >= 1,
+                  msg="free job running")
+        time.sleep(0.3)  # let its windows pool behind the hold
+        t_gold = threading.Thread(target=go, args=("gold",),
+                                  kwargs={"priority": 5})
+        t_gold.start()
+        _wait_for(lambda: srv.qos["preemptions"] >= 1,
+                  msg="gold admission preempting the free job")
+        srv.batcher.release()
+        t_free.join(timeout=120)
+        t_gold.join(timeout=120)
+        assert not errors, f"submits failed: {errors}"
+        assert results["free"] == solo_bytes
+        assert results["gold"] == solo_bytes
+    finally:
+        srv.batcher.release()
+        srv.drain(timeout=30)
+    entries = read_journal(journal)
+    events = [e["event"] for e in entries]
+    assert "preempted" in events and "resumed" in events
+    assert check_preemptions(entries) == []
+    assert check_consistency(entries) == []
+
+
+def test_preempt_resume_byte_identity_through_router(dataset2, solo2,
+                                                     tmp_path):
+    """The same contract across a 2-replica router: a gold job's shards
+    preempt the free job's shards on BOTH replicas; both merged outputs
+    stay byte-identical to the solo run."""
+    socks = [str(tmp_path / f"rep{i}.sock") for i in range(2)]
+    journals = [str(tmp_path / f"rep{i}.jsonl") for i in range(2)]
+    reps = [PolishServer(socket_path=s, workers=1, warmup=False,
+                         preempt=True, journal=j).start()
+            for s, j in zip(socks, journals)]
+    router = PolishRouter(replicas=",".join(socks),
+                          socket_path=str(tmp_path / "r.sock"),
+                          health_interval_s=0.2).start()
+    cl = PolishClient(socket_path=router.config.socket_path)
+    results: dict[str, bytes] = {}
+    errors: list[Exception] = []
+
+    def go(tag, **kw):
+        try:
+            results[tag] = cl.submit(*dataset2, tenant=tag, **kw).fasta
+        except Exception as exc:  # noqa: BLE001
+            errors.append(exc)
+
+    try:
+        for r in reps:
+            r.batcher.hold()
+        t_free = threading.Thread(target=go, args=("free",))
+        t_free.start()
+        _wait_for(lambda: sum(len(r._running_jobs) for r in reps) >= 2,
+                  msg="free shards running on both replicas")
+        time.sleep(0.3)
+        t_gold = threading.Thread(target=go, args=("gold",),
+                                  kwargs={"priority": 5})
+        t_gold.start()
+        # the router propagates priority verbatim onto the child
+        # frames, so each replica preempts its own free shard
+        _wait_for(lambda: sum(r.qos["preemptions"] for r in reps) >= 1,
+                  msg="replica-side preemption via routed priority")
+        for r in reps:
+            r.batcher.release()
+        t_free.join(timeout=120)
+        t_gold.join(timeout=120)
+        assert not errors, f"routed submits failed: {errors}"
+        assert results["free"] == solo2
+        assert results["gold"] == solo2
+    finally:
+        for r in reps:
+            r.batcher.release()
+        router.drain()
+        for r in reps:
+            r.drain(timeout=30)
+    for j in journals:
+        assert check_preemptions(read_journal(j)) == []
+
+
+# --------------------------------------------- deadline-abort (e2e, typed)
+def test_doomed_at_admission_typed_to_client(dataset, solo_bytes,
+                                             tmp_path_factory):
+    srv, cl = _serve_pair(tmp_path_factory, "unix", workers=1,
+                          abort_margin=0.0)
+    try:
+        srv.queue._ema_service_s = 100.0      # a busy server's EMA
+        with pytest.raises(ClientDoomed) as exc:
+            cl.submit(*dataset, deadline_s=0.5)
+        assert exc.value.predicted_s == pytest.approx(100.0, rel=0.05)
+        assert exc.value.remaining_s <= 0.5
+        assert srv.qos["aborted_doomed"] >= 1
+        assert "aborted_doomed" in cl.scrape()  # armed families render
+        srv.queue._ema_service_s = 1.0
+        assert cl.submit(*dataset).fasta == solo_bytes  # server survives
+    finally:
+        srv.drain(timeout=30)
+
+
+def test_doomed_mid_run_at_iteration_boundary(dataset, solo_bytes,
+                                              tmp_path_factory,
+                                              tmp_path):
+    """An admitted job whose deadline is provably lost dies typed at
+    the next iteration boundary (batcher extrapolation), not at job
+    completion: hold the feeder past the deadline, then release."""
+    journal = str(tmp_path / "midrun.jsonl")
+    srv, cl = _serve_pair(tmp_path_factory, "unix", workers=1,
+                          abort_margin=0.0, iteration_windows=2,
+                          journal=journal)
+    caught: list[Exception] = []
+
+    def go():
+        try:
+            cl.submit(*dataset, deadline_s=2.5)
+        except Exception as exc:  # noqa: BLE001
+            caught.append(exc)
+
+    try:
+        srv.batcher.hold()
+        t = threading.Thread(target=go)
+        t.start()
+        _wait_for(lambda: len(srv._running_jobs) >= 1,
+                  msg="doomed job running")
+        time.sleep(3.0)                       # deadline passes held
+        srv.batcher.release()
+        t.join(timeout=120)
+        assert len(caught) == 1 and isinstance(caught[0], ClientDoomed)
+        assert srv.qos["aborted_doomed"] >= 1
+        assert cl.submit(*dataset).fasta == solo_bytes
+    finally:
+        srv.batcher.release()
+        srv.drain(timeout=30)
+    doomed = [e for e in read_journal(journal)
+              if e["event"] == "deadline-doomed"]
+    assert doomed and doomed[0]["phase"] == "mid-run"
+
+
+# ----------------------------------------------------- cancel RPC (e2e)
+def test_cancel_queued_job_end_to_end(dataset, solo_bytes,
+                                      tmp_path_factory, tmp_path):
+    journal = str(tmp_path / "cq.jsonl")
+    srv, cl = _serve_pair(tmp_path_factory, "unix", workers=1,
+                          journal=journal)
+    caught: list[Exception] = []
+    done: dict[str, bytes] = {}
+
+    def runner():
+        done["first"] = cl.submit(*dataset).fasta
+
+    def queued():
+        try:
+            cl.submit(*dataset, trace_id="q-victim")
+        except Exception as exc:  # noqa: BLE001
+            caught.append(exc)
+
+    try:
+        srv.batcher.hold()
+        t1 = threading.Thread(target=runner)
+        t1.start()
+        _wait_for(lambda: len(srv._running_jobs) >= 1,
+                  msg="first job running")
+        t2 = threading.Thread(target=queued)
+        t2.start()
+        _wait_for(lambda: len(srv.queue) >= 1, msg="victim queued")
+        res = cl.cancel(trace_id="q-victim")
+        assert res["cancelled"] == "queued"
+        t2.join(timeout=30)
+        assert len(caught) == 1 and isinstance(caught[0], JobCancelled)
+        srv.batcher.release()
+        t1.join(timeout=120)
+        assert done["first"] == solo_bytes    # survivor untouched
+        assert srv.qos["cancelled"] >= 1
+        # unknown handles answer typed, not crash
+        with pytest.raises(ServeError) as exc:
+            cl.cancel(job_id="no-such-job")
+        assert exc.value.code == "unknown-job"
+    finally:
+        srv.batcher.release()
+        srv.drain(timeout=30)
+    assert "cancelled" in [e["event"] for e in read_journal(journal)]
+
+
+def test_cancel_running_job_end_to_end(dataset, solo_bytes,
+                                       tmp_path_factory):
+    srv, cl = _serve_pair(tmp_path_factory, "unix", workers=1)
+    caught: list[Exception] = []
+
+    def go():
+        try:
+            cl.submit(*dataset, trace_id="r-victim")
+        except Exception as exc:  # noqa: BLE001
+            caught.append(exc)
+
+    try:
+        srv.batcher.hold()
+        t = threading.Thread(target=go)
+        t.start()
+        _wait_for(lambda: len(srv._running_jobs) >= 1,
+                  msg="victim running")
+        time.sleep(0.2)
+        res = cl.cancel(trace_id="r-victim")
+        assert res["cancelled"] == "running"
+        t.join(timeout=60)
+        assert len(caught) == 1 and isinstance(caught[0], JobCancelled)
+        assert srv.qos["cancelled"] >= 1
+        srv.batcher.release()
+        assert cl.submit(*dataset).fasta == solo_bytes
+    finally:
+        srv.batcher.release()
+        srv.drain(timeout=30)
+
+
+def test_client_cancel_on_timeout_frees_the_server(dataset, solo_bytes,
+                                                   tmp_path_factory):
+    """Satellite (e): a client giving up on its own `timeout` sends a
+    cancel for its trace id on a fresh connection, so the abandoned
+    job frees its queue/quota slots instead of running to waste."""
+    srv, cl = _serve_pair(tmp_path_factory, "unix", workers=1)
+    impatient = PolishClient(socket_path=srv.config.socket_path,
+                             timeout=2.0)
+    try:
+        srv.batcher.hold()
+        with pytest.raises(JobCancelled):
+            impatient.submit(*dataset, cancel_on_timeout=True)
+        _wait_for(lambda: srv.qos["cancelled"] >= 1,
+                  msg="server-side cancel accounting")
+        srv.batcher.release()
+        assert cl.submit(*dataset).fasta == solo_bytes
+    finally:
+        srv.batcher.release()
+        srv.drain(timeout=30)
+
+
+# ------------------------------------------------- router QoS propagation
+class _RecordingDyingReplica:
+    """Protocol-complete fake replica that records each submit frame's
+    `deadline_s`, burns ~0.6s of the parent budget, then drops the
+    connection — so a requeue's inherited deadline is observable."""
+
+    def __init__(self, sock_path: str):
+        self.path = sock_path
+        self.deadlines: list = []
+        self._stop = threading.Event()
+        self._lst = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._lst.bind(sock_path)
+        self._lst.listen(8)
+        self._lst.settimeout(0.2)
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def _loop(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._lst.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            threading.Thread(target=self._handle, args=(conn,),
+                             daemon=True).start()
+
+    def _handle(self, conn):
+        import contextlib
+        try:
+            while True:
+                req = recv_frame(conn)
+                if req is None:
+                    return
+                rtype = req.get("type")
+                if rtype == "healthz":
+                    send_frame(conn, {"type": "healthz", "ok": True,
+                                      "draining": False})
+                elif rtype == "scrape":
+                    send_frame(conn, {"type": "metrics", "text": ""})
+                elif rtype == "ping":
+                    send_frame(conn, {"type": "pong"})
+                elif rtype == "submit":
+                    self.deadlines.append(req.get("deadline_s"))
+                    time.sleep(0.6)
+                    with contextlib.suppress(OSError):
+                        conn.shutdown(socket.SHUT_RDWR)
+                    return
+                else:
+                    send_frame(conn, {"type": "ok"})
+        except (OSError, ProtocolError):
+            return
+        finally:
+            import contextlib
+            with contextlib.suppress(OSError):
+                conn.close()
+
+    def close(self):
+        self._stop.set()
+        try:
+            self._lst.close()
+        except OSError:
+            pass
+
+
+def test_requeued_shard_inherits_remaining_deadline(dataset, tmp_path):
+    """The ISSUE's pin: `deadline_t` is the parent's ABSOLUTE deadline,
+    so the requeue attempt's child `deadline_s` is the REMAINING
+    budget — strictly less than the first attempt's, never a reset."""
+    stubs = [_RecordingDyingReplica(str(tmp_path / f"d{i}.sock"))
+             for i in range(2)]
+    router = PolishRouter(replicas=",".join(s.path for s in stubs),
+                          socket_path=str(tmp_path / "r.sock"),
+                          health_interval_s=0.2).start()
+    try:
+        cl = PolishClient(socket_path=router.config.socket_path)
+        with pytest.raises(ServeError):
+            cl.submit(*dataset, deadline_s=30.0)
+        recorded = [d for s in stubs for d in s.deadlines]
+        assert len(recorded) >= 2, recorded   # attempt + requeue(s)
+        assert all(isinstance(d, float) for d in recorded)
+        assert max(recorded) <= 30.0          # never more than granted
+        # every attempt burned ~0.6s of the SAME budget: all recorded
+        # deadlines are distinct, and first-to-last spans the burn
+        assert len(set(recorded)) == len(recorded)
+        assert min(recorded) <= max(recorded) - 0.4
+    finally:
+        router.drain()
+        for s in stubs:
+            s.close()
+
+
+def test_doomed_shard_cancels_siblings_through_router(dataset2, solo2,
+                                                      tmp_path):
+    """A deadline-doomed child fails the parent AND fans cancel RPCs to
+    the sibling shards within one iteration — the still-running shard
+    on the healthy replica dies cancelled instead of burning device
+    time for a result nobody will merge."""
+    socks = [str(tmp_path / f"s{i}.sock") for i in range(2)]
+    # replica 0 carries the speculative-abort margin (mid-run doom at
+    # the first iteration boundary once the deadline is provably
+    # lost); replica 1 is a plain server whose shard the fan-out cancel
+    # must reach while it is still running
+    reps = [PolishServer(socket_path=socks[0], workers=1, warmup=False,
+                         abort_margin=0.0, iteration_windows=1).start(),
+            PolishServer(socket_path=socks[1], workers=1,
+                         warmup=False).start()]
+    journal = str(tmp_path / "router.jsonl")
+    router = PolishRouter(replicas=",".join(socks),
+                          socket_path=str(tmp_path / "r.sock"),
+                          journal=journal,
+                          health_interval_s=0.2).start()
+    cl = PolishClient(socket_path=router.config.socket_path)
+    caught: list[Exception] = []
+
+    def go():
+        try:
+            cl.submit(*dataset2, deadline_s=3.0)
+        except Exception as exc:  # noqa: BLE001
+            caught.append(exc)
+
+    try:
+        for r in reps:
+            r.batcher.hold()
+        t = threading.Thread(target=go)
+        t.start()
+        _wait_for(lambda: sum(len(r._running_jobs) for r in reps) >= 2,
+                  msg="both shards admitted and running")
+        time.sleep(3.5)                       # the parent deadline dies
+        reps[0].batcher.release()             # -> mid-run doom there
+        _wait_for(lambda: reps[1].qos["cancelled"] >= 1,
+                  msg="sibling shard cancelled on the healthy replica")
+        t.join(timeout=60)
+        assert len(caught) == 1 and isinstance(caught[0], ClientDoomed)
+        reps[1].batcher.release()
+        assert cl.submit(*dataset2).fasta == solo2  # fabric survives
+    finally:
+        for r in reps:
+            r.batcher.release()
+        router.drain()
+        for r in reps:
+            r.drain(timeout=30)
+    events = [e["event"] for e in read_journal(journal)]
+    assert "siblings-cancelled" in events
+
+
+def test_parent_cancel_fans_out_through_router(dataset2, solo2,
+                                               tmp_path):
+    socks = [str(tmp_path / f"p{i}.sock") for i in range(2)]
+    reps = [PolishServer(socket_path=s, workers=1,
+                         warmup=False).start() for s in socks]
+    journal = str(tmp_path / "rcancel.jsonl")
+    router = PolishRouter(replicas=",".join(socks),
+                          socket_path=str(tmp_path / "r.sock"),
+                          journal=journal,
+                          health_interval_s=0.2).start()
+    cl = PolishClient(socket_path=router.config.socket_path)
+    caught: list[Exception] = []
+
+    def go():
+        try:
+            cl.submit(*dataset2, trace_id="parent-1")
+        except Exception as exc:  # noqa: BLE001
+            caught.append(exc)
+
+    try:
+        for r in reps:
+            r.batcher.hold()
+        t = threading.Thread(target=go)
+        t.start()
+        _wait_for(lambda: sum(len(r._running_jobs) for r in reps) >= 2,
+                  msg="both shards running")
+        time.sleep(0.5)  # shards' windows pooled -> tickets registered
+        res = cl.cancel(trace_id="parent-1")
+        assert res["cancelled"] == "running"
+        assert res["shards_cancelled"] >= 1
+        t.join(timeout=60)
+        assert len(caught) == 1 and isinstance(caught[0], JobCancelled)
+        for r in reps:
+            r.batcher.release()
+        assert cl.submit(*dataset2).fasta == solo2
+    finally:
+        for r in reps:
+            r.batcher.release()
+        router.drain()
+        for r in reps:
+            r.drain(timeout=30)
+    events = [e["event"] for e in read_journal(journal)]
+    assert "cancelled" in events
+    assert "siblings-cancelled" in events
+
+
+# ----------------------------------------------- default-off byte identity
+def test_qos_off_surfaces_identical_to_pre_qos(dataset, solo_bytes,
+                                               tmp_path_factory,
+                                               tmp_path):
+    """With no QoS knob armed, the scrape grows no new families, the
+    stats body no `qos` block, and a clean job's journal no new event
+    types — the pre-QoS surfaces byte-for-byte."""
+    journal = str(tmp_path / "plain.jsonl")
+    srv, cl = _serve_pair(tmp_path_factory, "unix", workers=1,
+                          journal=journal)
+    try:
+        assert cl.submit(*dataset).fasta == solo_bytes
+        text = cl.scrape()
+        for family in ("preempt", "doomed", "burst",
+                       "cancelled"):
+            assert family not in text, f"QoS-off scrape leaks {family}"
+        assert "qos" not in cl.stats()
+    finally:
+        srv.drain(timeout=30)
+    events = {e["event"] for e in read_journal(journal)}
+    assert not (events & QOS_EVENTS), events
+
+
+# -------------------------------------------------- obsreport unit checks
+def test_obsreport_preemption_balance_red_and_green():
+    base = [{"event": "received", "job": "a"},
+            {"event": "preempted", "job": "a"}]
+    assert check_preemptions(base + [{"event": "resumed",
+                                      "job": "a"}]) == []
+    problems = check_preemptions(base)
+    assert problems and "1 preempted events vs 0 resumed" in problems[0]
+    # a job whose `received` fell out of the rotation window is skipped
+    assert check_preemptions([{"event": "preempted", "job": "b"}]) == []
+
+
+# -------------------------------------------------- cancel CLI surface
+def test_cancel_cli_dispatch_and_arg_validation(capsys):
+    """`racon_tpu cancel` routes through cli.main; without an id it
+    fails typed rc 1 before touching any socket."""
+    from racon_tpu.cli import main as cli_main
+
+    assert cli_main(["cancel", "--socket", "/tmp/nope.sock"]) == 1
+    err = capsys.readouterr().err
+    assert "needs --job-id or --trace-id" in err
+    # an unreachable server is a connection error, not a crash
+    assert cli_main(["cancel", "--socket", "/tmp/definitely-not-a.sock",
+                     "--trace-id", "x"]) == 1
+    assert "error" in capsys.readouterr().err

@@ -43,6 +43,14 @@ bytes ship and no audit event fires. Both cells are gated; together
 they pin that detection is real AND that it comes from the sampling,
 not from some hidden always-on check.
 
+A PREEMPT section (two gated cells) exercises the preemptive-QoS layer:
+a gold-priority job preempting a running free job on a one-worker
+server (both outputs byte-identical to an undisturbed run, balanced
+`preempted`/`resumed` journal pair), and a cancel RPC landing during an
+injected `device:hang` (watchdog absorbs the hang, the job fails with
+the typed `cancelled` error, the warm server's next clean job is
+byte-identical).
+
 Usage: python tools/faultcheck.py [--quick]
   --quick drops the hang cases (the slow rows; the pytest suite tags the
   same cases with the `slow`/`faults` markers so tier-1 skips them too).
@@ -571,6 +579,124 @@ def run_router_cells(tmp: str) -> list[tuple[str, str]]:
     return cells
 
 
+def run_preempt_cells(tmp: str) -> list[tuple[str, str]]:
+    """The preemptive-QoS section (serve QoS: --preempt + cancel RPC):
+    a gold-priority job preempts a running free job on a one-worker
+    server — the free job's pooled windows are withdrawn and parked,
+    gold runs, the free job resumes — and BOTH outputs must be
+    byte-identical to an undisturbed run, with the balanced
+    `preempted`/`resumed` pair on the journal. Then a cancel RPC lands
+    during an injected `device:hang`: the watchdog absorbs the hang,
+    the cancelled job fails with the typed `cancelled` error instead of
+    shipping unwanted bytes, and the same warm server's next clean job
+    reproduces the clean bytes exactly."""
+    from racon_tpu.obs.journal import read_journal
+    from racon_tpu.serve import (JobCancelled, PolishClient,
+                                 PolishServer, make_synth_dataset)
+
+    names = ("preempt gold over free", "cancel during device hang")
+    cells: list[tuple[str, str]] = []
+    data_dir = os.path.join(tmp, "preempt_data")
+    os.makedirs(data_dir, exist_ok=True)
+    ppaths = make_synth_dataset(data_dir, contigs=3)
+    sock = os.path.join(tmp, "preempt.sock")
+    journal = os.path.join(tmp, "preempt_journal.jsonl")
+    server = None
+    try:
+        server = PolishServer(socket_path=sock, workers=1, warmup=False,
+                              quality_threshold=-1.0, preempt=True,
+                              journal=journal).start()
+        client = PolishClient(socket_path=sock)
+        clean = client.submit(*ppaths).fasta  # the undisturbed bytes
+
+        def run_job(out: dict, **kw):
+            mine = PolishClient(socket_path=sock)
+            try:
+                out["fasta"] = mine.submit(*ppaths, **kw).fasta
+            except Exception as exc:  # noqa: BLE001 — checked below
+                out["exc"] = exc
+
+        free_res: dict = {}
+        gold_res: dict = {}
+        # hold the device feeder so the free job is deterministically
+        # mid-flight (windows pooled, not yet dispatched) when gold
+        # arrives — the admission-time preemption path, not a race
+        server.batcher.hold()
+        try:
+            t_free = threading.Thread(target=run_job, args=(free_res,),
+                                      kwargs={"tenant": "free"})
+            t_free.start()
+            deadline = time.perf_counter() + 60
+            while (time.perf_counter() < deadline
+                   and not server._running_jobs):
+                time.sleep(0.02)
+            t_gold = threading.Thread(target=run_job, args=(gold_res,),
+                                      kwargs={"tenant": "gold",
+                                              "priority": 5})
+            t_gold.start()
+            while (time.perf_counter() < deadline
+                   and server.qos["preemptions"] < 1):
+                time.sleep(0.02)
+        finally:
+            server.batcher.release()
+        t_free.join(WALL_CAP)
+        t_gold.join(WALL_CAP)
+        events = [e["event"] for e in read_journal(journal)]
+        checks = [("preempted", server.qos["preemptions"] >= 1),
+                  ("preempted-journaled", "preempted" in events),
+                  ("resumed-journaled", "resumed" in events),
+                  ("free-identical", free_res.get("fasta") == clean),
+                  ("gold-identical", gold_res.get("fasta") == clean)]
+        failed = [n for n, ok in checks if not ok]
+        for res in (free_res, gold_res):
+            if "exc" in res:
+                failed.append(f"({type(res['exc']).__name__}: "
+                              f"{res['exc']})")
+        cells.append((names[0],
+                      "pass  preempted+resumed, both identical"
+                      if not failed else f"FAIL {' '.join(failed)}"))
+
+        # cell 2: cancel landing mid-hang on the SAME warm server —
+        # the hang plan parks the job inside the device dispatch for
+        # ~2s (watchdog timeout), a window no scheduler trick is
+        # needed to hit
+        poison_res: dict = {}
+        t_poison = threading.Thread(
+            target=run_job, args=(poison_res,),
+            kwargs={"fault_plan": "device:chunk=0:hang=8",
+                    "options": {"tpu_device_timeout": 2.0},
+                    "trace_id": "faultcheck-cancel"})
+        t_poison.start()
+        time.sleep(1.0)  # job admitted and stalled inside the hang
+        try:
+            cres = client.cancel(trace_id="faultcheck-cancel")
+        except Exception as exc:  # noqa: BLE001 — checked below
+            cres = {"error": f"{type(exc).__name__}: {exc}"}
+        t_poison.join(WALL_CAP)
+        try:
+            after = client.submit(*ppaths).fasta
+        except Exception:  # noqa: BLE001 — dead server is the failure
+            after = None
+        checks = [("cancel-acked", cres.get("type") == "ok"),
+                  ("typed-cancelled",
+                   isinstance(poison_res.get("exc"), JobCancelled)),
+                  ("server-survived-identical", after == clean)]
+        failed = [n for n, ok in checks if not ok]
+        cells.append((names[1],
+                      "pass  cancelled typed, watchdog absorbed, "
+                      "server clean"
+                      if not failed else f"FAIL {' '.join(failed)}"))
+    except Exception as exc:  # noqa: BLE001 — a crashed section is a
+        # red pair of cells, not a crashed grid
+        detail = f"FAIL crashed ({type(exc).__name__}: {exc})"
+        while len(cells) < 2:
+            cells.append((names[len(cells)], detail))
+    finally:
+        if server is not None:
+            server.drain(timeout=30)
+    return cells
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true",
@@ -712,8 +838,15 @@ def main() -> int:
         for name, cell in router_cells:
             failures += cell.startswith("FAIL")
             print(f"{name:<{width}}  {cell}", file=sys.stderr)
+        # the preemptive-QoS section: gold preempts free byte-
+        # identically; a cancel RPC lands during a watchdog-absorbed
+        # hang and the server survives
+        preempt_cells = run_preempt_cells(tmp)
+        for name, cell in preempt_cells:
+            failures += cell.startswith("FAIL")
+            print(f"{name:<{width}}  {cell}", file=sys.stderr)
     n_cells = ((len(columns) + 2) * len(rows) + len(audit_cells)
-               + len(router_cells))
+               + len(router_cells) + len(preempt_cells))
     print(f"[faultcheck] {'FAIL' if failures else 'PASS'}: "
           f"{n_cells - failures}/{n_cells} cells green",
           file=sys.stderr)

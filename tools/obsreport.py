@@ -36,7 +36,10 @@ per round (annotation events, rendered in the job's timeline with the
 round's wall clock and window-cache hit count), and `--check` pins
 the two counts equal per job, so a round that died mid-loop (or a
 duplicated boundary line) is a red check, not a plausible-looking
-timeline."""
+timeline — and the preemption lifecycle: every `preempted` a job
+journals must be balanced by exactly one `resumed` (the server emits
+`resumed` with reason=terminal when a job ends while still parked), so
+a job left parked forever — a leaked withdrawal — is a red check."""
 
 from __future__ import annotations
 
@@ -178,6 +181,7 @@ def main(argv=None) -> int:
     problems = check_consistency(entries)
     problems += check_parts_streamed(entries)
     problems += check_rounds(entries)
+    problems += check_preemptions(entries)
     for p in problems:
         print(f"consistency: {p}", file=out)
     print(f"consistency: {'OK' if not problems else 'FAIL'} "
@@ -254,6 +258,41 @@ def check_rounds(entries: list[dict]) -> list[str]:
             problems.append(
                 f"job {job}: {n_started} round-started events vs "
                 f"{n_finished} round-finished")
+    return problems
+
+
+def check_preemptions(entries: list[dict]) -> list[str]:
+    """Preemption invariant: every `preempted` a job journals must be
+    balanced by exactly one `resumed` — the server resumes a parked
+    job when capacity frees, and a job that TERMINATES while parked
+    still gets its `resumed` line (reason=terminal) from the
+    post-terminal cleanup. An unbalanced count means a withdrawal
+    leaked: a job parked forever with its windows held hostage. Jobs
+    whose `received` line fell out of the journal's rotation window
+    are skipped (the same tolerance the other per-job checks apply)."""
+    preempted: dict[str, int] = {}
+    resumed: dict[str, int] = {}
+    received: set[str] = set()
+    for e in entries:
+        job = e.get("job")
+        if not job:
+            continue
+        if e.get("event") == "received":
+            received.add(str(job))
+        elif e.get("event") == "preempted":
+            preempted[str(job)] = preempted.get(str(job), 0) + 1
+        elif e.get("event") == "resumed":
+            resumed[str(job)] = resumed.get(str(job), 0) + 1
+    problems: list[str] = []
+    for job in sorted(set(preempted) | set(resumed)):
+        if job not in received:
+            continue
+        n_pre = preempted.get(job, 0)
+        n_res = resumed.get(job, 0)
+        if n_pre != n_res:
+            problems.append(
+                f"job {job}: {n_pre} preempted events vs "
+                f"{n_res} resumed")
     return problems
 
 

@@ -69,6 +69,19 @@ Metric extraction understands both artifact shapes:
     dotted key when absent). Like router sweeps, rounds artifacts have
     no implicit baseline.
 
+  - servebench `--flood` artifacts (`"mode": "flood"`) carry a `qos`
+    block (preemptive-QoS isolation under a free-tenant flood):
+    `qos.gold_p99_flat` — gold-tenant p99 under flood-with-preemption
+    over gold p99 on an idle fabric — gates ABSOLUTELY whenever the
+    block is present (default 2.0; `--gold-p99-flat-max` makes it
+    mandatory, rc 2 naming the dotted key when absent), and
+    `qos.doomed_abort_saved_s` (EMA-predicted device seconds the
+    speculative deadline-aborts saved) gates against
+    `--doomed-abort-min`, mandatory once requested — an artifact
+    without the key exits 2 naming it. Like router sweeps, flood
+    artifacts have no implicit baseline (the idle arm inside the
+    artifact is the comparison).
+
   - synthbench `--json` artifacts (`"mode": "synth"`):
     `synth.windows_per_s`, HIGHER is better — gated ABSOLUTELY against
     `--windows-per-s-min` (the kernel-plane regression floor) and
@@ -251,6 +264,24 @@ def extract(doc: dict, path: str = "<artifact>") -> dict:
         if isinstance(inner.get("mesh"), dict):
             out["mesh"] = inner["mesh"]
         return out
+    if inner.get("mode") == "flood":
+        # servebench --flood artifact: gold-tenant p99 under a
+        # free-tenant flood with preemption, as a ratio over the idle
+        # fabric's gold p99 — LOWER is better (1.0 = perfectly flat).
+        # No implicit baseline (the idle arm inside the artifact IS
+        # the comparison) — the qos block's absolute gates carry the
+        # verdict; --against another flood artifact adds the relative
+        # flatness gate.
+        value = _lookup(inner, "qos.gold_p99_flat")
+        if value is None:
+            raise GateError(
+                f"{path}: artifact lacks gated metric "
+                "'qos.gold_p99_flat'")
+        out = {"name": "flood gold p99 flatness", "value": float(value),
+               "unit": "x", "higher_better": False, "kind": "flood"}
+        if isinstance(inner.get("mesh"), dict):
+            out["mesh"] = inner["mesh"]
+        return out
     if inner.get("mode") == "synth":
         # synthbench --json artifact: windows_per_s, HIGHER is better.
         # No implicit baseline exists for it (the published BASELINE
@@ -335,6 +366,11 @@ def resolve_baseline(cand: dict, args, candidate_path: str) -> tuple:
         # point; the cache block's absolute gates carry the verdict
         raise GateError("rounds artifact has no implicit baseline "
                         "(use --round2-speedup-min and/or --against)")
+    if cand.get("kind") == "flood":
+        # the idle-fabric arm inside the artifact is the comparison
+        # point; the qos block's absolute gates carry the verdict
+        raise GateError("flood artifact has no implicit baseline "
+                        "(use --doomed-abort-min and/or --against)")
     if cand.get("kind") == "synth":
         # a published sample-workload baseline is not comparable with a
         # synthetic-scale run; synth artifacts gate absolutely and/or
@@ -604,6 +640,67 @@ def cache_checks(doc: dict, args,
     return checks
 
 
+def qos_checks(doc: dict, args,
+               candidate_path: str) -> list[tuple[str, bool, str]]:
+    """Preemptive-QoS gates for servebench --flood artifacts:
+    (name, ok, detail) triples. Whenever the artifact carries a `qos`
+    block: `qos.gold_p99_flat` (gold p99 under flood-with-preemption
+    over gold p99 idle) gates ABSOLUTELY at the default 2.0 — gold
+    latency must stay flat, not merely better than the no-preemption
+    arm; `--gold-p99-flat-max` overrides the limit and makes the gate
+    mandatory (an artifact without the key exits 2 naming it).
+    `--doomed-abort-min X` additionally gates
+    `qos.doomed_abort_saved_s` (EMA-predicted device seconds the
+    admission-time deadline-aborts saved) >= X, mandatory once
+    requested — an artifact without the key exits 2 naming it."""
+    explicit_flat = args.gold_p99_flat_max is not None
+    explicit_doomed = args.doomed_abort_min is not None
+    inner = doc.get("parsed", doc)
+    qos = inner.get("qos") if isinstance(inner, dict) else None
+    if not isinstance(qos, dict):
+        if explicit_flat:
+            raise GateError(
+                f"{candidate_path}: artifact lacks gated metric "
+                "'qos.gold_p99_flat' (--gold-p99-flat-max gates "
+                "servebench --flood artifacts)")
+        if explicit_doomed:
+            raise GateError(
+                f"{candidate_path}: artifact lacks gated metric "
+                "'qos.doomed_abort_saved_s' (--doomed-abort-min gates "
+                "servebench --flood artifacts)")
+        return []
+    checks: list[tuple[str, bool, str]] = []
+    flat = qos.get("gold_p99_flat")
+    if flat is None:
+        if explicit_flat:
+            raise GateError(
+                f"{candidate_path}: artifact lacks gated metric "
+                "'qos.gold_p99_flat'")
+    else:
+        limit = (args.gold_p99_flat_max if explicit_flat else 2.0)
+        ok = float(flat) <= limit
+        checks.append(("qos.gold_p99_flat", ok,
+                       f"{flat:g} <= {limit:g}"
+                       + ("" if ok else
+                          " (gold p99 under the flood is NOT flat vs "
+                          "the idle fabric — preemption failed to "
+                          "isolate the gold tenant)")))
+    if explicit_doomed:
+        saved = _lookup(inner, "qos.doomed_abort_saved_s")
+        if saved is None:
+            raise GateError(
+                f"{candidate_path}: artifact lacks gated metric "
+                "'qos.doomed_abort_saved_s'")
+        limit = float(args.doomed_abort_min)
+        ok = float(saved) >= limit
+        checks.append(("qos.doomed_abort_saved_s", ok,
+                       f"{saved:g} >= {limit:g}"
+                       + ("" if ok else
+                          " (the speculative deadline-abort saved "
+                          "less device time than the floor)")))
+    return checks
+
+
 def fused_checks(cand: dict, args,
                  candidate_path: str) -> list[tuple[str, float, float]]:
     """Host-overhead gate for artifacts carrying a `fused` block
@@ -734,6 +831,11 @@ def run(args) -> int:
             # identity + hit-rate gates (plus --round2-speedup-min)
             # are absolute, no external baseline required
             reference, ref_desc, ref = None, "", None
+        elif cand.get("kind") == "flood" and not args.against:
+            # flood artifacts carry the idle arm internally: the qos
+            # block's flatness (plus --doomed-abort-min) gates are
+            # absolute, no external baseline required
+            reference, ref_desc, ref = None, "", None
         else:
             raise
     # mesh comparability resolves BEFORE any relative verdict prints: a
@@ -792,6 +894,12 @@ def run(args) -> int:
               f"(limit {limit:g}s, {kind})", file=sys.stderr)
     for name, check_ok, detail in router_checks(doc, args,
                                                 candidate_path):
+        failures += 0 if check_ok else 1
+        print(f"[perfgate] {'PASS' if check_ok else 'FAIL'}: "
+              f"{os.path.basename(candidate_path)} {name} ({detail})",
+              file=sys.stderr)
+    for name, check_ok, detail in qos_checks(doc, args,
+                                             candidate_path):
         failures += 0 if check_ok else 1
         print(f"[perfgate] {'PASS' if check_ok else 'FAIL'}: "
               f"{os.path.basename(candidate_path)} {name} ({detail})",
@@ -904,6 +1012,22 @@ def main(argv=None) -> int:
                          "gated on cache.identical, a nonzero "
                          "cache.hit_rate and audit.mismatches == 0 "
                          "whenever those keys are present")
+    ap.add_argument("--gold-p99-flat-max", type=float, default=None,
+                    help="absolute bound on the flood-mode gold-p99 "
+                         "flatness ratio (qos.gold_p99_flat: gold p99 "
+                         "under flood-with-preemption over gold p99 "
+                         "idle, servebench --flood artifacts; default: "
+                         "gate at 2.0 whenever the artifact carries "
+                         "the key; passing a value makes the gate "
+                         "mandatory — an artifact without it then "
+                         "exits 2 naming the dotted key)")
+    ap.add_argument("--doomed-abort-min", type=float, default=None,
+                    help="absolute floor in SECONDS on the device time "
+                         "the speculative deadline-aborts saved "
+                         "(qos.doomed_abort_saved_s, servebench "
+                         "--flood artifacts); mandatory once passed — "
+                         "an artifact without the key exits 2 naming "
+                         "the dotted key")
     ap.add_argument("--scale-balance-max", type=float, default=None,
                     help="per-shard useful-cell balance bound (max/min) "
                          "for synthbench --scale-curve artifacts "

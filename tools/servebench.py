@@ -74,6 +74,18 @@ cache snapshot) blocks; tools/perfgate.py gates `cache.identical`
 whenever the block is present and `rounds.round2_speedup_x` via
 `--round2-speedup-min`.
 
+FLOOD MODE (`--flood N`): preemptive-QoS isolation. N free-tenant
+submitter threads flood a 2-replica routed fabric in a closed loop
+while gold-priority waves measure p99 three ways — idle fabric, flood
+with preemption off, flood with preemption on — then a doomed-abort
+phase arms the speculative deadline-abort and submits unmeetable
+deadlines that must be rejected typed at admission. The artifact gains
+a `qos` block (`gold_p99_flat` = gold p99 under flood-with-preemption
+over idle, `doomed_abort_saved_s` = EMA-predicted device seconds the
+aborts saved) which tools/perfgate.py gates via `qos.gold_p99_flat`
+(default-when-present) and `--doomed-abort-min` (mandatory once
+requested).
+
 OPEN-LOOP ARRIVAL MODE (`--qps`, optionally a `--qps-curve` sweep):
 instead of firing the whole wave at once (closed-loop, back-pressure
 hides the queueing), jobs arrive by a Poisson process at the target
@@ -638,6 +650,207 @@ def run_rounds_bench(args, PolishClient, PolishServer) -> int:
     return 0
 
 
+def run_flood_bench(args, PolishClient, PolishServer) -> int:
+    """`--flood N`: preemptive-QoS isolation under load. Two warm
+    replicas behind the shard-aware router; N free-tenant submitter
+    threads flood the fabric in a closed loop while a gold-priority
+    wave runs through it. Three gold waves measure three points:
+
+      1. idle fabric            -> gold p99 baseline
+      2. flood, preemption OFF  -> gold p99 degraded by head-of-line
+                                   free work (reported, not gated)
+      3. flood, preemption ON   -> gold p99 must stay FLAT: each gold
+                                   shard preempts the free job on its
+                                   replica, runs, and the free job
+                                   resumes byte-identically
+
+    then a doomed-abort phase arms the speculative deadline-abort
+    (`abort_margin` 0) on every replica and submits free jobs with an
+    unmeetable deadline: each must come back typed `deadline-doomed`
+    at ADMISSION — before any device dispatch — and the sum of their
+    EMA-predicted service seconds is the device time the abort saved.
+    The `--json` artifact gains a `qos` block (`gold_p99_flat` = gold
+    p99 flood-with-preemption over idle, `doomed_abort_saved_s`)
+    which tools/perfgate.py gates via `qos.gold_p99_flat`
+    (default-when-present) and `--doomed-abort-min` (mandatory once
+    requested). Exit status: every gold job byte-identical to a
+    direct submit in every phase, preemptions actually fired in
+    phase 3, and every unmeetable-deadline job was aborted doomed."""
+    from racon_tpu.serve import DeadlineDoomed
+    from racon_tpu.serve.queue import nearest_rank
+    from racon_tpu.serve.router import PolishRouter
+
+    n_flood = max(1, args.flood)
+    n_gold = max(2, args.jobs)
+    fail: list[str] = []
+    with tempfile.TemporaryDirectory(prefix="racon_floodbench_") as tmp:
+        print(f"[servebench] flood bench: {n_flood} free submitter(s) "
+              f"vs {n_gold}-job gold waves, 2 replicas", file=sys.stderr)
+        paths = build_dataset(tmp, args.genome_kb, args.coverage,
+                              args.read_len, args.seed,
+                              contigs=args.contigs)
+        servers, socks = [], []
+        router = None
+        try:
+            t0 = time.perf_counter()
+            for k in range(2):
+                sock = os.path.join(tmp, f"flood_rep{k}.sock")
+                srv = PolishServer(
+                    socket_path=sock, workers=args.workers,
+                    warmup=False, job_threads=args.threads,
+                    tpu_poa_batches=args.tpupoa_batches,
+                    tpu_aligner_batches=args.tpualigner_batches)
+                srv.warmup(paths=paths)
+                srv.start()
+                servers.append(srv)
+                socks.append(sock)
+            router = PolishRouter(
+                replicas=socks,
+                socket_path=os.path.join(tmp, "flood_router.sock"),
+                journal=os.path.join(tmp, "flood_router.jsonl")).start()
+            client = PolishClient(
+                socket_path=router.config.socket_path)
+            print(f"[servebench] fabric warm in "
+                  f"{time.perf_counter() - t0:.2f}s", file=sys.stderr)
+            # the identity reference — and the submit that seeds every
+            # replica's service-time EMA for the doomed phase
+            solo = client.submit(*paths, tenant="gold", priority=10)
+
+            def gold_wave(tag: str) -> float:
+                lat: list[float] = []
+                for _ in range(n_gold):
+                    t = time.perf_counter()
+                    r = client.submit(*paths, tenant="gold",
+                                      priority=10, retries=8)
+                    lat.append(time.perf_counter() - t)
+                    if r.fasta != solo.fasta:
+                        fail.append(f"{tag}: gold FASTA diverged from "
+                                    "the direct submit bytes")
+                return nearest_rank(sorted(lat), 0.99)
+
+            def flood_phase(tag: str, preempt: bool) -> tuple[float,
+                                                              int]:
+                for srv in servers:
+                    srv.config.preempt = preempt
+                stop = threading.Event()
+                flood_done = [0] * n_flood
+                flood_bad: list[str] = []
+
+                def flood(slot: int):
+                    mine = PolishClient(
+                        socket_path=router.config.socket_path)
+                    while not stop.is_set():
+                        try:
+                            r = mine.submit(*paths, tenant="free",
+                                            priority=0, retries=8)
+                        except Exception as exc:  # noqa: BLE001
+                            flood_bad.append(
+                                f"{type(exc).__name__}: {exc}")
+                            return
+                        if r.fasta != solo.fasta:
+                            flood_bad.append("free FASTA diverged")
+                            return
+                        flood_done[slot] += 1
+
+                threads = [threading.Thread(target=flood, args=(i,))
+                           for i in range(n_flood)]
+                for t in threads:
+                    t.start()
+                time.sleep(1.0)  # the flood owns the fabric first
+                p99 = gold_wave(tag)
+                stop.set()
+                for t in threads:
+                    t.join(timeout=180)
+                for srv in servers:
+                    srv.config.preempt = False
+                if flood_bad:
+                    fail.append(f"{tag}: flood submitter died "
+                                f"({flood_bad[0]})")
+                print(f"[servebench] {tag}: gold p99 {p99:.2f}s "
+                      f"({sum(flood_done)} free jobs completed "
+                      "under the wave)", file=sys.stderr)
+                return p99, sum(flood_done)
+
+            p99_idle = gold_wave("flood idle-baseline")
+            print(f"[servebench] flood idle-baseline: gold p99 "
+                  f"{p99_idle:.2f}s", file=sys.stderr)
+            p99_nopre, _ = flood_phase("flood preempt-off", False)
+            pre0 = sum(s.qos["preemptions"] for s in servers)
+            p99_pre, free_done = flood_phase("flood preempt-on", True)
+            preemptions = sum(s.qos["preemptions"]
+                              for s in servers) - pre0
+            if preemptions < 1:
+                fail.append("preempt-on flood phase fired zero "
+                            "preemptions — gold never displaced free")
+
+            # doomed-abort phase: arm admission-time speculative abort
+            # on every replica (margin 0) and submit free jobs whose
+            # deadline the populated EMA says is unmeetable — the
+            # typed reject must arrive BEFORE any device dispatch
+            for srv in servers:
+                srv.queue.abort_margin = 0.0
+            doomed_n, doomed_saved = 0, 0.0
+            try:
+                for _ in range(n_gold):
+                    try:
+                        client.submit(*paths, tenant="free",
+                                      deadline_s=0.05)
+                        fail.append("unmeetable-deadline job was NOT "
+                                    "aborted doomed (it ran to "
+                                    "completion)")
+                    except DeadlineDoomed as exc:
+                        doomed_n += 1
+                        doomed_saved += max(exc.predicted_s, 0.0)
+            finally:
+                for srv in servers:
+                    srv.queue.abort_margin = None
+            aborted = sum(s.qos["aborted_doomed"] for s in servers)
+            print(f"[servebench] doomed-abort: {doomed_n}/{n_gold} "
+                  f"unmeetable jobs aborted at admission, "
+                  f"~{doomed_saved:.2f} predicted device-seconds "
+                  f"saved ({aborted} replica-side aborts)",
+                  file=sys.stderr)
+        finally:
+            if router is not None:
+                router.drain(timeout=30)
+            for srv in servers:
+                srv.drain(timeout=30)
+
+    flat = round(p99_pre / max(p99_idle, 1e-9), 3)
+    nopre_x = round(p99_nopre / max(p99_idle, 1e-9), 3)
+    qos_block = {
+        "replicas": 2,
+        "flood_submitters": n_flood,
+        "gold_jobs": n_gold,
+        "free_jobs_completed": free_done,
+        "gold_p99_idle_s": round(p99_idle, 3),
+        "gold_p99_flood_nopreempt_s": round(p99_nopre, 3),
+        "gold_p99_flood_preempt_s": round(p99_pre, 3),
+        "gold_p99_flat": flat,
+        "gold_p99_nopreempt_x": nopre_x,
+        "preemptions": preemptions,
+        "doomed_submitted": n_gold,
+        "doomed_aborted": doomed_n,
+        "doomed_abort_saved_s": round(doomed_saved, 3),
+    }
+    print(f"[servebench] gold p99: idle {p99_idle:.2f}s, flood "
+          f"no-preempt {p99_nopre:.2f}s (x{nopre_x:.2f}), flood "
+          f"preempt {p99_pre:.2f}s (x{flat:.2f} — "
+          "perfgate gates qos.gold_p99_flat)", file=sys.stderr)
+    if args.json:
+        artifact = {"mode": "flood", "jobs": n_gold,
+                    "qos": qos_block, "pass": not fail}
+        with open(args.json, "w") as fh:
+            json.dump(artifact, fh, indent=2, sort_keys=True)
+        print(f"[servebench] wrote {args.json}", file=sys.stderr)
+    if fail:
+        for f in fail:
+            print(f"[servebench] FAIL: {f}", file=sys.stderr)
+        return 1
+    print("[servebench] PASS", file=sys.stderr)
+    return 0
+
+
 def run_openloop(client, paths, qps: float, n_jobs: int,
                  seed: int) -> dict:
     """One open-loop wave: Poisson arrivals at `qps`, every job
@@ -801,6 +1014,16 @@ def main(argv=None) -> int:
                          "`rounds` / `cache` blocks that "
                          "tools/perfgate.py gates via cache.identical "
                          "and --round2-speedup-min")
+    ap.add_argument("--flood", type=int, default=None,
+                    help="flood bench mode: this many free-tenant "
+                         "submitter threads flood a 2-replica routed "
+                         "fabric while gold-priority waves measure "
+                         "p99 isolation (idle, flood preempt-off, "
+                         "flood preempt-on), plus a doomed-abort "
+                         "phase — the artifact gains a `qos` block "
+                         "(gold_p99_flat, doomed_abort_saved_s) that "
+                         "tools/perfgate.py gates via qos.gold_p99_flat "
+                         "and --doomed-abort-min")
     ap.add_argument("--fleet-poll-s", type=float, default=0.25,
                     help="fleet mode: aggregator poll interval during "
                          "the wave (default 0.25s)")
@@ -857,6 +1080,9 @@ def main(argv=None) -> int:
 
     if args.rounds is not None:
         return run_rounds_bench(args, PolishClient, PolishServer)
+
+    if args.flood is not None:
+        return run_flood_bench(args, PolishClient, PolishServer)
 
     cold_n = args.cold_runs if args.cold_runs is not None \
         else min(args.jobs, 3)

@@ -34,7 +34,11 @@ scrapes through obs/fleet.py, and redraws one screen per poll:
     entries — the dispatch-skip economics at a glance;
   - a ROUNDS suffix on the fleet line (rendered only once some replica
     ran a rounds=N job): iterative-rounds jobs in flight right now
-    plus the lifetime completed-rounds/jobs counters.
+    plus the lifetime completed-rounds/jobs counters;
+  - a QOS suffix on the fleet line (rendered only once some replica
+    arms preemption / abort margin / burst tokens or fires a QoS
+    event): lifetime preemptions / doomed-aborts / cancels, with
+    [PREEMPT n] while n jobs are parked by preemption right now.
 
 On a TTY the screen redraws in place; on a pipe it degrades to one
 summary line per poll (greppable, CI-friendly). `--once` polls once
@@ -183,7 +187,7 @@ def fleet_line(snap, burn: dict, prev: dict, dt: float) -> str:
             f"  iters {int(iters)} ({rate:.1f}/s)"
             f"  compiles {int(snap.counters.get(G + 'compiles_total', 0))}"
             + _fleet_audit(snap) + _fleet_rounds(snap)
-            + _fleet_router(snap))
+            + _fleet_preempt(snap) + _fleet_router(snap))
 
 
 def _fleet_audit(snap) -> str:
@@ -212,6 +216,24 @@ def _fleet_rounds(snap) -> str:
     done = int(snap.counters.get(
         "racon_tpu_serve_rounds_completed_total", 0))
     return f"  rounds {inflight} infl ({done}r/{jobs}j)"
+
+
+def _fleet_preempt(snap) -> str:
+    """QoS suffix (empty until some replica arms preemption / abort
+    margin / burst tokens or fires a QoS event — the families are
+    armed-only): lifetime preemptions, doomed-aborts and cancels, plus
+    [PREEMPT] while any job is parked right now."""
+    if "racon_tpu_serve_preemptions_total" not in snap.counters:
+        return ""
+    pre = int(snap.counters.get("racon_tpu_serve_preemptions_total", 0))
+    doomed = int(snap.counters.get(
+        "racon_tpu_serve_aborted_doomed_total", 0))
+    cancelled = int(snap.counters.get(
+        "racon_tpu_serve_cancelled_total", 0))
+    parked = int(snap.gauges.get("racon_tpu_serve_preempted_inflight",
+                                 0))
+    return (f"  qos {pre}p/{doomed}d/{cancelled}c"
+            + (f"  [PREEMPT {parked}]" if parked else ""))
 
 
 def _fleet_router(snap) -> str:
