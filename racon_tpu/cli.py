@@ -51,8 +51,13 @@ usage: racon_tpu [options ...] <sequences> <overlaps> <target sequences>
                 journal ledger requeues a dead replica's shards onto
                 healthy ones with streamed contigs deduped (each
                 contig exactly once), and rolling restarts — drain,
-                restart, rejoin on clean healthz — lose no jobs
-                (README "Serving"; RACON_TPU_ROUTER_* env knobs)
+                restart, rejoin on clean healthz — lose no jobs; when
+                replicas outnumber contigs, contigs split further by
+                window-range so a one-contig job scales past a single
+                replica, and --autoscale arms the elastic-fleet loop
+                that spawns/drains replicas with backlog pressure
+                (README "Serving"; RACON_TPU_ROUTER_* env knobs,
+                RACON_TPU_ROUTER_AUTOSCALE_* for the loop)
         fleet   federate N replicas' metrics and health into one view:
                 polls every endpoint in --endpoints /
                 RACON_TPU_FLEET_ENDPOINTS, merges counters and latency

@@ -163,6 +163,23 @@ class Polisher:
         self.sequences: list[Sequence] = []
         self.windows: list[Window] = []
         self.targets_coverages: list[int] = []
+        # window-range shard slice (serve/router.py sub-contig sharding):
+        # (lo, hi) target coordinates — initialize() keeps only windows
+        # whose grid start j satisfies lo <= j < hi (boundary windows
+        # owned by exactly one shard since starts are exact), and
+        # _stitch_contig emits bare-named SEGMENTS with their stitch
+        # accounting in `segment_meta` instead of tagged contigs. None
+        # (the default) is the classic whole-target run, byte-identical
+        # to the pre-range code path.
+        self.window_range: tuple[int, int] | None = None
+        #: per-contig segment accounting for range-shard runs —
+        #: {name: {polished, windows, total_windows, coverage, lo, hi}};
+        #: the router re-derives the solo LN/RC/XC tags from these when
+        #: it stitches sibling segments back together
+        self.segment_meta: dict[str, dict] = {}
+        #: per-target rank of the first KEPT window (all zeros outside
+        #: range mode) — the layer loop's window-id remap offset
+        self._range_first_rank: list[int] = []
         self.dummy_quality = b"!" * window_length
         self.logger = Logger()
         # live progress hook (serve mode: the server forwards these as
@@ -324,6 +341,8 @@ class Polisher:
         self.n_aligner_host_fallback = 0
         self.logger = Logger()
         self.targets_coverages = []
+        self.segment_meta = {}
+        self._range_first_rank = []
         self._num_targets = 0
         self._progress_phase = None
         self._progress_hwm = ("", 0, 0)
@@ -493,19 +512,30 @@ class Polisher:
 
         log.log()
 
-        # -- windows (polisher.cpp:384-399)
+        # -- windows (polisher.cpp:384-399); in range mode only the grid
+        #    positions with lo <= start < hi materialize, but `rank`
+        #    stays the GLOBAL grid rank so per-window identity (and
+        #    output) is independent of which slice holds the window
+        rng = self.window_range
         id_to_first_window_id = [0] * (targets_size + 1)
+        self._range_first_rank = [0] * targets_size
         for i in range(targets_size):
             data = self.sequences[i].data
             quality = self.sequences[i].quality
             k = 0
+            kept = 0
             for j in range(0, len(data), self.window_length):
-                length = min(j + self.window_length, len(data)) - j
-                q = quality[j:j + length] if quality else self.dummy_quality[:length]
-                self.windows.append(create_window(
-                    i, k, window_type, data[j:j + length], q))
+                if rng is None or rng[0] <= j < rng[1]:
+                    length = min(j + self.window_length, len(data)) - j
+                    q = quality[j:j + length] if quality \
+                        else self.dummy_quality[:length]
+                    self.windows.append(create_window(
+                        i, k, window_type, data[j:j + length], q))
+                    if kept == 0:
+                        self._range_first_rank[i] = k
+                    kept += 1
                 k += 1
-            id_to_first_window_id[i + 1] = id_to_first_window_id[i] + k
+            id_to_first_window_id[i + 1] = id_to_first_window_id[i] + kept
 
         self.targets_coverages = [0] * targets_size
 
@@ -535,8 +565,13 @@ class Polisher:
                     avg = float(qual_arr[q_first:q_last1].mean()) - 33.0
                     if avg < self.quality_threshold:
                         continue
-                window_id = id_to_first_window_id[o.t_id] + t_first // wl
                 window_start = (t_first // wl) * wl
+                if rng is not None and \
+                        not rng[0] <= window_start < rng[1]:
+                    continue
+                window_id = (id_to_first_window_id[o.t_id]
+                             + t_first // wl
+                             - self._range_first_rank[o.t_id])
                 data = data_src[q_first:q_last1]
                 qual = (qual_src[q_first:q_last1] if qual_src else None)
                 self.windows[window_id].add_layer(
@@ -631,7 +666,8 @@ class Polisher:
         """
         from ..native import nw_cigar_batch
 
-        need = [o for o in overlaps if not o.cigar and o.is_valid]
+        need = [o for o in overlaps
+                if not o.cigar and o.is_valid and self._range_keeps(o)]
         if need:
             pairs = []
             for o in need:
@@ -744,10 +780,36 @@ class Polisher:
                          "aligned on host (device capacity fallback)")
 
         for o in overlaps:
-            if o.is_valid and o.cigar:
+            if o.is_valid and o.cigar and self._range_keeps(o):
                 o.find_breaking_points(self.sequences, self.window_length)
 
         self.logger.log("[racon_tpu::Polisher.initialize] aligned overlaps")
+
+    def _range_keeps(self, o) -> bool:
+        """Whether an overlap can contribute layers to this run's kept
+        window slice (always True outside range mode — the classic path
+        pays one attribute check). Coverage (RC) is counted for EVERY
+        overlap regardless: the layer loop increments it before
+        consulting breaking points, so skipping the aligner and the
+        breaking-point walk here is pure saved work, never a semantic
+        change — this is where range sharding's per-shard speedup
+        comes from."""
+        rng = self.window_range
+        if rng is None:
+            return True
+        wl = self.window_length
+        length = len(self.sequences[o.t_id].data)
+        lo, hi = rng
+        # the kept windows' covered coordinate region: window starts are
+        # exact multiples of wl, so membership never depends on the
+        # split points being wl-aligned
+        first_start = -(-max(lo, 0) // wl) * wl
+        cap = min(hi, length)
+        if first_start >= cap:
+            return False
+        last_start = ((cap - 1) // wl) * wl
+        region_hi = min(length, last_start + wl)
+        return o.t_begin < region_hi and o.t_end > first_start
 
     # ---------------------------------------------------------------- polish
     def polish(self, drop_unpolished_sequences: bool = True,
@@ -881,13 +943,16 @@ class Polisher:
 
     def _contig_slices(self) -> list[tuple[int, int]]:
         """[start, end) window-index ranges, one per target contig, in
-        target order — a contig boundary is the next window's rank 0.
-        The unit the incremental stitcher completes on."""
+        target order — a contig boundary is the next window belonging
+        to a different target id (equivalent to the historical rank-0
+        test on whole-target runs; range-shard slices start at a
+        nonzero rank, where only the id transition is right). The unit
+        the incremental stitcher completes on."""
         slices: list[tuple[int, int]] = []
         start = 0
         for i in range(len(self.windows)):
             if (i == len(self.windows) - 1
-                    or self.windows[i + 1].rank == 0):
+                    or self.windows[i + 1].id != self.windows[i].id):
                 slices.append((start, i + 1))
                 start = i + 1
         return slices
@@ -904,6 +969,23 @@ class Polisher:
             num_polished_windows += 1 if window.polished else 0
             polished_data += window.consensus
         last = windows[-1]
+        if self.window_range is not None:
+            # range-shard segment: bare name, never dropped — the
+            # router stitches sibling segments back together and
+            # re-derives the solo LN/RC/XC tags (and the drop rule)
+            # from the accounting recorded here
+            name = self.sequences[last.id].name
+            data_len = len(self.sequences[last.id].data)
+            wl = self.window_length
+            self.segment_meta[name] = {
+                "polished": num_polished_windows,
+                "windows": len(windows),
+                "total_windows": (data_len + wl - 1) // wl,
+                "coverage": self.targets_coverages[last.id],
+                "lo": self.window_range[0],
+                "hi": self.window_range[1],
+            }
+            return create_sequence(name, bytes(polished_data))
         ratio = num_polished_windows / float(last.rank + 1)
         if drop_unpolished_sequences and ratio <= 0:
             return None

@@ -333,10 +333,26 @@ class FleetAggregator:
         self._stop = threading.Event()
 
     # ------------------------------------------------------------ polling
+    # ------------------------------------------------ endpoint mutation
+    def add_endpoint(self, spec: str) -> None:
+        """Join one endpoint to the polled set mid-flight (the router
+        autoscaler's scale-up seam). The list is REPLACED, not mutated:
+        poll() snapshots it once per pass, so a concurrent poll sees
+        either the old or the new set, never a half-edit."""
+        with self._lock:
+            if any(ep.spec == spec for ep in self.endpoints):
+                return
+            self.endpoints = self.endpoints + [Endpoint(spec)]
+
+    def remove_endpoint(self, spec: str) -> None:
+        with self._lock:
+            self.endpoints = [ep for ep in self.endpoints
+                              if ep.spec != spec]
+
     def poll(self) -> FleetSnapshot:
         snap = FleetSnapshot()
         t0 = time.perf_counter()
-        for ep in self.endpoints:
+        for ep in list(self.endpoints):
             rs = ReplicaSample(ep.spec)
             t1 = time.perf_counter()
             try:

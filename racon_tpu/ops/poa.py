@@ -96,8 +96,28 @@ class BatchPOA:
         self._device_engine = None
         self._session_net = None
 
-    #: windows per host batch call (bounds peak packed-buffer memory)
+    #: windows per host batch call (bounds peak packed-buffer memory);
+    #: RACON_TPU_HOST_POA_CHUNK overrides it — chunk granularity never
+    #: changes output (windows are independent), only pipeline batching,
+    #: so the fleet benches shrink it to pace per-chunk device latency
+    #: proportionally to a job's window count
     HOST_CHUNK = 4096
+
+    def _host_chunk(self) -> int:
+        raw = os.environ.get("RACON_TPU_HOST_POA_CHUNK", "")
+        if not raw:
+            return self.HOST_CHUNK
+        try:
+            n = int(raw)
+        except ValueError:
+            n = 0
+        if n <= 0:
+            from ..errors import RaconError
+            raise RaconError(
+                "BatchPOA",
+                f"invalid RACON_TPU_HOST_POA_CHUNK {raw!r} (expected a "
+                "positive integer)!")
+        return n
 
     def generate_consensus(self, windows, trim: bool) -> None:
         """Fill `window.consensus` / `window.polished` for every window.
@@ -169,8 +189,9 @@ class BatchPOA:
 
         pl = (self.pipeline if self.pipeline is not None
               else DispatchPipeline(depth=0))
-        chunks = [host[s:s + self.HOST_CHUNK]
-                  for s in range(0, len(host), self.HOST_CHUNK)]
+        host_chunk = self._host_chunk()
+        chunks = [host[s:s + host_chunk]
+                  for s in range(0, len(host), host_chunk)]
 
         def pack(chunk):
             return [_pack(w) for w in chunk]

@@ -31,12 +31,31 @@ def mesh_info(worker_lanes: int = 1) -> dict:
                 "RACON_TPU_MAX_DEVICES") or None}
 
 
-def partition_devices(devices, k: int) -> list[list]:
+def partition_devices(devices=None, k: int = 1) -> list[list]:
     """Split a device list into `k` contiguous, near-equal sub-lists —
     the serve layer's worker-lane partition (each lane becomes an
     independent sub-mesh with its own BatchRunner). `k` clamps to the
     device count (a lane with zero devices schedules nothing) and the
-    first len(devices) % k lanes carry the extra device."""
+    first len(devices) % k lanes carry the extra device.
+
+    `devices` may be an explicit list — in particular the GLOBAL
+    device list of a `jax.distributed` run, the prep seam for the
+    multi-host mesh (ROADMAP item 1): carving lanes from the global
+    list instead of the process-local set is what lets one job's
+    worker lanes span hosts. `devices=None` auto-discovers via
+    `jax.devices()` (which IS the global list once jax.distributed is
+    initialized, ordered by process index — so contiguous lanes stay
+    host-contiguous), honoring the same RACON_TPU_MAX_DEVICES cap as
+    `BatchRunner`."""
+    if devices is None:
+        import os
+
+        import jax
+
+        devices = jax.devices()
+        cap = int(os.environ.get("RACON_TPU_MAX_DEVICES", "0") or 0)
+        if cap > 0:
+            devices = devices[:cap]
     devices = list(devices)
     k = max(1, min(int(k), len(devices)))
     base, extra = divmod(len(devices), k)

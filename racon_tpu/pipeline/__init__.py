@@ -49,20 +49,59 @@ deadline only (re-waiting on a hung handle would just burn a second
 deadline), and fallback jobs get the same bounded retry. Both default
 from the environment and stay None when unconfigured, so the clean path
 pays a single `is None` check per stage.
+
+RACON_TPU_DEVICE_LATENCY_S / RACON_TPU_DEVICE_LATENCY_X (default
+unset) sleep a simulated accelerator round-trip per chunk — a fixed
+floor after the result wait, or a multiplier on the chunk's measured
+dispatch time. The CPU dev posture's device stage is pure host compute;
+these reproduce the device-dominated regime (off-CPU waits that
+overlap across replicas) the serve fleet benches measure their scaling
+against.
 """
 
 from __future__ import annotations
 
 import itertools
+import os
 import queue
 import threading
 import time
 from concurrent.futures import Future, ThreadPoolExecutor
 
+from ..errors import RaconError
 from ..obs import trace
 from ..resilience import Watchdog, get_fault_plan
 
 _STOP = object()
+
+
+def _env_device_latency(name: str) -> float:
+    """Simulated-device pacing knobs, both slept OFF-CPU per chunk (the
+    CPU dev posture has no real accelerator, so its device stage is pure
+    host compute; these reproduce the device-DOMINATED regime — waits a
+    caller can overlap across replicas — the serve fleet benches scale
+    against):
+
+      RACON_TPU_DEVICE_LATENCY_S  fixed seconds added after each
+                                  chunk's result wait (round-trip floor)
+      RACON_TPU_DEVICE_LATENCY_X  multiplier on each chunk's measured
+                                  dispatch time (a device whose
+                                  round-trip scales with batch size)
+
+    Unset/0 is the default and costs one comparison per run."""
+    raw = os.environ.get(name, "")
+    if not raw:
+        return 0.0
+    try:
+        lat = float(raw)
+    except ValueError:
+        raise RaconError(
+            "pipeline.DispatchPipeline",
+            f"invalid {name} {raw!r} (expected a float)!") from None
+    if lat < 0:
+        raise RaconError(
+            "pipeline.DispatchPipeline", f"{name} must be >= 0!")
+    return lat
 
 #: PipelineStats keys whose bumps are semantic events, mirrored as trace
 #: instant events when the tracer is armed — the counter and the trace
@@ -157,6 +196,10 @@ class DispatchPipeline:
         self.faults = (None if faults is False
                        else faults if faults is not None
                        else get_fault_plan())
+        self.device_latency_s = _env_device_latency(
+            "RACON_TPU_DEVICE_LATENCY_S")
+        self.device_latency_x = _env_device_latency(
+            "RACON_TPU_DEVICE_LATENCY_X")
         self._fb_counter = itertools.count()
         self._executor: ThreadPoolExecutor | None = None
         self._futures: list[Future] = []
@@ -169,6 +212,24 @@ class DispatchPipeline:
         (engine, bucket, job count). Both are ignored — zero cost — when
         tracing is off."""
         items = list(items)
+        if self.device_latency_x > 0.0:
+            # wrapped before instrumentation so the stall counts as
+            # device time under the watchdog deadline, exactly as a
+            # real accelerator round-trip would
+            inner_dispatch, x = dispatch, self.device_latency_x
+
+            def dispatch(item, ops, _d=inner_dispatch, _x=x):
+                t0 = time.perf_counter()
+                handle = _d(item, ops)
+                time.sleep((time.perf_counter() - t0) * _x)
+                return handle
+        if self.device_latency_s > 0.0:
+            inner_wait, lat = wait, self.device_latency_s
+
+            def wait(handle, _wait=inner_wait, _lat=lat):
+                res = _wait(handle)
+                time.sleep(_lat)
+                return res
         if self.faults is not None or self.watchdog is not None:
             pack, dispatch, wait, unpack = self._instrument(
                 pack, dispatch, wait, unpack)

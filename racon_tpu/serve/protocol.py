@@ -100,6 +100,24 @@ top-level submit keys are ignored by contract). A router's
 ``result_part`` frames add a ``shard`` field and renumber ``part``
 globally in contig order; its final ``result`` adds a ``router`` block
 (``shards`` / ``requeues`` / ``parts`` / ``wall_s``).
+
+Window-range child jobs (sub-contig sharding): when routable replicas
+outnumber contigs, the router also splits single contigs by target
+coordinate at window-grid boundaries. Such a child ``submit`` adds
+``range_lo`` / ``range_hi`` (integers, ``0 <= lo < hi``): the replica
+polishes only windows whose grid start ``j`` (multiples of
+``window_length``) satisfies ``lo <= j < hi``, and streams the contig
+*segment*. Range-child ``result_part`` frames differ from whole-contig
+parts: ``fasta`` is the raw polished segment (latin-1 bytes, **no**
+``>name`` header, no trailing newline — the concatenation-is-the-body
+rule does not apply) plus a ``seg`` stats dict ``{"polished",
+"windows", "total_windows", "coverage", "lo", "hi"}`` from which the
+router reassembles the full contig in coordinate order and re-derives
+the solo-identical header tags (LN/RC/XC). ``range_lo``/``range_hi``
+cannot be combined with ``rounds`` (typed ``bad-request``). Because a
+pre-range replica would silently ignore the keys and return the FULL
+contig, the router treats a range part arriving without ``seg`` as a
+typed ``replica-incompatible`` failure rather than merging garbage.
 """
 
 from __future__ import annotations

@@ -188,7 +188,7 @@ class Job:
                  "want_trace", "enqueued_t", "started_t", "response",
                  "event", "stats_ref", "trace_id", "want_progress",
                  "want_stream", "tenant", "rounds", "cancelled",
-                 "_outbox")
+                 "range_lo", "range_hi", "_outbox")
 
     def __init__(self, id_: str, sequences: str, overlaps: str,
                  target: str, options: dict, priority: int = 0,
@@ -198,7 +198,9 @@ class Job:
                  trace_id: str | None = None,
                  want_progress: bool = False,
                  want_stream: bool = False, tenant: str = "",
-                 rounds: int | None = None):
+                 rounds: int | None = None,
+                 range_lo: int | None = None,
+                 range_hi: int | None = None):
         self.id = id_
         self.sequences = sequences
         self.overlaps = overlaps
@@ -226,6 +228,14 @@ class Job:
         #: `_run_job`, core/polisher.redraft). The response carries a
         #: `rounds` accounting block only when the request asked.
         self.rounds = rounds if rounds is None else max(1, int(rounds))
+        #: sub-contig window-range shard slice (router fan-out,
+        #: serve/protocol.py "Child-job fields"): the worker polishes
+        #: only the target windows whose grid start falls in
+        #: [range_lo, range_hi) and streams bare-named SEGMENTS; None =
+        #: classic whole-target job. Mutually exclusive with `rounds`
+        #: (enforced at submit validation).
+        self.range_lo = range_lo
+        self.range_hi = range_hi
         #: cancel-RPC flag for RUNNING jobs the batcher cannot reach
         #: (isolation/solo paths never pool): the worker checks it at
         #: round boundaries and fails the job typed `cancelled`

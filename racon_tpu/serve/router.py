@@ -18,6 +18,20 @@ pieces — it speaks the SAME submit frame as a replica (protocol.py), so
     (``parent`` / ``shard`` / ``shards`` submit keys, child trace id
     ``<parent>.s<k>``), always with ``stream: true`` so finished
     contigs flow back the moment they land.
+  - **Window-range sharding.** When routable replicas EXCEED the
+    contig count (the one-mega-contig case the wrapper's file-level
+    scatter could never scale), the largest contigs split further by
+    target-coordinate range at window-grid boundaries — the grid is
+    deterministic from ``window_length``, so split points are exact
+    and every window is owned by exactly one shard. Each range child
+    carries ``range_lo``/``range_hi`` (protocol.py "Child-job
+    fields"), polishes only that window slice, and streams raw contig
+    SEGMENTS with stitch accounting; the merge ledger buffers a
+    contig's segments until all its shards are done, then re-derives
+    the solo LN/RC/XC tags — byte-identical to the unsharded run,
+    with requeue-after-kill deduping at segment granularity. Rounds
+    requests fall back to contig sharding (a re-draft round over a
+    segment is not the solo computation).
   - **Contig-order merge.** Replies merge via `ContigStreamer`
     semantics at shard granularity: shard k's parts are forwarded (or
     buffered, for a non-streaming client) only once shards 0..k-1 have
@@ -61,6 +75,15 @@ RACON_TPU_ROUTER_RETRIES (replica losses tolerated per shard, default
 3), RACON_TPU_ROUTER_WAIT_S (how long a shard waits for any routable
 replica before the job fails, default 60).
 
+Elastic autoscaling (serve/autoscale.py, ``racon_tpu router
+--autoscale``): an `Autoscaler` loop drives `add_replica` /
+`remove_replica` from the fleet poll's burn-rate / queue-depth /
+admission-EMA signals — warm replica subprocesses spawn on sustained
+pressure and drain on idle (SIGTERM -> graceful drain; a kill mid-job
+is the same journal-backed requeue as any replica loss, so scale-down
+loses zero jobs). Knobs: RACON_TPU_ROUTER_AUTOSCALE_* (strict-parsed;
+see autoscale.py). README "Elastic fleet" is the runbook.
+
 CLI: ``racon_tpu router --replicas /tmp/a.sock,/tmp/b.sock`` (cli.py);
 benchmarks: ``tools/servebench.py --router N``; failure matrix:
 ``tools/faultcheck.py`` router column. See README "Serving" for the
@@ -98,7 +121,9 @@ DEFAULT_ROUTER_SOCKET = "/tmp/racon_tpu_router.sock"
 ROUTER_EVENTS = frozenset((
     "router-start", "router-stop", "shard-dispatched", "shard-finished",
     "part-routed", "requeued", "replica-down", "replica-up",
-    "cancelled", "siblings-cancelled"))
+    "cancelled", "siblings-cancelled", "range-plan",
+    "replica-added", "replica-removed", "autoscale-up",
+    "autoscale-down"))
 
 #: trace-id charset (mirrors PolishServer._TRACE_ID_OK — "." is legal,
 #: which is what makes the `<parent>.s<k>` child ids valid replica-side)
@@ -250,12 +275,23 @@ class _JobMerge:
     shards 0..k-1 fully shipped — ContigStreamer semantics one level
     up), and dedupes a requeued shard's re-streamed parts by position
     (`arrived` counts the CURRENT attempt; anything below the buffered
-    length is a byte-identical duplicate and is skipped)."""
+    length is a byte-identical duplicate and is skipped).
 
-    def __init__(self, n_shards: int, emit_part=None, on_routed=None):
+    Range mode (`groups` set — sub-contig window-range sharding): each
+    shard is one (contig, [lo, hi)) slice streaming ONE bare-named raw
+    segment with its stitch accounting (`seg`); a group = one contig's
+    shards in lo order. A group's segments buffer until EVERY member
+    shard is done, then assemble into ONE whole-contig part with the
+    solo LN/RC/XC tags re-derived from the summed accounting — so the
+    merged output is byte-identical to the unsharded run, and the
+    requeue dedupe above operates at segment granularity."""
+
+    def __init__(self, n_shards: int, emit_part=None, on_routed=None,
+                 groups: list[dict] | None = None,
+                 fragment_correction: bool = False,
+                 drop_unpolished: bool = True):
         self.lock = threading.Lock()
-        self.parts: list[list[tuple[str | None, str]]] = [
-            [] for _ in range(n_shards)]
+        self.parts: list[list[tuple]] = [[] for _ in range(n_shards)]
         self.arrived = [0] * n_shards
         self.done = [False] * n_shards
         self.results: list[dict | None] = [None] * n_shards
@@ -270,6 +306,16 @@ class _JobMerge:
         self._cursor_shard = 0
         self._cursor_part = 0
         self.total_routed = 0
+        #: range mode: [{"name": contig, "shards": [k...]} ...] in
+        #: contig order, member shards in lo order
+        self.groups = groups
+        self._fragment_correction = fragment_correction
+        self._drop_unpolished = drop_unpolished
+        self._group_cursor = 0
+        self._assembled: list[tuple[str, str]] = []
+        #: accepted range segments (post-dedupe) — the obsreport
+        #: receipt unit; classic mode leaves it 0
+        self.segments_routed = 0
 
     def on_part(self, k: int, frame: dict) -> None:
         with self.lock:
@@ -277,6 +323,28 @@ class _JobMerge:
             self.arrived[k] += 1
             if idx < len(self.parts[k]):
                 return  # requeued re-run duplicate: ledger dedupe
+            if self.groups is not None:
+                seg = frame.get("seg")
+                if not isinstance(seg, dict):
+                    # a pre-range replica ignored range_lo/range_hi and
+                    # polished the WHOLE contig — merging its bytes
+                    # would corrupt the output, so the job fails typed
+                    if self.failure is None:
+                        self.failure = _ShardFailure(
+                            "replica-incompatible",
+                            f"shard {k}: part arrived without range "
+                            "segment accounting (replica predates "
+                            "range sharding?)")
+                    return
+                self.parts[k].append(
+                    (frame.get("name"), frame.get("fasta", ""), seg))
+                self.segments_routed += 1
+                if self._on_routed is not None:
+                    self._on_routed(k, idx, frame.get("name"),
+                                    len(frame.get("fasta", "")),
+                                    lo=seg.get("lo"), hi=seg.get("hi"))
+                self._pump_locked()
+                return
             self.parts[k].append(
                 (frame.get("name"), frame.get("fasta", "")))
             self._pump_locked()
@@ -297,6 +365,9 @@ class _JobMerge:
                 self.failure = failure
 
     def _pump_locked(self) -> None:
+        if self.groups is not None:
+            self._pump_groups_locked()
+            return
         n = len(self.parts)
         while self._cursor_shard < n:
             k = self._cursor_shard
@@ -314,9 +385,65 @@ class _JobMerge:
             self._cursor_shard += 1
             self._cursor_part = 0
 
+    def _pump_groups_locked(self) -> None:
+        """Range mode forward: a contig ships the moment ALL its range
+        shards are done (every segment final) and every earlier contig
+        has shipped. `on_routed` is deliberately NOT fired here —
+        range mode journals per-SEGMENT receipts at arrival instead."""
+        if self.failure is not None:
+            # a rejected part (or any shard failure) may have left a
+            # hole: never assemble — the client gets the typed error
+            return
+        while self._group_cursor < len(self.groups):
+            g = self.groups[self._group_cursor]
+            if not all(self.done[k] for k in g["shards"]):
+                return
+            part = self._assemble_locked(g)
+            self._group_cursor += 1
+            if part is None:
+                continue  # dropped as fully unpolished (solo rule)
+            name, fasta = part
+            self._assembled.append((name, fasta))
+            part_index = self.total_routed
+            self.total_routed += 1
+            if self._emit_part is not None:
+                self._emit_part(g["shards"][0], part_index, name, fasta)
+
+    def _assemble_locked(self, g: dict) -> tuple[str, str] | None:
+        """Stitch one contig's segments (lo order) into the whole-contig
+        FASTA entry a solo run would emit: body = segment concat, LN =
+        body length, RC = coverage (every range child parses ALL
+        overlaps, so each reports the identical count), XC =
+        sum(polished) / total grid windows — the same integer inputs as
+        the solo ratio, hence the same float and the same ``:.6f``
+        rendering (core/polisher._stitch_contig)."""
+        segs = []
+        for k in g["shards"]:
+            for _name, fasta, seg in self.parts[k]:
+                segs.append((int(seg.get("lo", 0)), fasta, seg))
+        segs.sort(key=lambda s: s[0])
+        total = max((int(s.get("total_windows", 0))
+                     for _lo, _f, s in segs), default=0)
+        if not segs or not total:
+            return None
+        body = "".join(f for _lo, f, _s in segs)
+        polished = sum(int(s.get("polished", 0)) for _lo, _f, s in segs)
+        coverage = max(int(s.get("coverage", 0)) for _lo, _f, s in segs)
+        ratio = polished / float(total)
+        if self._drop_unpolished and ratio <= 0:
+            return None
+        tags = "r" if self._fragment_correction else ""
+        tags += f" LN:i:{len(body)}"
+        tags += f" RC:i:{coverage}"
+        tags += f" XC:f:{ratio:.6f}"
+        name = g["name"] + tags
+        return name, f">{name}\n{body}\n"
+
     def fasta(self) -> str:
         """The merged body (latin-1 text, as it rides the wire)."""
         with self.lock:
+            if self.groups is not None:
+                return "".join(f for _name, f in self._assembled)
             return "".join(fasta for shard in self.parts
                            for _name, fasta in shard)
 
@@ -349,12 +476,20 @@ class PolishRouter:
         self._active: dict[str, tuple] = {}
         self._inflight_jobs = 0
         self._requeued_outstanding = 0
+        #: shards currently holding in _run_shard for an idle replica
+        #: (autoscale hold); the autoscaler counts these as backlog
+        self._dispatch_waiting = 0
         self._draining = threading.Event()
         self._stopped = threading.Event()
         self._t_start = time.perf_counter()
         self.counters = {"jobs_submitted": 0, "jobs_completed": 0,
                          "jobs_failed": 0, "shards_dispatched": 0,
                          "parts_routed": 0, "requeues": 0}
+        #: attached Autoscaler (serve/autoscale.py) or None — healthz
+        #: and /metrics surface its state only when armed, so the
+        #: off-knob exposition stays byte-identical; while armed with
+        #: headroom, _run_shard also holds for idle capacity
+        self.autoscaler = None
 
     # ------------------------------------------------------------ lifecycle
     def start(self) -> "PolishRouter":
@@ -496,19 +631,70 @@ class PolishRouter:
         with self._state_lock:
             return sum(1 for r in self.replicas if r.routable)
 
-    def _pick_replica(self, exclude: set) -> ReplicaState | None:
+    # ---------------------------------------------------- elastic fleet
+    def add_replica(self, spec: str) -> bool:
+        """Join a replica to the live routing set (the autoscaler's
+        scale-up seam; also usable operationally). Idempotent; the
+        next health poll (or a submit) takes it from there."""
+        with self._state_lock:
+            if any(r.spec == spec for r in self.replicas):
+                return False
+            self.replicas.append(ReplicaState(spec))
+        self.fleet.add_endpoint(spec)
+        if self.journal is not None:
+            self.journal.record("replica-added", replica=spec)
+        log_info(f"[racon_tpu::router] replica {spec} added "
+                 f"({self._routable_count()} routable)")
+        return True
+
+    def remove_replica(self, spec: str) -> bool:
+        """Remove a replica from the routing set (scale-down, after
+        its drain). In-flight shards on it finish or requeue through
+        the normal loss path; nothing new routes there."""
+        with self._state_lock:
+            before = len(self.replicas)
+            self.replicas = [r for r in self.replicas
+                             if r.spec != spec]
+            removed = len(self.replicas) != before
+        if not removed:
+            return False
+        self.fleet.remove_endpoint(spec)
+        if self.journal is not None:
+            self.journal.record("replica-removed", replica=spec)
+        log_info(f"[racon_tpu::router] replica {spec} removed")
+        return True
+
+    def _pick_replica(self, exclude: set,
+                      max_inflight: int | None = None
+                      ) -> ReplicaState | None:
         """Least-inflight routable replica, preferring ones the shard
-        has not failed on yet; claims an inflight slot under the lock."""
+        has not failed on yet; claims an inflight slot under the lock.
+        With `max_inflight`, only replicas strictly below that load
+        qualify — the autoscale hold uses this to insist on an idle
+        replica while the fleet can still grow."""
         with self._state_lock:
             cands = [r for r in self.replicas
                      if r.routable and r.spec not in exclude]
             if not cands:
                 cands = [r for r in self.replicas if r.routable]
+            if max_inflight is not None:
+                cands = [r for r in cands if r.inflight < max_inflight]
             if not cands:
                 return None
             best = min(cands, key=lambda r: r.inflight)
             best.inflight += 1
             return best
+
+    def _scaleup_headroom(self) -> bool:
+        """True while an armed autoscaler could still add a replica —
+        the only condition under which a shard holds for idle capacity
+        instead of committing to a busy queue."""
+        asc = self.autoscaler
+        if asc is None:
+            return False
+        with self._state_lock:
+            total = len(self.replicas)
+        return total < asc.config.max_replicas
 
     def _release_replica(self, r: ReplicaState) -> None:
         with self._state_lock:
@@ -617,7 +803,9 @@ class PolishRouter:
                 "requeued_outstanding": outstanding,
                 "inflight": inflight,
                 "uptime_s": round(
-                    time.perf_counter() - self._t_start, 3)}
+                    time.perf_counter() - self._t_start, 3),
+                **({"autoscale": self.autoscaler.snapshot()}
+                   if self.autoscaler is not None else {})}
 
     def stats_snapshot(self) -> dict:
         with self._state_lock:
@@ -674,6 +862,19 @@ class PolishRouter:
                 "router.uptime_seconds": round(
                     time.perf_counter() - self._t_start, 3),
             }
+        if self.autoscaler is not None:
+            # armed-only families: exposition without --autoscale stays
+            # byte-identical (the serve-plane scrape discipline)
+            snap = self.autoscaler.snapshot()
+            counters["router.autoscale.scale_ups"] = (
+                snap["scale_ups"], "replicas spawned on pressure")
+            counters["router.autoscale.scale_downs"] = (
+                snap["scale_downs"], "replicas drained on idle")
+            gauges["router.autoscale.spawned"] = (
+                snap["spawned"], "autoscaler-owned replicas alive")
+            gauges["router.autoscale.pressure"] = (
+                snap["pressure"], "queued+inflight jobs per routable "
+                "replica at the last poll")
         return body + obs_prom.render(counters, gauges)
 
     def _start_metrics_http(self) -> None:
@@ -815,6 +1016,57 @@ class PolishRouter:
             paths.append(path)
         return paths
 
+    @staticmethod
+    def _write_contig_targets(contigs: list, workdir: str) -> list[str]:
+        """Range mode: one FULL-contig target file per contig, shared
+        by every range shard of that contig (the child polishes only
+        its window slice; ranks and per-window output stay those of
+        the whole contig)."""
+        fastq = any(getattr(c, "quality", b"") for c in contigs)
+        ext = "fastq" if fastq else "fasta"
+        paths = []
+        for ci, c in enumerate(contigs):
+            path = os.path.join(workdir, f"contig_{ci}.{ext}")
+            with open(path, "wb") as fh:
+                if fastq:
+                    qual = getattr(c, "quality", b"") \
+                        or b"!" * len(c.data)
+                    fh.write(b"@" + c.name.encode() + b"\n"
+                             + c.data + b"\n+\n" + qual + b"\n")
+                else:
+                    fh.write(b">" + c.name.encode() + b"\n"
+                             + c.data + b"\n")
+            paths.append(path)
+        return paths
+
+    @staticmethod
+    def _plan_ranges(contigs: list, cap: int,
+                     wl: int) -> list[tuple[int, int, int]]:
+        """Sub-contig shard plan: split contigs by target-coordinate
+        range at window-grid boundaries (the grid is deterministic from
+        `window_length`, so split points are exact and every window is
+        owned by exactly one shard). Each contig gets >= 1 shard; the
+        remaining budget goes greedily to the contig with the most
+        windows per shard, and a contig never splits into more shards
+        than it has windows. Returns [(contig_index, lo, hi), ...] in
+        contig order, lo ascending within a contig."""
+        W = [max(1, (len(c.data) + wl - 1) // wl) for c in contigs]
+        budget = min(cap, sum(W))
+        s = [1] * len(W)
+        for _ in range(max(0, budget - len(W))):
+            cands = [i for i in range(len(W)) if s[i] < W[i]]
+            if not cands:
+                break
+            i = max(cands, key=lambda i: W[i] / s[i])
+            s[i] += 1
+        plan: list[tuple[int, int, int]] = []
+        for ci, (w_c, s_c) in enumerate(zip(W, s)):
+            for j in range(s_c):
+                lo = (j * w_c // s_c) * wl
+                hi = ((j + 1) * w_c // s_c) * wl
+                plan.append((ci, lo, hi))
+        return plan
+
     def _submit(self, req: dict, conn: socket.socket,
                 send_lock: threading.Lock) -> dict:
         for key in ("sequences", "overlaps", "target"):
@@ -876,16 +1128,61 @@ class PolishRouter:
                     "bad-request", f"cannot parse target: {exc}",
                     job_id=job_id)
             n_routable = self._routable_count()
-            n_shards = max(1, min(n_routable, len(contigs)))
+            cap = n_routable
             if self.config.max_shards > 0:
-                n_shards = min(n_shards, self.config.max_shards)
-            if n_shards > 1:
+                cap = min(cap, self.config.max_shards)
+            # sub-contig window-range sharding: when routable replicas
+            # exceed the contig count, split the largest contigs by
+            # coordinate range at window-grid boundaries — the one-
+            # mega-contig job scales past a single replica. Rounds fall
+            # back to contig sharding (round 2 would re-map reads onto
+            # a segment, which is not what solo rounds compute).
+            groups: list[dict] | None = None
+            shard_ranges: list[tuple[int, int] | None]
+            if cap > len(contigs) and req.get("rounds") is None:
+                wl = 500
+                opts_in = req.get("options")
+                if isinstance(opts_in, dict):
+                    try:
+                        wl = max(1, int(opts_in.get(
+                            "window_length", 500)))
+                    except (TypeError, ValueError):
+                        wl = 500
+                plan = self._plan_ranges(contigs, cap, wl)
+                n_shards = len(plan)
                 workdir = tempfile.mkdtemp(
                     prefix=f"racon_tpu_router_{job_id}_")
-                shard_targets = self._write_shard_targets(
-                    contigs, n_shards, workdir)
+                contig_paths = self._write_contig_targets(
+                    contigs, workdir)
+                shard_targets = [contig_paths[ci] for ci, _, _ in plan]
+                shard_ranges = [(lo, hi) for _, lo, hi in plan]
+                groups = []
+                for k, (ci, _lo, _hi) in enumerate(plan):
+                    if not groups or groups[-1]["ci"] != ci:
+                        groups.append({"ci": ci,
+                                       "name": contigs[ci].name,
+                                       "shards": []})
+                    groups[-1]["shards"].append(k)
+                if self.journal is not None:
+                    self.journal.record(
+                        "range-plan", job=job_id, trace=trace_id,
+                        shards=n_shards, contigs=len(contigs),
+                        window_length=wl)
             else:
-                shard_targets = [req["target"]]
+                n_shards = max(1, min(n_routable, len(contigs)))
+                if self.config.max_shards > 0:
+                    n_shards = min(n_shards, self.config.max_shards)
+                shard_ranges = [None] * n_shards
+                if n_shards > 1:
+                    workdir = tempfile.mkdtemp(
+                        prefix=f"racon_tpu_router_{job_id}_")
+                    shard_targets = self._write_shard_targets(
+                        contigs, n_shards, workdir)
+                else:
+                    shard_targets = [req["target"]]
+            opts_in = req.get("options") or {}
+            if not isinstance(opts_in, dict):
+                opts_in = {}
             del contigs  # the shard files own the bytes now
             requeues_before = self.counters["requeues"]
             emit_part = None
@@ -902,17 +1199,25 @@ class PolishRouter:
                     except (ProtocolError, OSError):
                         pass  # client gone: shards still finish
 
-            def on_routed(k, part_index, name, nbytes):
+            def on_routed(k, part_index, name, nbytes, **extra):
                 with self._state_lock:
                     self.counters["parts_routed"] += 1
                 if self.journal is not None:
+                    # range mode adds lo/hi: one `part-routed` line per
+                    # accepted SEGMENT (post-dedupe), which is what
+                    # obsreport's segment-receipt check tiles per contig
                     self.journal.record("part-routed", job=job_id,
                                         trace=trace_id, shard=k,
                                         part=part_index, name=name,
-                                        bytes=nbytes)
+                                        bytes=nbytes, **extra)
 
-            merge = _JobMerge(n_shards, emit_part=emit_part,
-                              on_routed=on_routed)
+            merge = _JobMerge(
+                n_shards, emit_part=emit_part, on_routed=on_routed,
+                groups=groups,
+                fragment_correction=bool(
+                    opts_in.get("fragment_correction")),
+                drop_unpolished=not opts_in.get(
+                    "include_unpolished", False))
             with self._state_lock:
                 self._active[job_id] = (trace_id, merge)
             threads = []
@@ -921,7 +1226,7 @@ class PolishRouter:
                     target=self._run_shard,
                     args=(req, job_id, trace_id, k, n_shards,
                           shard_targets[k], merge, conn, send_lock,
-                          want_progress, deadline_t),
+                          want_progress, deadline_t, shard_ranges[k]),
                     name=f"racon-tpu-router-{job_id}-s{k}", daemon=True)
                 t.start()
                 threads.append(t)
@@ -980,6 +1285,10 @@ class PolishRouter:
                               "parts": merge.total_routed,
                               "wall_s": round(wall_s, 4),
                               "shard_exec_max_s": round(exec_max, 4)}}
+            if groups is not None:
+                out["router"]["range"] = True
+                out["router"]["range_shards"] = n_shards
+                out["router"]["segments"] = merge.segments_routed
             if trace_id:
                 out["trace_id"] = trace_id
             if metrics:
@@ -998,11 +1307,14 @@ class PolishRouter:
             else:
                 out["fasta"] = merge.fasta()
             if self.journal is not None:
-                self.journal.record("finished", job=job_id,
-                                    trace=trace_id, shards=n_shards,
-                                    parts=merge.total_routed,
-                                    requeues=job_requeues,
-                                    wall_s=round(wall_s, 4))
+                self.journal.record(
+                    "finished", job=job_id,
+                    trace=trace_id, shards=n_shards,
+                    parts=merge.total_routed,
+                    segments=(merge.segments_routed
+                              if groups is not None else None),
+                    requeues=job_requeues,
+                    wall_s=round(wall_s, 4))
             with self._state_lock:
                 self.counters["jobs_completed"] += 1
             return out
@@ -1017,7 +1329,8 @@ class PolishRouter:
                    k: int, n_shards: int, shard_target: str,
                    merge: _JobMerge, conn: socket.socket,
                    send_lock: threading.Lock, want_progress: bool,
-                   deadline_t: float | None = None) -> None:
+                   deadline_t: float | None = None,
+                   rng: tuple[int, int] | None = None) -> None:
         """One shard's dispatch loop: submit to the least-loaded
         routable replica, stream parts into the merge, and on replica
         loss requeue to a healthy one (journal-backed, dedupe by the
@@ -1038,6 +1351,13 @@ class PolishRouter:
                     "strict", "tenant", "rounds"):
             if req.get(key) is not None:
                 child[key] = req[key]
+        if rng is not None:
+            # window-range shard: the child polishes only the target
+            # windows whose grid start falls in [lo, hi) and streams
+            # raw segments with stitch accounting (protocol.py
+            # "Child-job fields"); never combined with rounds (range
+            # plans are only built for round-less submits)
+            child["range_lo"], child["range_hi"] = rng
         if want_progress:
             child["progress"] = True
 
@@ -1054,8 +1374,30 @@ class PolishRouter:
         requeued_pending = False
         exclude: set[str] = set()
         wait_deadline = time.monotonic() + self.config.replica_wait_s
+        # autoscale hold: while the fleet can still grow, insist on an
+        # idle replica for up to hold_s before settling for a busy one
+        # — the held shard counts as backlog (autoscale._signals), so
+        # holding is what summons the scale-up it waits for. A busy
+        # replica serializes device phases anyway, so the hold costs
+        # nothing when no capacity arrives: the first replica to go
+        # idle (old or new) is taken within one 0.1s poll.
+        asc = self.autoscaler
+        hold_deadline = (
+            time.monotonic() + asc.config.hold_s
+            if asc is not None and asc.config.hold_s > 0 else None)
+        waiting_flagged = False
+
+        def _set_waiting(on: bool):
+            nonlocal waiting_flagged
+            if on == waiting_flagged:
+                return
+            with self._state_lock:
+                self._dispatch_waiting = max(
+                    0, self._dispatch_waiting + (1 if on else -1))
+            waiting_flagged = on
 
         def settle():
+            _set_waiting(False)
             if requeued_pending:
                 with self._state_lock:
                     self._requeued_outstanding = max(
@@ -1081,10 +1423,16 @@ class PolishRouter:
                     return
                 # requeued shards inherit the REMAINING parent budget
                 child["deadline_s"] = round(remaining, 4)
-            replica = self._pick_replica(exclude)
+            hold = (hold_deadline is not None
+                    and time.monotonic() < hold_deadline
+                    and not self._draining.is_set()
+                    and self._scaleup_headroom())
+            replica = self._pick_replica(
+                exclude, max_inflight=1 if hold else None)
             if replica is None:
-                if time.monotonic() < wait_deadline \
-                        and not self._draining.is_set():
+                if hold or (time.monotonic() < wait_deadline
+                            and not self._draining.is_set()):
+                    _set_waiting(True)
                     time.sleep(0.1)
                     continue
                 merge.fail(_ShardFailure(
@@ -1093,6 +1441,7 @@ class PolishRouter:
                     f"{self.config.replica_wait_s:g}s"))
                 settle()
                 return
+            _set_waiting(False)
             with self._state_lock:
                 self.counters["shards_dispatched"] += 1
             if self.journal is not None:
@@ -1233,6 +1582,19 @@ def router_main(argv: list[str]) -> int:
                     help="replica losses tolerated per shard before "
                          "the job fails (RACON_TPU_ROUTER_RETRIES, "
                          "default 3)")
+    ap.add_argument("--autoscale", action="store_true",
+                    help="arm the elastic-fleet loop: spawn warm "
+                         "replicas on sustained backlog pressure or a "
+                         "firing deadline burn-rate alert, drain the "
+                         "newest spawned replica after sustained idle "
+                         "(RACON_TPU_ROUTER_AUTOSCALE_* knobs, README "
+                         "'Elastic fleet')")
+    ap.add_argument("--autoscale-min", type=int, default=None,
+                    help="autoscaler fleet floor "
+                         "(RACON_TPU_ROUTER_AUTOSCALE_MIN, default 1)")
+    ap.add_argument("--autoscale-max", type=int, default=None,
+                    help="autoscaler fleet ceiling "
+                         "(RACON_TPU_ROUTER_AUTOSCALE_MAX, default 4)")
     args = ap.parse_args(argv)
 
     kw: dict = {}
@@ -1259,6 +1621,22 @@ def router_main(argv: list[str]) -> int:
         print(f"[racon_tpu::router] error: {exc}", file=sys.stderr)
         return 1
 
+    scaler = None
+    if args.autoscale:
+        from .autoscale import Autoscaler
+
+        as_kw: dict = {}
+        if args.autoscale_min is not None:
+            as_kw["min_replicas"] = args.autoscale_min
+        if args.autoscale_max is not None:
+            as_kw["max_replicas"] = args.autoscale_max
+        try:
+            scaler = Autoscaler(router, **as_kw).start()
+        except RaconError as exc:
+            print(f"[racon_tpu::router] error: {exc}", file=sys.stderr)
+            router.drain()
+            return 1
+
     stop = threading.Event()
 
     def _on_signal(signum, frame):
@@ -1268,5 +1646,7 @@ def router_main(argv: list[str]) -> int:
     signal.signal(signal.SIGINT, _on_signal)
     while not stop.is_set() and not router._stopped.is_set():
         stop.wait(0.2)
+    if scaler is not None:
+        scaler.close()
     router.drain()
     return 0

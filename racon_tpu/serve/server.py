@@ -1134,6 +1134,29 @@ class PolishServer:
             return error_response(
                 "bad-request",
                 f"rounds must be an integer in [1, {MAX_ROUNDS}]")
+        # sub-contig window-range shard slice (router fan-out,
+        # protocol.py "Child-job fields"): validated here so a typo'd
+        # range fails typed instead of silently polishing the whole
+        # target — the one unknown-key family a range-aware replica
+        # must NOT ignore
+        range_lo = req.get("range_lo")
+        range_hi = req.get("range_hi")
+        if range_lo is not None or range_hi is not None:
+            if (isinstance(range_lo, bool) or isinstance(range_hi, bool)
+                    or not isinstance(range_lo, int)
+                    or not isinstance(range_hi, int)
+                    or range_lo < 0 or range_hi <= range_lo):
+                return error_response(
+                    "bad-request",
+                    "range_lo/range_hi must be integers with "
+                    "0 <= range_lo < range_hi")
+            if rounds is not None:
+                # round 2 would re-map reads onto a SEGMENT, which is
+                # not what solo rounds on the full contig compute —
+                # the router falls back to contig sharding instead
+                return error_response(
+                    "bad-request",
+                    "rounds cannot be combined with range_lo/range_hi")
         with self._job_seq_lock:
             self._job_seq += 1
             job_id = f"j{self._job_seq}"
@@ -1145,7 +1168,8 @@ class PolishServer:
                   trace_id=trace_id,
                   want_progress=bool(req.get("progress")),
                   want_stream=bool(req.get("stream")),
-                  tenant=tenant or "", rounds=rounds)
+                  tenant=tenant or "", rounds=rounds,
+                  range_lo=range_lo, range_hi=range_hi)
         # child-job fields from a serve router (router.py): `parent` is
         # the router-side parent job id, `shard`/`shards` this child's
         # slot in the contig fan-out. Purely observational replica-side
@@ -1167,7 +1191,9 @@ class PolishServer:
                                 deadline_s=req.get("deadline_s"),
                                 rounds=job.rounds,
                                 parent=parent, shard=shard,
-                                shards=shards)
+                                shards=shards,
+                                range_lo=job.range_lo,
+                                range_hi=job.range_hi)
         try:
             self.queue.submit(job)
         except QueueFull as exc:
@@ -1623,6 +1649,12 @@ class PolishServer:
                 raise JobCancelledError("running")
             if job.want_progress:
                 polisher.progress_hook = job.notify_progress
+            if job.range_lo is not None:
+                # sub-contig range shard: polish only the target
+                # windows whose grid start falls in [lo, hi) — the
+                # polisher emits bare-named segments and records the
+                # stitch accounting in segment_meta (core/polisher.py)
+                polisher.window_range = (job.range_lo, job.range_hi)
             polisher.initialize()
             # per-contig sink: every serve job stitches incrementally
             # through the continuous batcher, so each finished contig is
@@ -1642,10 +1674,21 @@ class PolishServer:
                         "part-streamed", job=job.id, trace=job.trace_id,
                         contig=seq.name.split(" ", 1)[0],
                         part=len(parts), bytes=len(part))
-                job.notify_part({"type": "result_part",
-                                 "job_id": job.id, "part": len(parts),
-                                 "name": seq.name,
-                                 "fasta": part.decode("latin-1")})
+                frame = {"type": "result_part",
+                         "job_id": job.id, "part": len(parts),
+                         "name": seq.name,
+                         "fasta": part.decode("latin-1")}
+                if job.range_lo is not None:
+                    # range shard: the frame carries the RAW segment
+                    # body (no FASTA header/newline — Sequence.data has
+                    # no newlines) plus the stitch accounting the
+                    # router needs to re-derive the solo tags; the
+                    # classic "parts' concatenation IS the body"
+                    # contract deliberately does NOT apply here
+                    # (protocol.py "Child-job fields")
+                    frame["fasta"] = seq.data.decode("latin-1")
+                    frame["seg"] = polisher.segment_meta.get(seq.name)
+                job.notify_part(frame)
 
             drop = not opts.get("include_unpolished", False)
             per_round: list[dict] = []

@@ -35,6 +35,31 @@ def test_partition_devices_contiguous_and_balanced():
     assert partition_devices(devs, 1) == [devs]
 
 
+def test_partition_devices_global_list_seam(monkeypatch):
+    """The multi-host prep seam: an explicit (global) device list is
+    partitioned as given — lanes can span hosts — and devices=None
+    auto-discovers jax.devices() under the RACON_TPU_MAX_DEVICES cap,
+    matching BatchRunner's discovery exactly."""
+    import jax
+
+    # explicit global list: partitioned verbatim, no local filtering —
+    # host-contiguity is the CALLER's ordering, preserved here
+    global_devs = [("host0", i) for i in range(4)] \
+        + [("host1", i) for i in range(4)]
+    lanes = partition_devices(global_devs, 2)
+    assert lanes == [global_devs[:4], global_devs[4:]]
+
+    # devices=None: the process-wide jax.devices() view
+    auto = partition_devices(k=2)
+    expect = jax.devices()
+    assert sum(auto, []) == list(expect)
+
+    # ...honoring the same cap knob as BatchRunner auto-discovery
+    monkeypatch.setenv("RACON_TPU_MAX_DEVICES", "3")
+    capped = partition_devices(k=2)
+    assert sum(capped, []) == list(expect)[:3]
+
+
 # ------------------------------------------------------- sub-mesh runner
 def test_for_batch_submesh_and_cache():
     runner = BatchRunner(devices=_devices(4))

@@ -507,3 +507,85 @@ def test_router_scaling_min_mandatory_when_requested(tmp_path, capsys):
     assert perfgate.main(["--artifact", partial,
                           "--router-scaling-min", "1.5"]) == 2
     assert "router.scaling_x" in capsys.readouterr().err
+
+
+def test_range_scaling_gate(tmp_path, capsys):
+    """ISSUE-18 satellite: the single-job window-range-sharding
+    speedup gates via --range-scaling-min (mandatory once requested,
+    rc 2 naming the dotted key on an artifact that never
+    range-sharded)."""
+    doc = router_artifact()
+    doc["router"]["range"] = True
+    doc["router"]["range_shards"] = 2
+    doc["router"]["range_scaling_x"] = 1.7
+    ok = write(tmp_path / "range.json", doc)
+    assert perfgate.main(["--artifact", ok,
+                          "--range-scaling-min", "1.5"]) == 0
+    assert "router.range_scaling_x" in capsys.readouterr().err
+    assert perfgate.main(["--artifact", ok,
+                          "--range-scaling-min", "1.8"]) == 1
+    assert "router.range_scaling_x" in capsys.readouterr().err
+    # a sweep that never range-sharded carries no key: broken gate
+    plain = write(tmp_path / "plain.json", router_artifact())
+    assert perfgate.main(["--artifact", plain,
+                          "--range-scaling-min", "1.5"]) == 2
+    assert "router.range_scaling_x" in capsys.readouterr().err
+    # ...and so is the flag over an artifact with no router block
+    serve = write(tmp_path / "serve.json", serve_artifact(p50=1.0))
+    assert perfgate.main(["--artifact", serve, "--ref-value", "1.0",
+                          "--tolerance-pct", "50",
+                          "--range-scaling-min", "1.5"]) == 2
+    assert "router.range_scaling_x" in capsys.readouterr().err
+
+
+def ramp_artifact(flat=1.3, jobs_lost=0):
+    return {"mode": "ramp", "jobs": 24,
+            "autoscale": {"replicas_min": 1, "replicas_max": 4,
+                          "jobs": 24, "completed": 24 - jobs_lost,
+                          "jobs_lost": jobs_lost,
+                          "scale_ups": 3, "scale_downs": 3,
+                          "drained_to_min": True,
+                          "gold_p99_idle_s": 1.0,
+                          "gold_p99_ramp_s": flat,
+                          "gold_p99_flat": flat,
+                          "replicas_over_time": []}}
+
+
+def test_ramp_autoscale_gates(tmp_path, capsys):
+    """ISSUE-18 satellite: servebench --ramp artifacts gate
+    autoscale.jobs_lost == 0 and autoscale.gold_p99_flat (default 2.0
+    when the block is present, --ramp-p99-flat-max overriding)."""
+    ok = write(tmp_path / "ok.json", ramp_artifact())
+    assert perfgate.main(["--artifact", ok]) == 0
+    err = capsys.readouterr().err
+    assert "autoscale.jobs_lost" in err
+    assert "autoscale.gold_p99_flat" in err
+    # ANY lost job fails — a scale-event race, never noise
+    lossy = write(tmp_path / "lossy.json",
+                  ramp_artifact(jobs_lost=1))
+    assert perfgate.main(["--artifact", lossy]) == 1
+    assert "autoscale.jobs_lost" in capsys.readouterr().err
+    # p99 not flat vs the idle floor fails at the default 2.0
+    spiky = write(tmp_path / "spiky.json", ramp_artifact(flat=3.5))
+    assert perfgate.main(["--artifact", spiky]) == 1
+    assert "autoscale.gold_p99_flat" in capsys.readouterr().err
+    # explicit limit honored both ways
+    assert perfgate.main(["--artifact", spiky,
+                          "--ramp-p99-flat-max", "4.0"]) == 0
+    assert perfgate.main(["--artifact", ok,
+                          "--ramp-p99-flat-max", "1.1"]) == 1
+
+
+def test_ramp_p99_flat_max_mandatory_when_requested(tmp_path, capsys):
+    """--ramp-p99-flat-max over an artifact without an autoscale block
+    is a named-key broken gate, rc 2 (the slo.miss_rate convention) —
+    and a ramp artifact has no implicit baseline without --against."""
+    plain = write(tmp_path / "plain.json", serve_artifact(p50=1.0))
+    assert perfgate.main(["--artifact", plain, "--ref-value", "1.0",
+                          "--tolerance-pct", "50",
+                          "--ramp-p99-flat-max", "2.0"]) == 2
+    assert "autoscale.gold_p99_flat" in capsys.readouterr().err
+    # a ramp artifact missing the flatness key entirely cannot extract
+    with pytest.raises(perfgate.GateError,
+                       match="autoscale.gold_p99_flat"):
+        perfgate.extract({"mode": "ramp", "autoscale": {}})

@@ -54,6 +54,51 @@ def test_stage_order_and_stats(depth):
         assert s[k] >= 0.0
 
 
+def test_simulated_device_latency_env(monkeypatch):
+    """RACON_TPU_DEVICE_LATENCY_S stalls each chunk's result wait by the
+    configured round-trip (the device-dominated bench posture), charges
+    the stall to device seconds, and strict-parses."""
+    monkeypatch.setenv("RACON_TPU_DEVICE_LATENCY_S", "0.05")
+    pl = DispatchPipeline(depth=0)
+    assert pl.device_latency_s == 0.05
+    seen = []
+    t0 = time.perf_counter()
+    pl.run(range(4), pack=lambda i: i, dispatch=lambda i, ops: ops,
+           wait=lambda h: h, unpack=lambda i, r: seen.append(r))
+    wall = time.perf_counter() - t0
+    pl.close()
+    assert seen == [0, 1, 2, 3]  # output untouched, only paced
+    assert wall >= 0.2  # 4 chunks x 50 ms
+    assert pl.stats.snapshot()["device_s"] >= 0.2
+
+    from racon_tpu.errors import RaconError
+    for bad in ("fast", "-1"):
+        monkeypatch.setenv("RACON_TPU_DEVICE_LATENCY_S", bad)
+        with pytest.raises(RaconError, match="DEVICE_LATENCY_S"):
+            DispatchPipeline(depth=0)
+    monkeypatch.delenv("RACON_TPU_DEVICE_LATENCY_S")
+    assert DispatchPipeline(depth=0).device_latency_s == 0.0
+
+    # the proportional twin: each chunk's dispatch is followed by a
+    # sleep of X times its measured duration (a simulated device whose
+    # round-trip scales with batch size)
+    monkeypatch.setenv("RACON_TPU_DEVICE_LATENCY_X", "4")
+    pl = DispatchPipeline(depth=0)
+    assert pl.device_latency_x == 4.0
+    seen = []
+    t0 = time.perf_counter()
+    pl.run(range(2), pack=lambda i: i,
+           dispatch=lambda i, ops: time.sleep(0.05) or ops,
+           wait=lambda h: h, unpack=lambda i, r: seen.append(r))
+    wall = time.perf_counter() - t0
+    pl.close()
+    assert seen == [0, 1]
+    assert wall >= 0.4  # 2 chunks x (50 ms dispatch + 4x sleep)
+    monkeypatch.setenv("RACON_TPU_DEVICE_LATENCY_X", "no")
+    with pytest.raises(RaconError, match="DEVICE_LATENCY_X"):
+        DispatchPipeline(depth=0)
+
+
 @pytest.mark.parametrize("depth", [0, 2])
 def test_error_without_handler_propagates(depth):
     pl = DispatchPipeline(depth=depth)

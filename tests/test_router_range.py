@@ -1,0 +1,357 @@
+"""Sub-contig window-range sharding tests (serve/router.py +
+serve/server.py) — the ISSUE's pinned contracts:
+
+  - plan unit: `_plan_ranges` splits contigs at window-grid boundaries
+    only (every lo/hi a multiple of the window length), gapless and
+    non-overlapping per contig, never more shards than windows, extra
+    budget to the most-windowed contig;
+  - byte-identity: a ONE-contig job through the router over {1, 2, 4}
+    replicas produces the SAME polished FASTA as a solo run — at 2 and
+    4 the job really range-sharded (`router.range` / `range_shards`),
+    and the streamed surface still ships exactly one whole-contig part;
+  - window cache on: range shards against wincache-armed replicas stay
+    byte-identical (cold and warm);
+  - failover: a replica that drops its range shard's connection gets
+    the (contig, [lo,hi)) slice re-dispatched to a survivor — output
+    byte-identical, `requeued` in the journal (kill -9 with a partial
+    segment stream is `tools/faultcheck.py --match range`);
+  - compat: a pre-range replica that answers a range child with an
+    unsegmented part fails the job typed `replica-incompatible` rather
+    than corrupting the merge;
+  - server validation: malformed `range_lo`/`range_hi` and the
+    rounds+range combination answer typed `bad-request`; and the child
+    wire contract — raw segments + `seg` stitch accounting over a full
+    grid partition reassemble the solo body exactly.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import socket
+import threading
+import time
+
+import pytest
+
+from racon_tpu.core.polisher import PolisherType, create_polisher
+from racon_tpu.obs.journal import read_journal
+from racon_tpu.serve import (PolishClient, PolishRouter, PolishServer,
+                             make_synth_dataset)
+from racon_tpu.serve.client import ServeError
+from racon_tpu.serve.protocol import ProtocolError, recv_frame, send_frame
+
+
+# --------------------------------------------------------------- fixtures
+@pytest.fixture(scope="module")
+def dataset1(tmp_path_factory):
+    """ONE contig (4 polish windows at wl=500) — the workload contig
+    sharding cannot split past a single replica."""
+    return make_synth_dataset(str(tmp_path_factory.mktemp("range_data")))
+
+
+def _polish_solo(paths) -> bytes:
+    p = create_polisher(*paths, PolisherType.kC, 500, 10.0, 0.3,
+                        num_threads=2)
+    p.initialize()
+    return b"".join(b">" + s.name.encode() + b"\n" + s.data + b"\n"
+                    for s in p.polish())
+
+
+@pytest.fixture(scope="module")
+def solo1(dataset1):
+    return _polish_solo(dataset1)
+
+
+@pytest.fixture(scope="module")
+def range_replicas(tmp_path_factory):
+    d = tmp_path_factory.mktemp("range_reps")
+    socks = [str(d / f"rep{i}.sock") for i in range(4)]
+    servers = [PolishServer(socket_path=s, workers=2).start()
+               for s in socks]
+    yield socks
+    for srv in servers:
+        srv.drain(timeout=10)
+
+
+def _wait_routable(cli: PolishClient, want: int, deadline_s: float = 30.0):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < deadline_s:
+        with contextlib.suppress(Exception):
+            hz = cli.request({"type": "healthz"})
+            if hz.get("routable") == want:
+                return hz
+        time.sleep(0.1)
+    raise AssertionError(f"router never reached routable == {want}")
+
+
+# ------------------------------------------------------------- plan unit
+class _C:
+    def __init__(self, n: int):
+        self.data = b"A" * n
+
+
+def test_plan_ranges_grid_aligned_and_budgeted():
+    wl = 500
+    contigs = [_C(5000), _C(1200), _C(300)]  # 10 / 3 / 1 windows
+    plan = PolishRouter._plan_ranges(contigs, cap=6, wl=wl)
+    assert len(plan) == 6  # the whole budget lands
+    by_c: dict[int, list] = {}
+    for ci, lo, hi in plan:
+        assert lo % wl == 0 and hi % wl == 0 and hi > lo
+        by_c.setdefault(ci, []).append((lo, hi))
+    assert set(by_c) == {0, 1, 2}  # every contig >= 1 shard
+    for ci, spans in by_c.items():
+        w = max(1, (len(contigs[ci].data) + wl - 1) // wl)
+        spans.sort()
+        assert spans[0][0] == 0 and spans[-1][1] == w * wl
+        for (_alo, ahi), (blo, _bhi) in zip(spans, spans[1:]):
+            assert ahi == blo  # gapless, non-overlapping
+    # extra budget flows to the most-windowed contig
+    assert len(by_c[0]) > len(by_c[1]) >= len(by_c[2]) == 1
+    # a contig never splits past its window count
+    assert PolishRouter._plan_ranges([_C(300)], cap=8, wl=wl) \
+        == [(0, 0, wl)]
+
+
+# ------------------------------------------------------------- byte pins
+def test_range_byte_identity_1_2_4_replicas(dataset1, solo1,
+                                            range_replicas, tmp_path):
+    for n in (1, 2, 4):
+        router = PolishRouter(replicas=",".join(range_replicas[:n]),
+                              socket_path=str(tmp_path / f"rr{n}.sock"),
+                              health_interval_s=0.2).start()
+        try:
+            cli = PolishClient(socket_path=router.config.socket_path)
+            _wait_routable(cli, n)
+            raw = cli.request({"type": "submit",
+                               "sequences": dataset1[0],
+                               "overlaps": dataset1[1],
+                               "target": dataset1[2]})
+            assert raw["fasta"].encode("latin-1") == solo1
+            assert raw["router"]["requeues"] == 0
+            if n == 1:
+                assert not raw["router"].get("range")
+            else:
+                assert raw["router"]["range"] is True
+                assert raw["router"]["range_shards"] == n
+            # streamed surface: segments are router-internal — the
+            # client still gets exactly ONE whole-contig part
+            parts: list[dict] = []
+            res = cli.submit(*dataset1, stream=True,
+                             on_part=lambda f: parts.append(f))
+            assert res.fasta == solo1
+            assert len(parts) == 1 and parts[0]["part"] == 0
+        finally:
+            router.drain()
+
+
+def test_range_wincache_byte_identity(dataset1, solo1, tmp_path):
+    socks = [str(tmp_path / f"wc{i}.sock") for i in range(2)]
+    servers = [PolishServer(socket_path=s, workers=2,
+                            wincache=True).start() for s in socks]
+    router = PolishRouter(replicas=",".join(socks),
+                          socket_path=str(tmp_path / "rwc.sock"),
+                          health_interval_s=0.2).start()
+    try:
+        cli = PolishClient(socket_path=router.config.socket_path)
+        _wait_routable(cli, 2)
+        for _ in range(2):  # second run replays warm cache entries
+            raw = cli.request({"type": "submit",
+                               "sequences": dataset1[0],
+                               "overlaps": dataset1[1],
+                               "target": dataset1[2]})
+            assert raw["fasta"].encode("latin-1") == solo1
+            assert raw["router"].get("range") is True
+    finally:
+        router.drain()
+        for srv in servers:
+            srv.drain(timeout=10)
+
+
+# ------------------------------------------------------------- failover
+class _StubReplica:
+    """Protocol-complete fake replica: healthy to every probe, submit
+    behavior injectable — drop the connection (a replica dying the
+    moment its range shard lands) or answer like a PRE-RANGE replica
+    that ignored range_lo/range_hi."""
+
+    def __init__(self, sock_path: str, on_submit):
+        self.path = sock_path
+        self.on_submit = on_submit
+        self.submits = 0
+        self._stop = threading.Event()
+        self._lst = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._lst.bind(sock_path)
+        self._lst.listen(8)
+        self._lst.settimeout(0.2)
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def _loop(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._lst.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            threading.Thread(target=self._handle, args=(conn,),
+                             daemon=True).start()
+
+    def _handle(self, conn):
+        try:
+            while True:
+                req = recv_frame(conn)
+                if req is None:
+                    return
+                rtype = req.get("type")
+                if rtype == "healthz":
+                    send_frame(conn, {"type": "healthz", "ok": True,
+                                      "draining": False})
+                elif rtype == "scrape":
+                    send_frame(conn, {"type": "metrics", "text": ""})
+                elif rtype == "submit":
+                    self.submits += 1
+                    self.on_submit(conn, req)
+                    return
+                else:
+                    send_frame(conn, {"type": "ok"})
+        except (OSError, ProtocolError):
+            return
+        finally:
+            with contextlib.suppress(OSError):
+                conn.close()
+
+    def close(self):
+        self._stop.set()
+        with contextlib.suppress(OSError):
+            self._lst.close()
+
+
+def test_range_shard_requeues_to_survivor(dataset1, solo1, tmp_path):
+    def drop(conn, _req):  # connection drop before any segment
+        with contextlib.suppress(OSError):
+            conn.shutdown(socket.SHUT_RDWR)
+
+    stub = _StubReplica(str(tmp_path / "stub.sock"), drop)
+    real = PolishServer(socket_path=str(tmp_path / "real.sock"),
+                        workers=2).start()
+    journal = str(tmp_path / "router.jsonl")
+    router = PolishRouter(
+        replicas=f"{stub.path},{real.config.socket_path}",
+        socket_path=str(tmp_path / "r.sock"), journal=journal,
+        health_interval_s=0.2).start()
+    try:
+        cli = PolishClient(socket_path=router.config.socket_path)
+        _wait_routable(cli, 2)
+        raw = cli.request({"type": "submit",
+                           "sequences": dataset1[0],
+                           "overlaps": dataset1[1],
+                           "target": dataset1[2]})
+        assert raw["fasta"].encode("latin-1") == solo1
+        assert raw["router"]["range"] is True
+        assert raw["router"]["requeues"] >= 1
+        assert stub.submits >= 1  # the dying replica really got a slice
+    finally:
+        router.drain()
+        stub.close()
+        real.drain(timeout=10)
+    events = [e["event"] for e in read_journal(journal)]
+    assert "range-plan" in events
+    assert "requeued" in events
+
+
+def test_pre_range_replica_fails_typed(dataset1, solo1, tmp_path):
+    def unsegmented(conn, req):  # a part WITHOUT `seg`: whole-contig
+        send_frame(conn, {"type": "result_part", "job_id": "stub",
+                          "part": 1, "name": "draft",
+                          "fasta": ">draft\nACGT\n"})
+        send_frame(conn, {"type": "result", "job_id": "stub",
+                          "fasta": ""})
+
+    stub = _StubReplica(str(tmp_path / "old.sock"), unsegmented)
+    real = PolishServer(socket_path=str(tmp_path / "real2.sock"),
+                        workers=2).start()
+    router = PolishRouter(
+        replicas=f"{stub.path},{real.config.socket_path}",
+        socket_path=str(tmp_path / "r2.sock"),
+        health_interval_s=0.2).start()
+    try:
+        cli = PolishClient(socket_path=router.config.socket_path)
+        _wait_routable(cli, 2)
+        with pytest.raises(ServeError) as exc_info:
+            cli.request({"type": "submit",
+                         "sequences": dataset1[0],
+                         "overlaps": dataset1[1],
+                         "target": dataset1[2]})
+        assert exc_info.value.code == "replica-incompatible"
+    finally:
+        router.drain()
+        stub.close()
+        real.drain(timeout=10)
+
+
+# ------------------------------------------------- server-side contract
+def test_server_rejects_malformed_range(dataset1, tmp_path):
+    srv = PolishServer(socket_path=str(tmp_path / "v.sock"),
+                       workers=1).start()
+    try:
+        cli = PolishClient(socket_path=srv.config.socket_path)
+        base = {"type": "submit", "sequences": dataset1[0],
+                "overlaps": dataset1[1], "target": dataset1[2]}
+        for bad in ({"range_lo": "0", "range_hi": 500},
+                    {"range_lo": 0, "range_hi": 0},
+                    {"range_lo": -500, "range_hi": 500},
+                    {"range_lo": True, "range_hi": 500},
+                    {"range_lo": 500}):
+            with pytest.raises(ServeError) as exc_info:
+                cli.request({**base, **bad})
+            assert exc_info.value.code == "bad-request"
+        with pytest.raises(ServeError) as exc_info:
+            cli.request({**base, "range_lo": 0, "range_hi": 500,
+                         "rounds": 2})
+        assert exc_info.value.code == "bad-request"
+        assert "rounds" in str(exc_info.value)
+    finally:
+        srv.drain(timeout=10)
+
+
+def test_range_child_segments_reassemble_solo_body(dataset1, solo1,
+                                                   tmp_path):
+    """The child wire contract, driven directly: raw segments + `seg`
+    stitch accounting over a full grid partition concatenate to the
+    solo body, and the accounting sums to the solo XC inputs."""
+    from racon_tpu.io.parsers import create_sequence_parser
+
+    contigs: list = []
+    create_sequence_parser(dataset1[2], "range_test").parse(contigs, -1)
+    plan = PolishRouter._plan_ranges(contigs, cap=2, wl=500)
+    assert len(plan) == 2
+    srv = PolishServer(socket_path=str(tmp_path / "c.sock"),
+                       workers=1).start()
+    try:
+        cli = PolishClient(socket_path=srv.config.socket_path)
+        segs = []
+        for _ci, lo, hi in plan:
+            parts: list[dict] = []
+            cli.request({"type": "submit", "sequences": dataset1[0],
+                         "overlaps": dataset1[1], "target": dataset1[2],
+                         "range_lo": lo, "range_hi": hi,
+                         "stream": True},
+                        on_part=lambda f: parts.append(f))
+            assert len(parts) == 1
+            seg = parts[0]["seg"]
+            assert seg["lo"] == lo and seg["hi"] == hi
+            assert parts[0]["name"] == "draft"  # bare, no solo tags
+            segs.append((seg["lo"], parts[0]["fasta"], seg))
+        segs.sort(key=lambda s: s[0])
+        body = "".join(f for _lo, f, _s in segs)
+        solo_header, _, solo_rest = solo1.partition(b"\n")
+        assert body.encode("latin-1") == solo_rest.rstrip(b"\n")
+        # the accounting re-derives the solo tags exactly
+        total = segs[0][2]["total_windows"]
+        assert all(s["total_windows"] == total for _l, _f, s in segs)
+        polished = sum(s["polished"] for _l, _f, s in segs)
+        assert f"XC:f:{polished / total:.6f}".encode() in solo_header
+        assert f"LN:i:{len(body)}".encode() in solo_header
+    finally:
+        srv.drain(timeout=10)

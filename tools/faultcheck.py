@@ -43,6 +43,13 @@ bytes ship and no audit event fires. Both cells are gated; together
 they pin that detection is real AND that it comes from the sampling,
 not from some hidden always-on check.
 
+A RANGE section (one gated cell) exercises window-range sharding: a
+single-contig job split by target-coordinate range across two real
+replica subprocesses, one killed -9 mid-job — the requeued window range
+must complete on the survivor with the reassembled contig
+byte-identical to a solo run, the `range-plan`/`requeued` lines on the
+ledger, and obsreport's segment-receipt check tiling clean.
+
 A PREEMPT section (two gated cells) exercises the preemptive-QoS layer:
 a gold-priority job preempting a running free job on a one-worker
 server (both outputs byte-identical to an undisturbed run, balanced
@@ -579,6 +586,122 @@ def run_router_cells(tmp: str) -> list[tuple[str, str]]:
     return cells
 
 
+def run_range_cells(tmp: str) -> list[tuple[str, str]]:
+    """The window-range sharding section (serve/router.py sub-contig
+    fan-out): a SINGLE-contig job range-sharded across two REAL
+    `racon_tpu serve` replica subprocesses, with one replica killed -9
+    mid-job. The requeue must re-run the dead replica's window range on
+    the survivor and the reassembled contig must be byte-identical to a
+    solo run; the ledger must carry the `range-plan` and `requeued`
+    lines, stay lifecycle-consistent, AND pass obsreport's
+    segment-receipt tiling check (each accepted segment journaled
+    exactly once, covering the window axis with no gap or overlap)."""
+    import signal
+    import subprocess
+
+    from racon_tpu.core.polisher import PolisherType, create_polisher
+    from racon_tpu.obs.journal import check_consistency, read_journal
+    from racon_tpu.serve import (PolishClient, PolishRouter,
+                                 make_synth_dataset)
+
+    name = "range-shard kill -9 mid-job"
+    cells: list[tuple[str, str]] = []
+    data_dir = os.path.join(tmp, "range_data")
+    os.makedirs(data_dir, exist_ok=True)
+    rpaths = make_synth_dataset(data_dir)  # ONE contig: the mega-contig
+    p = create_polisher(*rpaths, PolisherType.kC, 500, 10.0, 0.3,
+                        num_threads=2)
+    p.initialize()
+    clean = b"".join(b">" + s.name.encode() + b"\n" + s.data + b"\n"
+                     for s in p.polish())
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               RACON_TPU_DEVICE_RETRIES="1")
+    env["PYTHONPATH"] = os.pathsep.join(
+        [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+        + [q for q in env.get("PYTHONPATH", "").split(os.pathsep)
+           if q and "axon_site" not in q])
+    socks = [os.path.join(tmp, f"range_rep{i}.sock") for i in range(2)]
+    procs = [subprocess.Popen(
+        [sys.executable, "-m", "racon_tpu.cli", "serve", "--socket", s,
+         "--workers", "2", "--no-warmup"],
+        env=env, stderr=subprocess.DEVNULL) for s in socks]
+    router = None
+    journal = os.path.join(tmp, "range_journal.jsonl")
+    try:
+        for s in socks:
+            probe = PolishClient(socket_path=s, timeout=30)
+            deadline = time.perf_counter() + 90
+            while time.perf_counter() < deadline:
+                try:
+                    probe.request({"type": "ping"})
+                    break
+                except Exception:  # noqa: BLE001 — still starting
+                    time.sleep(0.2)
+            else:
+                raise RuntimeError(f"replica {s} never came up")
+        router = PolishRouter(replicas=",".join(socks),
+                              socket_path=os.path.join(tmp,
+                                                       "range_rt.sock"),
+                              journal=journal,
+                              health_interval_s=0.5).start()
+        # same pacing trick as the contig-shard section: a
+        # watchdog-absorbed hang keeps both range shards busy long
+        # enough for the kill to land genuinely mid-job
+        slow = {"fault_plan": "device:chunk=0:hang=8",
+                "options": {"tpu_device_timeout": 2.0}}
+        res: dict = {}
+
+        def run_job(out: dict):
+            mine = PolishClient(socket_path=router.config.socket_path)
+            try:
+                out["resp"] = mine.submit(*rpaths, stream=True, **slow)
+            except Exception as exc:  # noqa: BLE001 — checked below
+                out["exc"] = exc
+
+        t = threading.Thread(target=run_job, args=(res,))
+        t.start()
+        time.sleep(1.0)  # both range shards dispatched and stalled
+        procs[0].send_signal(signal.SIGKILL)  # the real kill -9
+        t.join(WALL_CAP)
+        entries = read_journal(journal)
+        events = [e["event"] for e in entries]
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        import obsreport
+        resp = res.get("resp")
+        checks = [("completed", resp is not None),
+                  ("identical",
+                   resp is not None and resp.fasta == clean),
+                  ("range-sharded",
+                   resp is not None
+                   and resp.router.get("range") is True),
+                  ("range-plan-journaled", "range-plan" in events),
+                  ("requeued-journaled", "requeued" in events
+                   and "replica-down" in events),
+                  ("journal-consistent",
+                   check_consistency(entries) == []),
+                  ("segments-tile",
+                   obsreport.check_parts_routed(entries) == [])]
+        failed = [n for n, ok in checks if not ok]
+        if "exc" in res:
+            failed.append(f"({type(res['exc']).__name__}: "
+                          f"{res['exc']})")
+        cells.append((name,
+                      "pass  requeued, segments tiled, identical"
+                      if not failed else f"FAIL {' '.join(failed)}"))
+    except Exception as exc:  # noqa: BLE001 — a crashed section is a
+        # red cell, not a crashed grid
+        cells.append((name,
+                      f"FAIL crashed ({type(exc).__name__}: {exc})"))
+    finally:
+        if router is not None:
+            router.drain()
+        for proc in procs:
+            if proc.poll() is None:
+                proc.kill()
+            proc.wait(timeout=10)
+    return cells
+
+
 def run_preempt_cells(tmp: str) -> list[tuple[str, str]]:
     """The preemptive-QoS section (serve QoS: --preempt + cancel RPC):
     a gold-priority job preempts a running free job on a one-worker
@@ -838,6 +961,14 @@ def main() -> int:
         for name, cell in router_cells:
             failures += cell.startswith("FAIL")
             print(f"{name:<{width}}  {cell}", file=sys.stderr)
+        # the window-range sharding section: kill -9 one of two
+        # replicas mid-range-sharded SINGLE-contig job — the requeued
+        # window range must complete byte-identically with the
+        # segment receipts tiling the contig exactly once
+        range_cells = run_range_cells(tmp)
+        for name, cell in range_cells:
+            failures += cell.startswith("FAIL")
+            print(f"{name:<{width}}  {cell}", file=sys.stderr)
         # the preemptive-QoS section: gold preempts free byte-
         # identically; a cancel RPC lands during a watchdog-absorbed
         # hang and the server survives
@@ -846,7 +977,8 @@ def main() -> int:
             failures += cell.startswith("FAIL")
             print(f"{name:<{width}}  {cell}", file=sys.stderr)
     n_cells = ((len(columns) + 2) * len(rows) + len(audit_cells)
-               + len(router_cells) + len(preempt_cells))
+               + len(router_cells) + len(range_cells)
+               + len(preempt_cells))
     print(f"[faultcheck] {'FAIL' if failures else 'PASS'}: "
           f"{n_cells - failures}/{n_cells} cells green",
           file=sys.stderr)

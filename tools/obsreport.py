@@ -39,7 +39,16 @@ duplicated boundary line) is a red check, not a plausible-looking
 timeline — and the preemption lifecycle: every `preempted` a job
 journals must be balanced by exactly one `resumed` (the server emits
 `resumed` with reason=terminal when a job ends while still parked), so
-a job left parked forever — a leaked withdrawal — is a red check."""
+a job left parked forever — a leaked withdrawal — is a red check.
+The router-journal twin of the part-streamed receipt is checked at
+SEGMENT granularity: a range-sharded job (serve/router.py window-range
+sharding) journals one `part-routed` line per accepted segment with its
+`lo`/`hi` window-grid coordinates, and `--check` pins that each
+contig's segments, sorted by `lo`, tile the coordinate axis from 0
+with no gap, overlap, or duplicate — so a segment merged twice (a
+requeue dedupe bug) or a hole silently dropped from a reassembled
+contig is a red check; whole-contig `part-routed` lines are pinned to
+exactly one per contig per job."""
 
 from __future__ import annotations
 
@@ -180,6 +189,7 @@ def main(argv=None) -> int:
 
     problems = check_consistency(entries)
     problems += check_parts_streamed(entries)
+    problems += check_parts_routed(entries)
     problems += check_rounds(entries)
     problems += check_preemptions(entries)
     for p in problems:
@@ -223,6 +233,57 @@ def check_parts_streamed(entries: list[dict]) -> list[str]:
             problems.append(
                 f"job {job}: {n_parts} part-streamed events for "
                 f"{n_seqs} output sequences")
+    return problems
+
+
+def check_parts_routed(entries: list[dict]) -> list[str]:
+    """Router part-receipt invariant, at segment granularity: the
+    router journals one `part-routed` line per contig it forwards —
+    and under window-range sharding, one per accepted SEGMENT, tagged
+    with the segment's `lo`/`hi` window-grid coordinates. Per (job,
+    contig): range segments sorted by `lo` must tile the axis from 0 —
+    every `lo` equal to the previous `hi`, no overlap, no duplicate —
+    because the merge ledger dedupes requeue replays BEFORE journaling;
+    a violation means a segment was merged twice or a hole shipped
+    inside a reassembled contig. Whole-contig lines (no `lo`) must
+    appear exactly once per contig. Jobs whose `received` line fell out
+    of the rotation window are skipped (the shared tolerance)."""
+    segs: dict[tuple[str, str], list[tuple[int, int]]] = {}
+    whole: dict[tuple[str, str], int] = {}
+    received: set[str] = set()
+    for e in entries:
+        job = e.get("job")
+        if not job:
+            continue
+        if e.get("event") == "received":
+            received.add(str(job))
+        elif e.get("event") == "part-routed":
+            key = (str(job), str(e.get("name")))
+            if isinstance(e.get("lo"), int) \
+                    and isinstance(e.get("hi"), int):
+                segs.setdefault(key, []).append((e["lo"], e["hi"]))
+            else:
+                whole[key] = whole.get(key, 0) + 1
+    problems: list[str] = []
+    for (job, name), ranges in sorted(segs.items()):
+        if job not in received:
+            continue
+        ranges.sort()
+        expect = 0
+        for lo, hi in ranges:
+            if lo != expect or hi <= lo:
+                problems.append(
+                    f"job {job}: contig {name!r} segments do not tile "
+                    f"— got [{lo},{hi}) where window {expect} was due")
+                break
+            expect = hi
+    for (job, name), n in sorted(whole.items()):
+        if job not in received:
+            continue
+        if n != 1:
+            problems.append(
+                f"job {job}: contig {name!r} routed {n} times "
+                f"(expected exactly once)")
     return problems
 
 

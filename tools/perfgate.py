@@ -52,9 +52,26 @@ Metric extraction understands both artifact shapes:
     a real replica loss, not noise); `router.scaling_x` (jobs/s at N
     replicas over jobs/s at 1) gates ABSOLUTELY against
     `--router-scaling-min` (mandatory once requested, rc 2 naming the
-    dotted key when absent). The headline `router.jobs_per_s` gates
-    RELATIVELY only against an explicit `--against` router artifact —
-    there is no implicit baseline for a replica-count sweep.
+    dotted key when absent); `router.range_scaling_x` (single-job wall
+    at 1 replica over the wall at the highest swept count — the
+    window-range-sharding speedup a `--contigs 1` workload measures)
+    gates ABSOLUTELY against `--range-scaling-min` (mandatory once
+    requested, rc 2 naming the dotted key when absent). The headline
+    `router.jobs_per_s` gates RELATIVELY only against an explicit
+    `--against` router artifact — there is no implicit baseline for a
+    replica-count sweep.
+
+  - servebench `--ramp` artifacts (`"mode": "ramp"`) carry an
+    `autoscale` block (the elastic-fleet loop under a 1x->10x Poisson
+    ramp): `autoscale.jobs_lost` must be ZERO whenever the block is
+    present (a job lost across scale-up/scale-down is the race the
+    unroute-then-drain handshake exists to prevent, never noise), and
+    `autoscale.gold_p99_flat` — gold p99 over the ramp divided by the
+    idle 1-replica p99 — gates ABSOLUTELY whenever the block is
+    present (default 2.0; `--ramp-p99-flat-max` makes it mandatory,
+    rc 2 naming the dotted key when absent). Like router sweeps, ramp
+    artifacts have no implicit baseline (the idle arm inside the
+    artifact is the comparison).
 
   - servebench `--rounds` artifacts (`"mode": "rounds"`) carry
     `rounds` / `cache` blocks (serve-native iterative polishing with
@@ -282,6 +299,24 @@ def extract(doc: dict, path: str = "<artifact>") -> dict:
         if isinstance(inner.get("mesh"), dict):
             out["mesh"] = inner["mesh"]
         return out
+    if inner.get("mode") == "ramp":
+        # servebench --ramp artifact: gold p99 over the 1x->10x ramp
+        # as a ratio over the idle 1-replica p99 — LOWER is better
+        # (1.0 = the autoscaler held latency perfectly flat). No
+        # implicit baseline (the idle arm inside the artifact IS the
+        # comparison) — the autoscale block's absolute gates carry the
+        # verdict; --against another ramp artifact adds the relative
+        # flatness gate.
+        value = _lookup(inner, "autoscale.gold_p99_flat")
+        if value is None:
+            raise GateError(
+                f"{path}: artifact lacks gated metric "
+                "'autoscale.gold_p99_flat'")
+        out = {"name": "ramp gold p99 flatness", "value": float(value),
+               "unit": "x", "higher_better": False, "kind": "ramp"}
+        if isinstance(inner.get("mesh"), dict):
+            out["mesh"] = inner["mesh"]
+        return out
     if inner.get("mode") == "synth":
         # synthbench --json artifact: windows_per_s, HIGHER is better.
         # No implicit baseline exists for it (the published BASELINE
@@ -371,6 +406,11 @@ def resolve_baseline(cand: dict, args, candidate_path: str) -> tuple:
         # point; the qos block's absolute gates carry the verdict
         raise GateError("flood artifact has no implicit baseline "
                         "(use --doomed-abort-min and/or --against)")
+    if cand.get("kind") == "ramp":
+        # the idle 1-replica arm inside the artifact is the comparison
+        # point; the autoscale block's absolute gates carry the verdict
+        raise GateError("ramp artifact has no implicit baseline "
+                        "(use --ramp-p99-flat-max and/or --against)")
     if cand.get("kind") == "synth":
         # a published sample-workload baseline is not comparable with a
         # synthetic-scale run; synth artifacts gate absolutely and/or
@@ -546,8 +586,14 @@ def router_checks(doc: dict, args,
     fleet means a replica dropped mid-shard). `--router-scaling-min X`
     additionally gates `router.scaling_x` (jobs/s at the highest swept
     replica count over jobs/s at 1) >= X, and is mandatory once
-    requested — an artifact without the key exits 2 naming it."""
+    requested — an artifact without the key exits 2 naming it.
+    `--range-scaling-min X` gates `router.range_scaling_x` (the
+    single-job window-range-sharding speedup: sequential job wall at
+    1 replica over the wall at the highest swept count) >= X the same
+    way — mandatory once requested, rc 2 naming the dotted key when
+    the artifact never range-sharded."""
     explicit = args.router_scaling_min is not None
+    explicit_range = args.range_scaling_min is not None
     inner = doc.get("parsed", doc)
     router = inner.get("router") if isinstance(inner, dict) else None
     if not isinstance(router, dict):
@@ -555,6 +601,11 @@ def router_checks(doc: dict, args,
             raise GateError(
                 f"{candidate_path}: artifact lacks gated metric "
                 "'router.scaling_x' (--router-scaling-min gates "
+                "servebench --router artifacts)")
+        if explicit_range:
+            raise GateError(
+                f"{candidate_path}: artifact lacks gated metric "
+                "'router.range_scaling_x' (--range-scaling-min gates "
                 "servebench --router artifacts)")
         return []
     identical = bool(router.get("identical"))
@@ -578,6 +629,18 @@ def router_checks(doc: dict, args,
         limit = float(args.router_scaling_min)
         checks.append(("router.scaling_x", float(scaling) >= limit,
                        f"{scaling:g} >= {limit:g}"))
+    if explicit_range:
+        rscaling = _lookup(inner, "router.range_scaling_x")
+        if rscaling is None:
+            raise GateError(
+                f"{candidate_path}: artifact lacks gated metric "
+                "'router.range_scaling_x' (the sweep's top point "
+                "never window-range-sharded — use a --contigs 1 "
+                "workload with 2+ replicas)")
+        limit = float(args.range_scaling_min)
+        checks.append(("router.range_scaling_x",
+                       float(rscaling) >= limit,
+                       f"{rscaling:g} >= {limit:g}"))
     return checks
 
 
@@ -698,6 +761,55 @@ def qos_checks(doc: dict, args,
                        + ("" if ok else
                           " (the speculative deadline-abort saved "
                           "less device time than the floor)")))
+    return checks
+
+
+def autoscale_checks(doc: dict, args,
+                     candidate_path: str) -> list[tuple[str, bool, str]]:
+    """Elastic-fleet gates for servebench --ramp artifacts:
+    (name, ok, detail) triples. Whenever the artifact carries an
+    `autoscale` block: `autoscale.jobs_lost` must be ZERO (a job lost
+    across a scale-up or scale-down is the race the unroute-then-drain
+    handshake exists to prevent — never acceptable noise) and
+    `autoscale.gold_p99_flat` (gold p99 over the 1x->10x ramp divided
+    by the idle 1-replica p99) gates ABSOLUTELY at the default 2.0 —
+    the loop must hold latency flat, not merely absorb some load;
+    `--ramp-p99-flat-max` overrides the limit and makes the gate
+    mandatory (an artifact without the key exits 2 naming it)."""
+    explicit = args.ramp_p99_flat_max is not None
+    inner = doc.get("parsed", doc)
+    block = inner.get("autoscale") if isinstance(inner, dict) else None
+    if not isinstance(block, dict):
+        if explicit:
+            raise GateError(
+                f"{candidate_path}: artifact lacks gated metric "
+                "'autoscale.gold_p99_flat' (--ramp-p99-flat-max gates "
+                "servebench --ramp artifacts)")
+        return []
+    checks: list[tuple[str, bool, str]] = []
+    lost = block.get("jobs_lost")
+    if lost is not None:
+        ok = float(lost) == 0.0
+        checks.append(("autoscale.jobs_lost", ok,
+                       f"{lost:g} == 0"
+                       + ("" if ok else
+                          " (a job was LOST across a scale event — "
+                          "the drain/requeue handshake failed)")))
+    flat = block.get("gold_p99_flat")
+    if flat is None:
+        if explicit:
+            raise GateError(
+                f"{candidate_path}: artifact lacks gated metric "
+                "'autoscale.gold_p99_flat'")
+    else:
+        limit = args.ramp_p99_flat_max if explicit else 2.0
+        ok = float(flat) <= limit
+        checks.append(("autoscale.gold_p99_flat", ok,
+                       f"{flat:g} <= {limit:g}"
+                       + ("" if ok else
+                          " (gold p99 under the ramp is NOT flat vs "
+                          "the idle floor — the autoscaler failed to "
+                          "absorb the offered load)")))
     return checks
 
 
@@ -836,6 +948,11 @@ def run(args) -> int:
             # block's flatness (plus --doomed-abort-min) gates are
             # absolute, no external baseline required
             reference, ref_desc, ref = None, "", None
+        elif cand.get("kind") == "ramp" and not args.against:
+            # ramp artifacts carry the idle 1-replica arm internally:
+            # the autoscale block's jobs_lost/flatness gates are
+            # absolute, no external baseline required
+            reference, ref_desc, ref = None, "", None
         else:
             raise
     # mesh comparability resolves BEFORE any relative verdict prints: a
@@ -900,6 +1017,12 @@ def run(args) -> int:
               file=sys.stderr)
     for name, check_ok, detail in qos_checks(doc, args,
                                              candidate_path):
+        failures += 0 if check_ok else 1
+        print(f"[perfgate] {'PASS' if check_ok else 'FAIL'}: "
+              f"{os.path.basename(candidate_path)} {name} ({detail})",
+              file=sys.stderr)
+    for name, check_ok, detail in autoscale_checks(doc, args,
+                                                   candidate_path):
         failures += 0 if check_ok else 1
         print(f"[perfgate] {'PASS' if check_ok else 'FAIL'}: "
               f"{os.path.basename(candidate_path)} {name} ({detail})",
@@ -1001,6 +1124,27 @@ def main(argv=None) -> int:
                          "Router artifacts are also always gated on "
                          "router.identical and router.requeues == 0 "
                          "whenever the block is present")
+    ap.add_argument("--range-scaling-min", type=float, default=None,
+                    help="absolute floor on the single-job window-"
+                         "range-sharding speedup "
+                         "(router.range_scaling_x: sequential job "
+                         "wall at 1 replica over the wall at the "
+                         "highest swept count, servebench --router "
+                         "artifacts on a --contigs 1 workload); "
+                         "mandatory once passed — an artifact without "
+                         "the key exits 2 naming the dotted key")
+    ap.add_argument("--ramp-p99-flat-max", type=float, default=None,
+                    help="absolute bound on the ramp-mode gold-p99 "
+                         "flatness ratio (autoscale.gold_p99_flat: "
+                         "gold p99 over the 1x->10x Poisson ramp over "
+                         "the idle 1-replica p99, servebench --ramp "
+                         "artifacts; default: gate at 2.0 whenever "
+                         "the artifact carries the key; passing a "
+                         "value makes the gate mandatory — an "
+                         "artifact without it then exits 2 naming the "
+                         "dotted key). Ramp artifacts are also always "
+                         "gated on autoscale.jobs_lost == 0 whenever "
+                         "the block is present")
     ap.add_argument("--round2-speedup-min", type=float, default=None,
                     help="absolute floor on the window-cache round-2+ "
                          "speedup (rounds.round2_speedup_x: mean "

@@ -46,7 +46,21 @@ count (zero on a healthy fleet, any requeue fails the bench), the
 router's merge overhead (job wall minus slowest-shard exec) and
 byte-identity vs a direct single-replica submit — plus `scaling_x`
 (jobs/s at N over jobs/s at 1), which tools/perfgate.py gates via
-`router.identical` and `--router-scaling-min`.
+`router.identical` and `--router-scaling-min`. Sequential single-job
+submits per count additionally measure `range_scaling_x` — how much
+faster ONE job finishes when the router window-range-shards its
+contig across the fleet (a `--contigs 1` workload makes every
+multi-replica point range-shard) — gated via `--range-scaling-min`.
+
+RAMP MODE (`--ramp N`): elastic autoscaling under a ramped open-loop
+load. One warm replica behind the router, the autoscaler armed with
+ceiling N, Poisson arrivals climbing from well inside one replica's
+capacity to far outside it, then a slow trickle while the idle fleet
+drains back to the floor. The artifact gains an `autoscale` block
+(replicas over time, scale up/down counts, `gold_p99_flat` = ramp
+p99 over idle p99, `jobs_lost`) which tools/perfgate.py gates via
+`autoscale.jobs_lost` == 0 and `autoscale.gold_p99_flat`
+(default-when-present; `--ramp-p99-flat-max` makes it mandatory).
 
 AUDIT MODE (`--audit-rate R`): arm the identity-audit sentinel
 (racon_tpu/obs/audit.py) on every replica, keep it armed through the
@@ -219,6 +233,65 @@ def _mesh_block(batcher_snap: dict) -> dict:
         worker_lanes=batcher_snap.get("worker_lanes", 1))
 
 
+def spawn_replica(sock: str, args):
+    """One REAL `racon_tpu serve` replica subprocess. The fleet benches
+    (--router / --ramp) spawn replicas as processes, not in-process
+    threads: N PolishServers in one interpreter share a single GIL, so
+    thread-replicas can only ever measure overhead, never scaling."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [REPO] + [q for q in env.get("PYTHONPATH", "").split(os.pathsep)
+                  if q and "axon_site" not in q])
+    if getattr(args, "device_latency_ms", 0):
+        # the device-dominated posture: every replica pipeline stalls a
+        # simulated accelerator round-trip per chunk (off-CPU, so waits
+        # overlap across replica processes even on a small host)
+        env["RACON_TPU_DEVICE_LATENCY_S"] = str(
+            args.device_latency_ms / 1000.0)
+    if getattr(args, "device_latency_x", 0):
+        env["RACON_TPU_DEVICE_LATENCY_X"] = str(args.device_latency_x)
+    if getattr(args, "host_poa_chunk", 0):
+        # smaller chunks -> per-chunk latency paces proportionally to a
+        # job's window count (a range shard carries fewer windows, so
+        # it pays proportionally less simulated device time)
+        env["RACON_TPU_HOST_POA_CHUNK"] = str(args.host_poa_chunk)
+    return subprocess.Popen(
+        [sys.executable, "-m", "racon_tpu.cli", "serve",
+         "--socket", sock, "--workers", str(args.workers),
+         "--no-warmup", "-t", str(args.threads),
+         "-c", str(args.tpupoa_batches),
+         "--tpualigner-batches", str(args.tpualigner_batches)],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+
+
+def wait_replica(PolishClient, sock: str,
+                 deadline_s: float = 120.0) -> None:
+    probe = PolishClient(socket_path=sock, timeout=10)
+    deadline = time.perf_counter() + deadline_s
+    while time.perf_counter() < deadline:
+        try:
+            probe.request({"type": "ping"})
+            return
+        except Exception:  # noqa: BLE001 — still starting
+            time.sleep(0.2)
+    raise RuntimeError(f"replica {sock} never came up")
+
+
+def stop_replica(proc) -> None:
+    try:
+        proc.terminate()
+    except Exception:  # noqa: BLE001 — already gone
+        pass
+    try:
+        proc.wait(timeout=30)
+    except Exception:  # noqa: BLE001 — escalate
+        try:
+            proc.kill()
+            proc.wait(timeout=5)
+        except Exception:  # noqa: BLE001 — nothing left to do
+            pass
+
+
 def cold_cli_run(paths, args) -> tuple[float, bytes]:
     """One fresh-process CLI run: the full cold tax, wall-clocked."""
     env = {k: v for k, v in os.environ.items() if "axon" not in k.lower()}
@@ -331,18 +404,27 @@ def check_slo(args, PolishClient, PolishServer) -> int:
 def run_router_bench(args, PolishClient, PolishServer) -> int:
     """`--router N`: job throughput through the shard-aware router
     (racon_tpu/serve/router.py) vs replica count. Starts N warm
-    in-process replicas ONCE, then for each swept count c (1, 2, 4 ...
+    replica SUBPROCESSES once (real processes — in-process
+    thread-replicas share one GIL and cannot scale), then for each
+    swept count c (1, 2, 4 ...
     capped at N; N always included) fronts the first c replicas with a
     PolishRouter and fires the same concurrent wave through it.
     Reports jobs/s per count, the requeue count (zero on a healthy
     fleet — any requeue here is a real replica loss and fails the
     bench), the router's merge overhead (job wall minus the slowest
     shard's exec seconds: the fan-out + merge + ledger tax) and
-    byte-identity vs a direct single-replica submit. `--json` rides
-    the curve out as a `router` artifact block with `scaling_x`
-    (jobs/s at N replicas over jobs/s at 1) which tools/perfgate.py
-    gates via `router.identical` (always, when the block is present)
-    and `--router-scaling-min` (mandatory once requested)."""
+    byte-identity vs a direct single-replica submit. Each swept count
+    also times SEQUENTIAL single-job submits: with a single-contig
+    workload (`--contigs 1`) the router splits the one contig by
+    window range across every routable replica, so the per-job wall
+    drops as replicas join — `range_scaling_x` (single-job wall at 1
+    replica over the wall at N) is that claim, reported whenever the
+    top point actually range-sharded. `--json` rides the curve out as
+    a `router` artifact block with `scaling_x` (jobs/s at N replicas
+    over jobs/s at 1) which tools/perfgate.py gates via
+    `router.identical` (always, when the block is present),
+    `--router-scaling-min` and `--range-scaling-min` (each mandatory
+    once requested)."""
     from racon_tpu.serve.queue import nearest_rank
     from racon_tpu.serve.router import PolishRouter
 
@@ -356,22 +438,21 @@ def run_router_bench(args, PolishClient, PolishServer) -> int:
         paths = build_dataset(tmp, args.genome_kb, args.coverage,
                               args.read_len, args.seed,
                               contigs=args.contigs)
-        servers, socks = [], []
+        procs, socks = [], []
         try:
             t0 = time.perf_counter()
             for k in range(n_max):
                 sock = os.path.join(tmp, f"rep{k}.sock")
-                srv = PolishServer(
-                    socket_path=sock, workers=args.workers, warmup=False,
-                    job_threads=args.threads,
-                    tpu_poa_batches=args.tpupoa_batches,
-                    tpu_aligner_batches=args.tpualigner_batches)
-                srv.warmup(paths=paths)
-                srv.start()
-                servers.append(srv)
+                procs.append(spawn_replica(sock, args))
                 socks.append(sock)
-            print(f"[servebench] {n_max} replica(s) warm in "
-                  f"{time.perf_counter() - t0:.2f}s", file=sys.stderr)
+            for sock in socks:
+                wait_replica(PolishClient, sock)
+                # one direct job warms this replica's engines on the
+                # bench's own shapes before anything is timed
+                PolishClient(socket_path=sock).submit(*paths)
+            print(f"[servebench] {n_max} replica subprocess(es) warm "
+                  f"in {time.perf_counter() - t0:.2f}s",
+                  file=sys.stderr)
             # the identity reference: one direct submit to a single
             # replica — every routed job must reproduce these bytes
             solo = PolishClient(socket_path=socks[0]).submit(*paths)
@@ -402,6 +483,24 @@ def run_router_bench(args, PolishClient, PolishServer) -> int:
                 for t in threads:
                     t.join()
                 wall = time.perf_counter() - t_wave
+                # sequential single-JOB latency: the number window-range
+                # sharding moves. The wave above measures fleet
+                # THROUGHPUT (more replicas, more concurrent jobs);
+                # these submits measure how much faster ONE job
+                # finishes when the router can split a contig by
+                # window range across every routable replica
+                seq_cl = PolishClient(
+                    socket_path=router.config.socket_path)
+                seq_walls: list[float] = []
+                r_seq = None
+                for _ in range(3):
+                    t_seq = time.perf_counter()
+                    r_seq = seq_cl.submit(*paths, retries=5)
+                    seq_walls.append(time.perf_counter() - t_seq)
+                    if r_seq.fasta != solo.fasta:
+                        fail.append(f"router x{c}: sequential job "
+                                    "FASTA diverged from the direct "
+                                    "single-replica bytes")
                 requeues = router.counters["requeues"]
                 router.drain(timeout=30)
                 done = [r for r in results if r is not None]
@@ -415,6 +514,7 @@ def run_router_bench(args, PolishClient, PolishServer) -> int:
                       for r in done
                       if r.router.get("wall_s")]
                 shards = [r.router.get("shards", 1) for r in done]
+                rb = r_seq.router if r_seq is not None else {}
                 pt = {"replicas": c, "jobs": args.jobs,
                       "completed": len(done),
                       "wall_s": round(wall, 3),
@@ -422,6 +522,10 @@ def run_router_bench(args, PolishClient, PolishServer) -> int:
                                           3),
                       "shards_mean": round(statistics.mean(shards), 2)
                       if shards else 0,
+                      "job_wall_s": round(min(seq_walls), 3)
+                      if seq_walls else None,
+                      "range": bool(rb.get("range")),
+                      "range_shards": rb.get("range_shards"),
                       "requeues": requeues,
                       "merge_overhead_pct": round(
                           nearest_rank(sorted(ov), 0.50), 2)
@@ -434,8 +538,11 @@ def run_router_bench(args, PolishClient, PolishServer) -> int:
                       f"{pt['shards_mean']:.1f} shards/job, "
                       f"merge overhead "
                       f"{pt['merge_overhead_pct'] or 0:.2f}%, "
-                      f"{requeues} requeues) "
-                      f"[{'OK' if identical else 'FAIL'} identity]",
+                      f"{requeues} requeues), single job "
+                      f"{pt['job_wall_s']:.2f}s"
+                      + (f" range-sharded x{pt['range_shards']}"
+                         if pt["range"] else "")
+                      + f" [{'OK' if identical else 'FAIL'} identity]",
                       file=sys.stderr)
                 if len(done) < args.jobs:
                     fail.append(f"router x{c}: only {len(done)}/"
@@ -448,8 +555,8 @@ def run_router_bench(args, PolishClient, PolishServer) -> int:
                                 "a healthy fleet (a replica dropped "
                                 "mid-shard)")
         finally:
-            for srv in servers:
-                srv.drain(timeout=30)
+            for proc in procs:
+                stop_replica(proc)
 
     scaling_x = (curve[-1]["jobs_per_s"]
                  / max(curve[0]["jobs_per_s"], 1e-9)) if curve else 0.0
@@ -458,6 +565,8 @@ def run_router_bench(args, PolishClient, PolishServer) -> int:
         "jobs": args.jobs,
         "curve": curve,
         "jobs_per_s": curve[-1]["jobs_per_s"] if curve else 0.0,
+        "job_wall_s": curve[-1]["job_wall_s"] if curve else None,
+        "range": bool(curve) and bool(curve[-1].get("range")),
         "requeues": sum(pt["requeues"] for pt in curve),
         "merge_overhead_pct": max(
             (pt["merge_overhead_pct"] for pt in curve
@@ -465,11 +574,30 @@ def run_router_bench(args, PolishClient, PolishServer) -> int:
         "identical": bool(curve) and all(pt["identical"]
                                          for pt in curve),
         "scaling_x": round(scaling_x, 3),
+        "device_latency_ms": args.device_latency_ms,
+        "device_latency_x": args.device_latency_x,
+        "host_poa_chunk": args.host_poa_chunk,
     }
     print(f"[servebench] router scaling: x{scaling_x:.2f} jobs/s at "
           f"{n_max} replica(s) vs 1 "
           f"({router_block['requeues']} requeues total)",
           file=sys.stderr)
+    # single-JOB scaling, reported only when the highest-count point
+    # actually range-sharded (a multi-contig workload at few replicas
+    # splits whole contigs instead — no sub-contig claim to make there)
+    if router_block["range"] and curve[0].get("job_wall_s"):
+        router_block["range_shards"] = curve[-1].get("range_shards")
+        router_block["range_scaling_x"] = round(
+            curve[0]["job_wall_s"]
+            / max(curve[-1]["job_wall_s"], 1e-9), 3)
+        print(f"[servebench] range scaling: one job "
+              f"x{router_block['range_scaling_x']:.2f} faster at "
+              f"{n_max} replica(s) vs 1 "
+              f"({curve[0]['job_wall_s']:.2f}s -> "
+              f"{curve[-1]['job_wall_s']:.2f}s, "
+              f"{router_block['range_shards']} window-range shards — "
+              "perfgate gates router.range_scaling_x)",
+              file=sys.stderr)
     if args.json:
         artifact = {"mode": "router", "jobs": args.jobs,
                     "router": router_block, "pass": not fail}
@@ -851,6 +979,272 @@ def run_flood_bench(args, PolishClient, PolishServer) -> int:
     return 0
 
 
+def run_ramp_bench(args, PolishClient, PolishServer) -> int:
+    """`--ramp N`: elastic autoscaling under a ramped open-loop load.
+    The fabric starts at ONE warm replica behind the router with the
+    autoscaler (serve/autoscale.py) armed, ceiling N. Poisson arrivals
+    ramp the offered rate linearly from 1x to 10x over the wave — the
+    1x base rate sits well inside one replica's capacity (measured, or
+    `--ramp-qps0`), the 10x peak far outside it, so the loop MUST
+    scale up to hold latency. Every job's FASTA must equal a direct
+    submit's bytes (with a single-contig workload the scaled-up points
+    exercise window-range sharding on every job).
+
+    After the ramp a slow trickle keeps jobs arriving while the idle
+    fleet scales back down to the 1-replica floor: a job lost in that
+    phase is the scale-down race the unroute-then-drain handshake
+    exists to prevent. The bench FAILS on any lost job, any byte
+    divergence, a ramp that never scaled up, or a fleet that did not
+    drain back to the floor. `--json` writes a `"mode": "ramp"`
+    artifact whose `autoscale` block (replicas over time, scale
+    up/down counts, gold p99 idle vs ramp as `gold_p99_flat`,
+    `jobs_lost`) tools/perfgate.py gates via `autoscale.jobs_lost`
+    == 0 (always, when the block is present) and
+    `autoscale.gold_p99_flat` (default 2.0; `--ramp-p99-flat-max`
+    makes it mandatory)."""
+    import random
+
+    from racon_tpu.serve.autoscale import AutoscaleConfig, Autoscaler
+    from racon_tpu.serve.queue import nearest_rank
+    from racon_tpu.serve.router import PolishRouter
+
+    n_max = max(2, args.ramp)
+    n_jobs = max(8, args.ramp_jobs)
+    fail: list[str] = []
+    samples: list[dict] = []
+    with tempfile.TemporaryDirectory(prefix="racon_rampbench_") as tmp:
+        print(f"[servebench] ramp bench: 1->{n_max} replicas, "
+              f"{n_jobs} Poisson jobs ramping 1x->10x", file=sys.stderr)
+        paths = build_dataset(tmp, args.genome_kb, args.coverage,
+                              args.read_len, args.seed,
+                              contigs=args.contigs)
+        # one warm base replica + warm SPARES on the exact spec sockets
+        # the autoscaler will ask for (autoscale_1.sock, ...) — all
+        # real subprocesses (one GIL per replica), so a scale-up adds
+        # genuine capacity and its latency is the healthz handshake,
+        # not an interpreter start or a compile
+        t0 = time.perf_counter()
+        base_sock = os.path.join(tmp, "ramp_base.sock")
+        base = spawn_replica(base_sock, args)
+        pool: dict = {}
+        for i in range(1, n_max):
+            spec = os.path.join(tmp, f"autoscale_{i}.sock")
+            pool[spec] = spawn_replica(spec, args)
+        for sock in [base_sock, *pool]:
+            wait_replica(PolishClient, sock)
+            PolishClient(socket_path=sock).submit(*paths)  # warm it
+        print(f"[servebench] base + {len(pool)} warm spare "
+              f"subprocess(es) in {time.perf_counter() - t0:.2f}s",
+              file=sys.stderr)
+        router = PolishRouter(
+            replicas=base_sock,
+            socket_path=os.path.join(tmp, "ramp_router.sock"),
+            journal=os.path.join(tmp, "ramp_router.jsonl"),
+            # under ramped CONCURRENT load, unbounded range fan-out
+            # couples every job to every replica (one busy replica
+            # gates all merges); two shards per job keeps the
+            # sub-contig speedup while the fleet spreads whole jobs
+            max_shards=2,
+            health_interval_s=0.25).start()
+        live: dict = {}
+
+        def spawn(spec):
+            proc = pool.pop(spec, None)
+            if proc is None:  # past the prebuilt pool: cold spawn
+                proc = spawn_replica(spec, args)
+            live[spec] = proc
+            return spec
+
+        def stop(handle):
+            proc = live.pop(handle, None)
+            if proc is not None:
+                stop_replica(proc)
+
+        scaler = None
+        try:
+            client = PolishClient(
+                socket_path=router.config.socket_path)
+            # identity reference; also seeds the service-time EMA
+            solo = client.submit(*paths, tenant="gold")
+            # idle gold baseline on the 1-replica floor
+            idle: list[float] = []
+            for _ in range(3):
+                t = time.perf_counter()
+                r = client.submit(*paths, tenant="gold")
+                idle.append(time.perf_counter() - t)
+                if r.fasta != solo.fasta:
+                    fail.append("idle-baseline FASTA diverged")
+            p99_idle = nearest_rank(sorted(idle), 0.99)
+            qps0 = args.ramp_qps0 or \
+                0.35 / max(statistics.mean(idle), 1e-9)
+            print(f"[servebench] idle gold p99 {p99_idle:.2f}s; "
+                  f"offered rate {qps0:.2f} -> {qps0 * 10:.2f} jobs/s",
+                  file=sys.stderr)
+
+            scaler = Autoscaler(
+                router,
+                config=AutoscaleConfig(
+                    min_replicas=1, max_replicas=n_max,
+                    # latency-biased posture: any sustained backlog
+                    # beyond one job per replica scales up (the warm
+                    # spare pool makes an up cheap); idle still drains
+                    # fast enough to exercise scale-down under the
+                    # live trickle below
+                    interval_s=0.2, up_pressure=1.1, up_sustain_s=0.3,
+                    down_idle_s=2.0, cooldown_s=1.0, socket_dir=tmp,
+                    ready_timeout_s=30.0,
+                    # hold_s > job wall: a burst arrival holds for the
+                    # replica its own pressure spawns instead of
+                    # serializing behind a committed sibling
+                    hold_s=10.0),
+                spawn=spawn, stop=stop).start()
+
+            # replicas-over-time sampler: the artifact's scaling trace
+            stop_sampling = threading.Event()
+            t_wave0 = time.perf_counter()
+
+            def sample():
+                while not stop_sampling.is_set():
+                    snap = scaler.snapshot()
+                    samples.append(
+                        {"t_s": round(time.perf_counter() - t_wave0, 2),
+                         "replicas": 1 + snap["spawned"],
+                         "pressure": round(snap["pressure"], 2)})
+                    stop_sampling.wait(0.25)
+
+            sampler = threading.Thread(target=sample, daemon=True)
+            sampler.start()
+
+            # the ramp wave: Poisson arrivals, rate climbing 1x -> 10x
+            rng = random.Random(args.seed)
+            lat: list = [None] * n_jobs
+            lost: list[str] = []
+
+            arrive: list = [None] * n_jobs
+            shards: list = [None] * n_jobs
+
+            def submit(i):
+                t = time.perf_counter()
+                arrive[i] = t - t_wave0
+                try:
+                    r = PolishClient(
+                        socket_path=router.config.socket_path).submit(
+                            *paths, tenant="gold", retries=8)
+                except Exception as exc:  # noqa: BLE001
+                    lost.append(f"ramp job {i}: "
+                                f"{type(exc).__name__}: {exc}")
+                    return
+                lat[i] = time.perf_counter() - t
+                rb = r.router or {}
+                shards[i] = rb.get("shards")
+                if r.fasta != solo.fasta:
+                    fail.append(f"ramp job {i} FASTA diverged")
+
+            threads = []
+            for i in range(n_jobs):
+                rate = qps0 * (1.0 + 9.0 * i / max(n_jobs - 1, 1))
+                time.sleep(rng.expovariate(rate))
+                th = threading.Thread(target=submit, args=(i,))
+                th.start()
+                threads.append(th)
+            for th in threads:
+                th.join()
+            ramp_done = sorted(v for v in lat if v is not None)
+            p99_ramp = (nearest_rank(ramp_done, 0.99) if ramp_done
+                        else float("inf"))
+            ups = scaler.snapshot()["scale_ups"]
+            peak = max((s["replicas"] for s in samples), default=1)
+            print(f"[servebench] ramp: {len(ramp_done)}/{n_jobs} jobs, "
+                  f"gold p99 {p99_ramp:.2f}s, {ups} scale-up(s), "
+                  f"peak {peak} replicas", file=sys.stderr)
+
+            # scale-down under a live trickle: jobs keep arriving
+            # slowly while the idle fleet drains back to the floor
+            trickle_n = n_max + 1
+            for i in range(trickle_n):
+                time.sleep(3.0)
+                try:
+                    r = client.submit(*paths, tenant="gold", retries=8)
+                    if r.fasta != solo.fasta:
+                        fail.append(f"trickle job {i} FASTA diverged")
+                except Exception as exc:  # noqa: BLE001
+                    lost.append(f"trickle job {i}: "
+                                f"{type(exc).__name__}: {exc}")
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline and scaler.spawned:
+                time.sleep(0.25)
+            snap = scaler.snapshot()
+            drained = snap["spawned"] == 0
+            stop_sampling.set()
+            sampler.join(timeout=5)
+        finally:
+            if scaler is not None:
+                scaler.close()
+            router.drain(timeout=30)
+            stop_replica(base)
+            for proc in [*live.values(), *pool.values()]:
+                stop_replica(proc)
+
+    jobs_lost = len(lost)
+    for msg in lost:
+        fail.append(f"job lost: {msg}")
+    if snap["scale_ups"] < 1:
+        fail.append("the ramp never scaled up — the offered load "
+                    "stayed inside one replica (raise --ramp-jobs or "
+                    "lower --ramp-qps0)")
+    if snap["scale_downs"] < 1 or not drained:
+        fail.append(f"the fleet did not drain back to the floor "
+                    f"({snap['spawned']} spawned replica(s) left, "
+                    f"{snap['scale_downs']} scale-down(s))")
+    flat = round(p99_ramp / max(p99_idle, 1e-9), 3)
+    autoscale_block = {
+        "replicas_min": 1,
+        "replicas_max": n_max,
+        "jobs": n_jobs,
+        "completed": len(ramp_done),
+        "jobs_lost": jobs_lost,
+        "qps0": round(qps0, 3),
+        "qps_peak": round(qps0 * 10.0, 3),
+        "scale_ups": snap["scale_ups"],
+        "scale_downs": snap["scale_downs"],
+        "spawn_failures": snap["spawn_failures"],
+        "drained_to_min": drained,
+        "trickle_jobs": trickle_n,
+        "gold_p99_idle_s": round(p99_idle, 3),
+        "gold_p99_ramp_s": round(p99_ramp, 3),
+        "gold_p99_flat": flat,
+        "replicas_over_time": samples,
+        # the per-job trace behind the p99: arrival offset into the
+        # wave, end-to-end latency, shards the router planned
+        "ramp_jobs": [
+            {"i": i,
+             "arrive_s": round(arrive[i], 2) if arrive[i] else None,
+             "lat_s": round(lat[i], 2) if lat[i] else None,
+             "shards": shards[i]}
+            for i in range(n_jobs)],
+        "device_latency_ms": args.device_latency_ms,
+        "device_latency_x": args.device_latency_x,
+        "host_poa_chunk": args.host_poa_chunk,
+    }
+    print(f"[servebench] autoscale: {snap['scale_ups']} up / "
+          f"{snap['scale_downs']} down, {jobs_lost} jobs lost, gold "
+          f"p99 idle {p99_idle:.2f}s vs ramp {p99_ramp:.2f}s "
+          f"(x{flat:.2f} — perfgate gates autoscale.gold_p99_flat)",
+          file=sys.stderr)
+    if args.json:
+        artifact = {"mode": "ramp", "jobs": n_jobs,
+                    "autoscale": autoscale_block, "pass": not fail}
+        with open(args.json, "w") as fh:
+            json.dump(artifact, fh, indent=2, sort_keys=True)
+        print(f"[servebench] wrote {args.json}", file=sys.stderr)
+    if fail:
+        for f in fail:
+            print(f"[servebench] FAIL: {f}", file=sys.stderr)
+        return 1
+    print("[servebench] PASS", file=sys.stderr)
+    return 0
+
+
 def run_openloop(client, paths, qps: float, n_jobs: int,
                  seed: int) -> dict:
     """One open-loop wave: Poisson arrivals at `qps`, every job
@@ -1024,6 +1418,47 @@ def main(argv=None) -> int:
                          "(gold_p99_flat, doomed_abort_saved_s) that "
                          "tools/perfgate.py gates via qos.gold_p99_flat "
                          "and --doomed-abort-min")
+    ap.add_argument("--ramp", type=int, default=None,
+                    help="ramp bench mode: Poisson offered load "
+                         "ramping 1x->10x through a routed fabric "
+                         "that starts at ONE replica with the elastic "
+                         "autoscaler (serve/autoscale.py) armed, "
+                         "ceiling at this many replicas — the "
+                         "artifact gains an `autoscale` block "
+                         "(replicas over time, scale up/down counts, "
+                         "gold p99 idle vs ramp, jobs_lost) that "
+                         "tools/perfgate.py gates via "
+                         "autoscale.jobs_lost == 0 and "
+                         "autoscale.gold_p99_flat")
+    ap.add_argument("--ramp-jobs", type=int, default=24,
+                    help="ramp mode: jobs across the ramp (default 24)")
+    ap.add_argument("--device-latency-ms", type=float, default=0.0,
+                    help="fleet modes (--router / --ramp): arm "
+                         "RACON_TPU_DEVICE_LATENCY_S in every replica "
+                         "subprocess — a simulated per-chunk accelerator "
+                         "round-trip of this many ms, slept off-CPU, so "
+                         "the bench measures device-dominated scaling "
+                         "(the production posture) instead of being "
+                         "bound by this host's core count; recorded in "
+                         "the artifact as device_latency_ms")
+    ap.add_argument("--device-latency-x", type=float, default=0.0,
+                    help="fleet modes: arm RACON_TPU_DEVICE_LATENCY_X "
+                         "in every replica subprocess — each pipeline "
+                         "chunk's dispatch is followed by an off-CPU "
+                         "sleep of this many times its measured "
+                         "duration (a simulated device whose round-trip "
+                         "scales with batch size); recorded in the "
+                         "artifact as device_latency_x")
+    ap.add_argument("--host-poa-chunk", type=int, default=0,
+                    help="fleet modes: arm RACON_TPU_HOST_POA_CHUNK in "
+                         "every replica subprocess — windows per host "
+                         "POA batch call (default 4096), shrunk so "
+                         "--device-latency-ms paces proportionally to "
+                         "each job's window count")
+    ap.add_argument("--ramp-qps0", type=float, default=None,
+                    help="ramp mode: the 1x starting arrival rate in "
+                         "jobs/s (default: 0.35x the measured "
+                         "single-replica capacity)")
     ap.add_argument("--fleet-poll-s", type=float, default=0.25,
                     help="fleet mode: aggregator poll interval during "
                          "the wave (default 0.25s)")
@@ -1083,6 +1518,9 @@ def main(argv=None) -> int:
 
     if args.flood is not None:
         return run_flood_bench(args, PolishClient, PolishServer)
+
+    if args.ramp is not None:
+        return run_ramp_bench(args, PolishClient, PolishServer)
 
     cold_n = args.cold_runs if args.cold_runs is not None \
         else min(args.jobs, 3)

@@ -38,7 +38,11 @@ scrapes through obs/fleet.py, and redraws one screen per poll:
   - a QOS suffix on the fleet line (rendered only once some replica
     arms preemption / abort margin / burst tokens or fires a QoS
     event): lifetime preemptions / doomed-aborts / cancels, with
-    [PREEMPT n] while n jobs are parked by preemption right now.
+    [PREEMPT n] while n jobs are parked by preemption right now;
+  - an AUTOSCALE suffix on the fleet line (rendered only when a polled
+    router armed the elastic-fleet loop, serve/autoscale.py): lifetime
+    scale-up/scale-down counts, the last polled backlog pressure, and
+    [SCALED +n] while n autoscaler-spawned replicas are alive.
 
 On a TTY the screen redraws in place; on a pipe it degrades to one
 summary line per poll (greppable, CI-friendly). `--once` polls once
@@ -187,7 +191,8 @@ def fleet_line(snap, burn: dict, prev: dict, dt: float) -> str:
             f"  iters {int(iters)} ({rate:.1f}/s)"
             f"  compiles {int(snap.counters.get(G + 'compiles_total', 0))}"
             + _fleet_audit(snap) + _fleet_rounds(snap)
-            + _fleet_preempt(snap) + _fleet_router(snap))
+            + _fleet_preempt(snap) + _fleet_router(snap)
+            + _fleet_autoscale(snap))
 
 
 def _fleet_audit(snap) -> str:
@@ -255,6 +260,26 @@ def _fleet_router(snap) -> str:
             + (f" ({draining} drn)" if draining else "")
             + f"  requeued {requeued}"
             + ("  [REQUEUED]" if requeued else ""))
+
+
+def _fleet_autoscale(snap) -> str:
+    """Elastic-fleet suffix (empty unless a polled router armed the
+    autoscaler, serve/autoscale.py — the families are armed-only):
+    lifetime scale-ups/scale-downs, the last polled backlog pressure
+    (queued+inflight jobs per routable replica), and [SCALED +n] while
+    n autoscaler-owned replicas are alive right now."""
+    if "racon_tpu_router_autoscale_spawned" not in snap.gauges:
+        return ""
+    ups = int(snap.counters.get(
+        "racon_tpu_router_autoscale_scale_ups", 0))
+    downs = int(snap.counters.get(
+        "racon_tpu_router_autoscale_scale_downs", 0))
+    spawned = int(snap.gauges.get(
+        "racon_tpu_router_autoscale_spawned", 0))
+    pressure = snap.gauges.get("racon_tpu_router_autoscale_pressure",
+                               0.0)
+    return (f"  autoscale {ups}u/{downs}d pressure {pressure:g}"
+            + (f"  [SCALED +{spawned}]" if spawned else ""))
 
 
 def render_screen(snap, burn: dict, rows: list[dict], prev: dict,
