@@ -36,8 +36,12 @@ usage: racon_tpu [options ...] <sequences> <overlaps> <target sequences>
                 queue position), `--stream` writes each polished
                 contig the moment it finishes on the server,
                 `--tenant` names the fair-scheduling bucket, and
-                `--trace-out t.json` writes one merged client+server
-                Chrome trace of the request
+                `--trace-out t.json` writes one merged Chrome trace of
+                the request — through the router, a DISTRIBUTED trace:
+                client, router and every participating replica as
+                clock-synced process tracks in one artifact
+                (`tools/tracereport.py` prints its critical path and
+                per-stage cost attribution)
         cancel  cancel a queued or running job by --job-id or
                 --trace-id (name jobs via `submit --trace-id`): queued
                 jobs dequeue with a typed `cancelled` error to their
@@ -57,7 +61,12 @@ usage: racon_tpu [options ...] <sequences> <overlaps> <target sequences>
                 replica, and --autoscale arms the elastic-fleet loop
                 that spawns/drains replicas with backlog pressure
                 (README "Serving"; RACON_TPU_ROUTER_* env knobs,
-                RACON_TPU_ROUTER_AUTOSCALE_* for the loop)
+                RACON_TPU_ROUTER_AUTOSCALE_* for the loop); the router
+                keeps its own flight ring of plan/dispatch/merge spans
+                — `--trace` (or RACON_TPU_ROUTER_TRACE) dumps it at
+                drain, and a traced submit pulls every replica's spans
+                into ONE merged trace (README "Distributed tracing &
+                cost accounting")
         fleet   federate N replicas' metrics and health into one view:
                 polls every endpoint in --endpoints /
                 RACON_TPU_FLEET_ENDPOINTS, merges counters and latency

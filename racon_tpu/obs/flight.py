@@ -43,11 +43,16 @@ import os
 import threading
 from collections import deque
 
-from .trace import TraceRecorder
+from .trace import TraceRecorder, trace_matches
 
 #: default total ring capacity (events); a span dict is ~200 bytes, so
 #: the default bounds the recorder around ~1 MB for the process lifetime
 DEFAULT_CAPACITY = 4096
+
+#: default cap on events a single `trace_pull` RPC returns
+#: (RACON_TPU_TRACE_PULL_EVENTS) — a routed job only needs its own
+#: window of the ring, and the reply rides one length-prefixed frame
+DEFAULT_PULL_EVENTS = 2048
 
 
 def ring_capacity() -> int:
@@ -100,6 +105,37 @@ class FlightRecorder(TraceRecorder):
                     self._threads[tid] = name
             self._local.tid = tid
         return self._ring
+
+
+def trace_pull_max_events() -> int:
+    try:
+        n = int(os.environ.get("RACON_TPU_TRACE_PULL_EVENTS", 0))
+    except ValueError:
+        n = 0
+    return n if n > 0 else DEFAULT_PULL_EVENTS
+
+
+def trace_events(recorder: TraceRecorder,
+                 trace_id: str | list[str] | tuple[str, ...],
+                 max_events: int | None = None) -> list[dict]:
+    """The ring windowed to ONE distributed trace: spans/instants whose
+    args carry `trace_id` (exact or dotted child `<trace>.s<k>` match,
+    including lane-iteration `trace_ids` lists), plus every thread-name
+    metadata event so track labels survive the pull. A list of ids
+    selects the union — the router pulls each replica for exactly the
+    child traces that completed there. Oldest events are trimmed past
+    `max_events` (metadata kept) — the trace_pull RPC's bounded-reply
+    guarantee."""
+    cap = max_events if max_events and max_events > 0 else trace_pull_max_events()
+    tids = ((trace_id,) if isinstance(trace_id, str) else
+            tuple(trace_id))
+    meta, hits = [], []
+    for ev in recorder.events():
+        if ev.get("ph") == "M":
+            meta.append(ev)
+        elif any(trace_matches(ev.get("args"), t) for t in tids):
+            hits.append(ev)
+    return meta + hits[-cap:]
 
 
 def window_events(recorder: TraceRecorder,

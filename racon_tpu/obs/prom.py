@@ -285,6 +285,15 @@ class Scrape:
             hs._hists[name] = self.histogram(name)
         return hs
 
+    def series_sum(self, name: str, kind: str = "counter") -> float:
+        """Sum of one labeled family's sample values across every label
+        set — the 'family total' view cost-accounting invariants check
+        (e.g. per-tenant device-seconds summing to total lane device
+        seconds). Zero when the family is absent."""
+        series = (self.counter_series if kind == "counter"
+                  else self.gauge_series).get(name) or {}
+        return sum(v for _, v in series.values())
+
 
 _VALUE = r"[^\s#]+"
 _LINE_RE = re.compile(

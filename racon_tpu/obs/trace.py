@@ -315,6 +315,26 @@ def rebase_events(events: list[dict], pid: int, shift_us: float = 0.0,
     return out
 
 
+def trace_matches(args: dict | None, trace_id: str) -> bool:
+    """True when a span/instant's args tie it to `trace_id` or to one of
+    its descendants — the router's child shards carry dotted ids
+    (`<trace>.s<k>`), so a match is exact OR by dotted prefix. Two arg
+    shapes exist in the fabric: per-job spans carry a single `trace_id`
+    string, batched lane iterations carry a `trace_ids` list (one entry
+    per co-scheduled job); either side matching counts."""
+    if not args:
+        return False
+
+    def _hit(t) -> bool:
+        return isinstance(t, str) and (
+            t == trace_id or t.startswith(trace_id + "."))
+
+    if _hit(args.get("trace_id")):
+        return True
+    tids = args.get("trace_ids")
+    return isinstance(tids, (list, tuple)) and any(_hit(t) for t in tids)
+
+
 def span(name: str, **args):
     """Convenience span: a real recording context when tracing is armed,
     a shared no-op otherwise."""

@@ -123,7 +123,7 @@ class _Ticket:
     __slots__ = ("polisher", "key", "event", "error",
                  "total", "remaining", "done", "iterations",
                  "iteration_ids", "shared_iterations", "compiles",
-                 "compile_s", "device_s", "_delivery")
+                 "compile_s", "device_s", "device_share_s", "_delivery")
 
     def __init__(self, polisher, key):
         from .queue import DeliveryQueue
@@ -140,6 +140,11 @@ class _Ticket:
         self.compiles = 0
         self.compile_s = 0.0
         self.device_s = 0.0
+        #: this job's PRORATED slice of shared iteration wall (its
+        #: window count over the iteration's total) — the cost-
+        #: accounting number, vs device_s which charges each rider the
+        #: FULL iteration wall (the latency number)
+        self.device_share_s = 0.0
         #: finished-window handoff feeder -> job thread; the queue owns
         #: the completion flag and the wakeup discipline (see
         #: queue.DeliveryQueue — a bare event.set() would leave the
@@ -163,13 +168,21 @@ class _Ticket:
         return self._delivery.take(timeout)
 
     def batch_info(self, solo: bool = False) -> dict:
-        return {"iterations": self.iterations,
+        info = {"iterations": self.iterations,
                 "iteration_ids": list(self.iteration_ids),
                 "shared_iterations": self.shared_iterations,
                 "windows": self.total, "solo": solo,
                 "compiles": self.compiles,
                 "compile_s": round(self.compile_s, 3),
                 "device_s": round(self.device_s, 4)}
+        tenant = getattr(self.polisher, "serve_tenant", None)
+        if tenant:
+            # armed-only: tenanted jobs carry their prorated device
+            # cost in the result frame; untenanted frames stay
+            # byte-identical to the pre-accounting wire shape
+            info["tenant"] = tenant
+            info["device_share_s"] = round(self.device_share_s, 4)
+        return info
 
 
 class _IterProgress:
@@ -394,6 +407,17 @@ class WindowBatcher:
                          "audit_s": 0.0,
                          "lane_quarantines": 0, "lane_rejoins": 0,
                          "lane_reprobes": 0}
+        #: per-tenant device-seconds: each iteration's wall prorated
+        #: onto the tenants whose windows rode it (window count over
+        #: the iteration total — the shares of one iteration sum to
+        #: its wall by construction, so the buckets sum to total lane
+        #: busy seconds). The "" bucket is untenanted traffic.
+        self._tenant_device: dict[str, float] = {}
+
+    def _accrue_tenant_device(self, tenant: str, share_s: float) -> None:
+        with self._cond:
+            self._tenant_device[tenant] = (
+                self._tenant_device.get(tenant, 0.0) + share_s)
 
     # ------------------------------------------------------------ entry
     def consensus(self, polisher, on_windows=None) -> None:
@@ -438,6 +462,20 @@ class WindowBatcher:
             # and a caught window is repaired before delivery
             self._audit([(w, polisher) for w in polisher.windows],
                         lane, it)
+            # a solo iteration is still an iteration to the trace
+            # plane: without this span a traced fault-plan job's
+            # device seconds would be invisible to tracereport's
+            # span-sums-vs-stage_stats check (host_s unmeasured on
+            # the isolation path — the whole wall bills as device)
+            tr = trace.get_tracer()
+            if tr is not None:
+                tid = getattr(polisher, "serve_trace_id", None)
+                tr.complete("serve.iteration", t0, t1,
+                            {"iteration": it, "lane": lane.index,
+                             "jobs": 1,
+                             "windows": len(polisher.windows),
+                             "solo": True, "host_s": 0.0,
+                             "trace_ids": [tid] if tid else []})
             if self.hists is not None:
                 self.hists.observe("serve.iteration", t1 - t0)
             self._account(1, len(polisher.windows), solo=True)
@@ -445,6 +483,11 @@ class WindowBatcher:
             ticket.iterations = 1
             ticket.iteration_ids = [it]
             ticket.device_s = t1 - t0
+            # a solo iteration has exactly one rider: its full wall IS
+            # that tenant's prorated cost
+            ticket.device_share_s = t1 - t0
+            self._accrue_tenant_device(
+                getattr(polisher, "serve_tenant", None) or "", t1 - t0)
             polisher.serve_batch = ticket.batch_info(solo=True)
             if on_windows is not None:
                 on_windows(list(polisher.windows))
@@ -957,6 +1000,14 @@ class WindowBatcher:
             ticket.compiles += post_c - pre_c
             ticket.compile_s += post_s - pre_s
             ticket.device_s += t1 - t0
+            # cost proration: this job's slice of the iteration wall is
+            # its window share (the slices of one iteration sum to its
+            # wall, so tenant buckets sum to total lane busy seconds)
+            share = (t1 - t0) * len(ws) / len(windows)
+            ticket.device_share_s += share
+            self._accrue_tenant_device(
+                getattr(ticket.polisher, "serve_tenant", None) or "",
+                share)
             ticket.done += len(ws)
             ticket.remaining -= len(ws)
             # iteration boundary: every participant's bar reaches its
@@ -1281,6 +1332,14 @@ class WindowBatcher:
                 out["withdrawn_jobs"] = len(self._withdrawn)
                 out["parked_windows"] = sum(
                     len(v) for v in self._parked.values())
+            # armed-only: appears once any NAMED tenant has accrued
+            # device time (the "" bucket alone is untenanted traffic
+            # and stays invisible, keeping flagless snapshots and
+            # scrapes byte-identical)
+            if any(t for t in self._tenant_device):
+                out["tenant_device_s"] = {
+                    t: round(v, 4)
+                    for t, v in sorted(self._tenant_device.items())}
         stats = self._merged_stats()
         compiles, compile_s = self._compile_totals(stats)
         out["compiles"] = compiles

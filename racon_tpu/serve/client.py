@@ -135,8 +135,8 @@ _ERROR_TYPES = {"queue-full": QueueFull, "draining": ServerDraining,
 
 class PolishResult:
     __slots__ = ("job_id", "fasta", "metrics", "serve", "trace",
-                 "trace_base_mono", "streamed", "parts", "router",
-                 "rounds")
+                 "trace_base_mono", "trace_replicas", "streamed",
+                 "parts", "router", "rounds")
 
     def __init__(self, resp: dict):
         self.job_id = resp.get("job_id")
@@ -166,6 +166,11 @@ class PolishResult:
         #: the server-side recorder's time zero in SERVER perf_counter
         #: terms — merge_trace() needs it to rebase server spans
         self.trace_base_mono = resp.get("trace_base_mono")
+        #: routed trace collection (router._attach_trace): one entry
+        #: per participating replica — {replica, events, base_mono,
+        #: offset_s (replica clock relative to the ROUTER), rtt_s};
+        #: None for direct submits and untraced routed jobs
+        self.trace_replicas = resp.get("trace_replicas")
 
 
 class PolishClient:
@@ -479,23 +484,63 @@ def merge_trace(result: PolishResult, client_rec, clock: dict,
     lands at server_mono - offset on the client's perf_counter, then
     rebases onto the client recorder's zero. Accuracy is the handshake's
     ±rtt/2 — microseconds on localhost, which is what the transports
-    here are."""
+    here are.
+
+    Routed jobs extend the same construction fleet-wide: pid 2 is the
+    ROUTER (its plan/dispatch/stream/merge spans), and every entry the
+    router pulled into `result.trace_replicas` becomes its own process
+    track on pid 3+. A replica event's clock chains TWO handshakes —
+    replica→router (`offset_s`, measured by the router) and
+    router→client (`clock`) — so all tracks land on the client
+    timeline and the per-hop rtt brackets simply add. `trace_context`
+    carries the per-replica clock metadata plus a `stats` snapshot
+    (serve / router / rounds blocks), which is what
+    tools/tracereport.py checks span sums against."""
     from ..obs.trace import rebase_events
 
     events = rebase_events(client_rec.events(), pid=1,
                            name="racon_tpu client")
+    routed = bool(result.router)
     if result.trace and result.trace_base_mono is not None:
         shift_us = ((result.trace_base_mono - clock["offset_s"])
                     - client_rec._base) * 1e6
-        events += rebase_events(result.trace, pid=2, shift_us=shift_us,
-                                name="racon_tpu server")
+        events += rebase_events(
+            result.trace, pid=2, shift_us=shift_us,
+            name="racon_tpu router" if routed else "racon_tpu server")
+    ctx_replicas = []
+    for i, rep in enumerate(result.trace_replicas or []):
+        base = rep.get("base_mono")
+        if base is None:
+            continue
+        off = float(rep.get("offset_s") or 0.0)
+        # replica mono -> router mono (-off) -> client mono (-clock
+        # offset), then onto the client recorder's zero
+        shift_us = ((base - off - clock["offset_s"])
+                    - client_rec._base) * 1e6
+        events += rebase_events(
+            rep.get("events") or [], pid=3 + i, shift_us=shift_us,
+            name=f"racon_tpu replica {rep.get('replica')}")
+        ctx_replicas.append({"replica": rep.get("replica"),
+                             "offset_s": rep.get("offset_s"),
+                             "rtt_s": rep.get("rtt_s")})
     events.sort(key=lambda e: (e.get("ph") != "M", e.get("ts", 0.0)))
+    ctx = {"trace_id": trace_id,
+           "job_id": result.job_id,
+           "clock_offset_s": round(clock["offset_s"], 6),
+           "clock_rtt_s": round(clock["rtt_s"], 6)}
+    if ctx_replicas:
+        ctx["replicas"] = ctx_replicas
+    stats: dict = {}
+    if result.serve:
+        stats["serve"] = result.serve
+    if result.router:
+        stats["router"] = result.router
+    if result.rounds:
+        stats["rounds"] = result.rounds
+    if stats:
+        ctx["stats"] = stats
     return {"traceEvents": events, "displayTimeUnit": "ms",
-            "trace_context": {
-                "trace_id": trace_id,
-                "job_id": result.job_id,
-                "clock_offset_s": round(clock["offset_s"], 6),
-                "clock_rtt_s": round(clock["rtt_s"], 6)}}
+            "trace_context": ctx}
 
 
 class _ProgressPrinter:

@@ -24,12 +24,13 @@ Malformed-input discipline (the server must outlive every bad client):
     answered — the handler cleans up the connection quietly.
 
 Request types: ``submit`` / ``ping`` / ``stats`` / ``healthz`` /
-``scrape`` / ``debug`` / ``cancel`` / ``shutdown``. Response types:
-``result`` /
+``scrape`` / ``debug`` / ``trace_pull`` / ``cancel`` / ``shutdown``.
+Response types: ``result`` /
 ``pong`` / ``stats`` / ``healthz`` (``ok`` false while draining — the
 RPC twin of the HTTP endpoint's 503) / ``metrics`` (Prometheus text in
 ``text``) / ``debug``
-(flight-recorder events + dump paths) / ``ok`` / ``error`` (with a
+(flight-recorder events + dump paths) / ``trace`` (flight-ring spans
+windowed to one trace id) / ``ok`` / ``error`` (with a
 machine-readable ``code``; ``queue-full`` errors carry ``retry_after``
 seconds, ``job-failed`` errors carry ``error_type`` from the errors.py
 taxonomy).
@@ -100,6 +101,29 @@ top-level submit keys are ignored by contract). A router's
 ``result_part`` frames add a ``shard`` field and renumber ``part``
 globally in contig order; its final ``result`` adds a ``router`` block
 (``shards`` / ``requeues`` / ``parts`` / ``wall_s``).
+
+Distributed tracing (README "Distributed tracing & cost accounting"):
+a ``trace_pull`` request carries ``trace_id`` (trace-id charset; a
+parent id matches its dotted ``<trace>.s<k>`` children too) and an
+optional ``max_events`` cap (RACON_TPU_TRACE_PULL_EVENTS, default
+2048); the ``trace`` response carries ``events`` (the replica's
+always-on flight-ring spans windowed to that trace), ``base_mono``
+(the ring recorder's time zero in that process's ``perf_counter``
+terms, ``null`` when no ring is installed) and a fresh ``mono_s``
+sample. Child submits deliberately do NOT carry ``trace: true`` —
+replica spans come from the always-on ring via ``trace_pull``, never
+from a per-job scoped recorder (which would serialize same-replica
+shards). A ROUTED submit with ``trace: true`` answers with ``trace`` /
+``trace_base_mono`` holding the ROUTER's own spans (plan / dispatch
+with held-for-idle time / stream / merge / requeue / cancel fan-out),
+``trace_replicas`` — one ``{replica, events, base_mono, offset_s,
+rtt_s}`` entry per participating replica, clock-synced against the
+router via the ping ``mono_s`` min-RTT bracket — and a per-shard
+``shards_detail`` list inside the ``router`` block (queue_wait_s /
+exec_s / batch per shard, the stage-stats side of tracereport's
+span-sums consistency check). All three keys appear ONLY on traced
+submits; untraced routed frames are byte-identical to the pre-tracing
+wire shape.
 
 Window-range child jobs (sub-contig sharding): when routable replicas
 outnumber contigs, the router also splits single contigs by target

@@ -103,6 +103,7 @@ import threading
 import time
 
 from ..errors import RaconError
+from ..obs import flight as obs_flight
 from ..obs import prom as obs_prom
 from ..obs.fleet import FleetAggregator
 from ..obs.journal import Journal
@@ -123,7 +124,7 @@ ROUTER_EVENTS = frozenset((
     "part-routed", "requeued", "replica-down", "replica-up",
     "cancelled", "siblings-cancelled", "range-plan",
     "replica-added", "replica-removed", "autoscale-up",
-    "autoscale-down"))
+    "autoscale-down", "hold"))
 
 #: trace-id charset (mirrors PolishServer._TRACE_ID_OK — "." is legal,
 #: which is what makes the `<parent>.s<k>` child ids valid replica-side)
@@ -222,6 +223,15 @@ class RouterConfig:
         self.probe_timeout_s = (
             float(pt) if pt is not None
             else _env_float("RACON_TPU_ROUTER_PROBE_TIMEOUT", 2.0))
+        # RACON_TPU_ROUTER_TRACE=<out.json>: dump the router's own
+        # flight ring (plan/dispatch/stream/merge/requeue spans for
+        # every routed job still in the ring) as Chrome-trace JSON at
+        # router stop — the standalone-router twin of the per-job
+        # --trace-out pull
+        tp = kw.pop("trace_path", None)
+        self.trace_path = (tp if tp is not None
+                           else os.environ.get(
+                               "RACON_TPU_ROUTER_TRACE", "")) or None
         self.max_frame = max_frame_bytes()
         if kw:
             raise RaconError(
@@ -301,6 +311,17 @@ class _JobMerge:
         #: reads this to reach every other shard's replica by child
         #: trace id when one shard's failure dooms the whole parent
         self.dispatched: dict[int, tuple] = {}
+        #: every replica that EVER took a shard of this job (spec ->
+        #: ReplicaState), including ones that later died — the trace
+        #: collection resolves pull targets through it
+        self.replicas_seen: dict[str, object] = {}
+        #: shard k -> (replica spec, child trace id) of the attempt
+        #: that COMPLETED the shard (never popped, unlike
+        #: `dispatched`): the trace collection pulls each replica for
+        #: exactly the child traces it finished, so co-resident
+        #: replicas sharing one process flight ring (in-process tests)
+        #: never duplicate each other's spans
+        self.shard_owner: dict[int, tuple] = {}
         self._emit_part = emit_part
         self._on_routed = on_routed
         self._cursor_shard = 0
@@ -490,6 +511,14 @@ class PolishRouter:
         #: off-knob exposition stays byte-identical; while armed with
         #: headroom, _run_shard also holds for idle capacity
         self.autoscaler = None
+        #: the router's own always-on flight ring (obs/flight.py):
+        #: plan / dispatch(+hold) / stream / merge / requeue / cancel
+        #: spans per routed job, tagged with the parent trace id and
+        #: the child `<trace>.s<k>` ids. Deliberately NOT installed as
+        #: the process-global tracer — routers share processes with
+        #: replicas in tests and embedded runs, and the global slot
+        #: belongs to the serve layer's ring
+        self.recorder = obs_flight.FlightRecorder()
 
     # ------------------------------------------------------------ lifecycle
     def start(self) -> "PolishRouter":
@@ -580,6 +609,16 @@ class PolishRouter:
             with contextlib.suppress(OSError):
                 os.unlink(self.config.socket_path)
         self.fleet.close()
+        if self.config.trace_path:
+            # RACON_TPU_ROUTER_TRACE: best-effort ring dump at stop —
+            # a full disk loses the artifact, never the drain
+            try:
+                obs_flight.dump(self.recorder, self.config.trace_path)
+                log_info(f"[racon_tpu::router] trace written to "
+                         f"{self.config.trace_path}")
+            except Exception as exc:  # noqa: BLE001 — see above
+                log_info(f"[racon_tpu::router] warning: could not "
+                         f"write trace ({type(exc).__name__}: {exc})")
         if self.journal is not None:
             self.journal.record(
                 "router-stop", clean=clean,
@@ -967,6 +1006,7 @@ class PolishRouter:
             targets = [(k, rep, ctid)
                        for k, (rep, ctid) in merge.dispatched.items()
                        if k != cause_shard]
+        tc0 = time.perf_counter()
         for k, replica, child_trace in targets:
             try:
                 replica.client(
@@ -974,11 +1014,17 @@ class PolishRouter:
                     trace_id=child_trace)
             except (ServeError, ProtocolError, OSError):
                 continue  # already finished, or the replica is gone
-        if targets and self.journal is not None:
-            self.journal.record(
-                "siblings-cancelled", job=job_id, trace=trace_id,
-                by_shard=cause_shard, code=code,
-                cancelled=len(targets))
+        if targets:
+            self.recorder.complete(
+                "router.cancel", tc0, time.perf_counter(),
+                {"job": job_id, "trace_id": trace_id or job_id,
+                 "by_shard": cause_shard, "code": code,
+                 "cancelled": len(targets)})
+            if self.journal is not None:
+                self.journal.record(
+                    "siblings-cancelled", job=job_id, trace=trace_id,
+                    by_shard=cause_shard, code=code,
+                    cancelled=len(targets))
         return len(targets)
 
     # --------------------------------------------------------------- submit
@@ -1183,7 +1229,16 @@ class PolishRouter:
             opts_in = req.get("options") or {}
             if not isinstance(opts_in, dict):
                 opts_in = {}
+            n_contigs = len(contigs)
             del contigs  # the shard files own the bytes now
+            # plan span: target parse + shard planning + shard-target
+            # writes, from the submit's t0 — the first hop of the
+            # routed job's distributed trace
+            self.recorder.complete(
+                "router.plan", t0, time.perf_counter(),
+                {"job": job_id, "trace_id": trace_id or job_id,
+                 "mode": "range" if groups is not None else "contig",
+                 "shards": n_shards, "contigs": n_contigs})
             requeues_before = self.counters["requeues"]
             emit_part = None
             if want_stream:
@@ -1202,6 +1257,10 @@ class PolishRouter:
             def on_routed(k, part_index, name, nbytes, **extra):
                 with self._state_lock:
                     self.counters["parts_routed"] += 1
+                self.recorder.instant(
+                    "router.stream",
+                    {"job": job_id, "trace_id": trace_id or job_id,
+                     "shard": k, "part": part_index, "bytes": nbytes})
                 if self.journal is not None:
                     # range mode adds lo/hi: one `part-routed` line per
                     # accepted SEGMENT (post-dedupe), which is what
@@ -1245,6 +1304,7 @@ class PolishRouter:
                                       **f.extra)
 
             wall_s = time.perf_counter() - t0
+            tm0 = time.perf_counter()
             job_requeues = self.counters["requeues"] - requeues_before
             queue_wait = 0.0
             exec_max = 0.0
@@ -1306,6 +1366,14 @@ class PolishRouter:
                 out["parts"] = merge.total_routed
             else:
                 out["fasta"] = merge.fasta()
+            # merge span: stats aggregation + group assembly/concat +
+            # result-frame build — the final hop before the reply
+            self.recorder.complete(
+                "router.merge", tm0, time.perf_counter(),
+                {"job": job_id, "trace_id": trace_id or job_id,
+                 "shards": n_shards, "parts": merge.total_routed})
+            if req.get("trace"):
+                self._attach_trace(out, merge, job_id, trace_id)
             if self.journal is not None:
                 self.journal.record(
                     "finished", job=job_id,
@@ -1324,6 +1392,78 @@ class PolishRouter:
                 self._inflight_jobs = max(0, self._inflight_jobs - 1)
             if workdir is not None:
                 shutil.rmtree(workdir, ignore_errors=True)
+
+    def _attach_trace(self, out: dict, merge: _JobMerge, job_id: str,
+                      trace_id: str | None) -> None:
+        """Trace collection for a routed `--trace-out` job: clock-sync
+        and `trace_pull` every replica that completed a shard, then
+        embed router spans + per-replica span sets in the result frame
+        so the CLIENT can merge everything onto its own timeline
+        (client.merge_trace — one process track per replica).
+
+        The child submits deliberately do NOT carry `trace: true`:
+        obs.trace.scoped serializes on a module lock, so a traced child
+        would serialize same-replica shards. The replica's ALWAYS-ON
+        flight ring supplies the spans instead — serve.queue_wait /
+        serve.job / serve.iteration all carry the child trace ids —
+        and the pull costs the replica nothing it was not already
+        paying. Each replica is pulled for EXACTLY the child trace ids
+        it finished (merge.shard_owner), never the whole parent prefix:
+        a lost attempt's partial spans on a doomed replica would skew
+        the critical-path sums, and in-process replica fixtures share
+        one flight ring, where a prefix pull would return every
+        sibling's spans on every track. Best-effort per replica: one
+        that died after a requeue simply contributes no track.
+        `offset_s` is the replica clock relative to the ROUTER; the
+        client chains it with its own router handshake offset."""
+        tid = trace_id or job_id
+        pulls = []
+        tp0 = time.perf_counter()
+        with merge.lock:
+            owners = dict(merge.shard_owner)
+            seen = dict(merge.replicas_seen)
+        per_rep: dict[str, list[str]] = {}
+        for k in sorted(owners):
+            spec, ctid = owners[k]
+            per_rep.setdefault(spec, []).append(ctid)
+        for spec in sorted(per_rep):
+            replica = seen.get(spec)
+            if replica is None:
+                continue
+            try:
+                cl = replica.client(timeout=self.config.probe_timeout_s)
+                sync = cl.clock_sync()
+                resp = cl.request({"type": "trace_pull",
+                                   "trace_id": tid,
+                                   "trace_ids": per_rep[spec]})
+            except (ServeError, ProtocolError, OSError):
+                continue
+            if resp.get("base_mono") is None:
+                continue  # flight ring disabled on that replica
+            pulls.append({"replica": spec,
+                          "events": resp.get("events") or [],
+                          "base_mono": resp["base_mono"],
+                          "offset_s": round(float(sync["offset_s"]), 6),
+                          "rtt_s": round(float(sync["rtt_s"]), 6)})
+        self.recorder.complete(
+            "router.trace_pull", tp0, time.perf_counter(),
+            {"job": job_id, "trace_id": tid, "replicas": len(pulls)})
+        out["trace"] = obs_flight.trace_events(self.recorder, tid)
+        out["trace_base_mono"] = self.recorder._base
+        if pulls:
+            out["trace_replicas"] = pulls
+        # per-shard serve stats ride along (traced jobs only — the
+        # flagless frame is pinned byte-identical): tracereport's
+        # span-sums-vs-stage_stats consistency check needs each
+        # shard's device_s/queue_wait next to the spans
+        detail = []
+        for kk, resp in enumerate(merge.results):
+            serve = (resp or {}).get("serve") or {}
+            detail.append({"shard": kk,
+                           "queue_wait_s": serve.get("queue_wait_s"),
+                           "exec_s": serve.get("exec_s"),
+                           "batch": serve.get("batch")})
+        out["router"]["shards_detail"] = detail
 
     def _run_shard(self, req: dict, job_id: str, trace_id: str | None,
                    k: int, n_shards: int, shard_target: str,
@@ -1403,6 +1543,11 @@ class PolishRouter:
                     self._requeued_outstanding = max(
                         0, self._requeued_outstanding - 1)
 
+        #: dispatch-span clock: each attempt's `router.dispatch` span
+        #: runs from here to the moment a replica is picked, so the
+        #: busy-wait AND the autoscale hold both show up as span width
+        attempt_t0 = time.perf_counter()
+        held = False  # the autoscale hold actually engaged this attempt
         while True:
             if merge.failure is not None:
                 # another shard (or a parent-level cancel) already
@@ -1432,6 +1577,7 @@ class PolishRouter:
             if replica is None:
                 if hold or (time.monotonic() < wait_deadline
                             and not self._draining.is_set()):
+                    held = held or hold
                     _set_waiting(True)
                     time.sleep(0.1)
                     continue
@@ -1442,6 +1588,17 @@ class PolishRouter:
                 settle()
                 return
             _set_waiting(False)
+            picked_t = time.perf_counter()
+            held_s = picked_t - attempt_t0
+            # dispatch span: replica acquisition for this attempt —
+            # width IS the wait (busy-wait + autoscale hold); `held`
+            # says the PR-18 idle-hold specifically engaged
+            self.recorder.complete(
+                "router.dispatch", attempt_t0, picked_t,
+                {"job": job_id, "trace_id": child["trace_id"],
+                 "shard": k, "replica": replica.spec,
+                 "held_s": round(held_s, 4), "held": held,
+                 "attempt": losses + busy_waits})
             with self._state_lock:
                 self.counters["shards_dispatched"] += 1
             if self.journal is not None:
@@ -1449,14 +1606,32 @@ class PolishRouter:
                                     trace=trace_id, shard=k,
                                     replica=replica.spec,
                                     attempt=losses + busy_waits)
+                if held:
+                    # annotation twin of the span: obsreport timelines
+                    # and the autoscale balance check read this
+                    self.journal.record("hold", job=job_id,
+                                        trace=trace_id, shard=k,
+                                        held_s=round(held_s, 4))
             with merge.lock:
                 merge.dispatched[k] = (replica, child["trace_id"])
+                merge.replicas_seen[replica.spec] = replica
             lost = False
             try:
                 resp = replica.client().request(
                     child,
                     on_part=lambda f: merge.on_part(k, f),
                     on_progress=on_progress if want_progress else None)
+                # shard span: the child request's full wall on the
+                # replica — the critical-path unit tracereport walks
+                self.recorder.complete(
+                    "router.shard", picked_t, time.perf_counter(),
+                    {"job": job_id, "trace_id": child["trace_id"],
+                     "shard": k, "replica": replica.spec,
+                     "outcome": "ok",
+                     "parts": len(resp.get("_parts") or ())})
+                with merge.lock:
+                    merge.shard_owner[k] = (replica.spec,
+                                            child["trace_id"])
                 merge.shard_done(k, resp)
                 if self.journal is not None:
                     self.journal.record(
@@ -1475,6 +1650,8 @@ class PolishRouter:
                 # rolling restart in progress: this replica stopped
                 # admitting — route the shard elsewhere, no loss
                 exclude.add(replica.spec)
+                attempt_t0 = time.perf_counter()
+                held = False
                 continue
             except QueueFull as exc:
                 busy_waits += 1
@@ -1484,6 +1661,10 @@ class PolishRouter:
                         f"shard {k}: replicas stayed full"))
                     settle()
                     return
+                # the backoff is capacity wait: charge it to the NEXT
+                # attempt's dispatch span
+                attempt_t0 = time.perf_counter()
+                held = False
                 time.sleep(_retry_delay(exc.retry_after))
                 continue
             except ServeError as exc:
@@ -1508,6 +1689,11 @@ class PolishRouter:
             if not lost:
                 return  # unreachable, but keeps the loop shape honest
             # ---- replica loss: mark down, requeue with ledger dedupe
+            self.recorder.complete(
+                "router.shard", picked_t, time.perf_counter(),
+                {"job": job_id, "trace_id": child["trace_id"],
+                 "shard": k, "replica": replica.spec,
+                 "outcome": "lost"})
             with self._state_lock:
                 replica.down_forced = True
             if self.journal is not None:
@@ -1533,8 +1719,14 @@ class PolishRouter:
                                     trace=trace_id, shard=k,
                                     from_replica=replica.spec)
             merge.requeue(k)
+            self.recorder.instant(
+                "router.requeue",
+                {"job": job_id, "trace_id": child["trace_id"],
+                 "shard": k, "from": replica.spec, "losses": losses})
             exclude.add(replica.spec)
             wait_deadline = time.monotonic() + self.config.replica_wait_s
+            attempt_t0 = time.perf_counter()
+            held = False
 
 
 # ------------------------------------------------------------------ CLI
@@ -1582,6 +1774,11 @@ def router_main(argv: list[str]) -> int:
                     help="replica losses tolerated per shard before "
                          "the job fails (RACON_TPU_ROUTER_RETRIES, "
                          "default 3)")
+    ap.add_argument("--trace", default=None, metavar="OUT.json",
+                    help="dump the router's own flight ring (plan/"
+                         "dispatch/stream/merge/requeue spans per "
+                         "routed job) as Chrome-trace JSON at stop "
+                         "(RACON_TPU_ROUTER_TRACE)")
     ap.add_argument("--autoscale", action="store_true",
                     help="arm the elastic-fleet loop: spawn warm "
                          "replicas on sustained backlog pressure or a "
@@ -1614,6 +1811,8 @@ def router_main(argv: list[str]) -> int:
         kw["max_shards"] = args.max_shards
     if args.shard_retries is not None:
         kw["shard_retries"] = args.shard_retries
+    if args.trace is not None:
+        kw["trace_path"] = args.trace
 
     try:
         router = PolishRouter(**kw).start()

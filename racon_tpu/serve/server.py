@@ -1065,6 +1065,8 @@ class PolishServer:
                     resp["audit_ack"] = self.auditor.ack()
                 resp["audit"] = self.auditor.snapshot()
             return resp
+        if rtype == "trace_pull":
+            return self._trace_pull(req)
         if rtype == "cancel":
             return self._cancel(req)
         if rtype == "shutdown":
@@ -1598,6 +1600,17 @@ class PolishServer:
                 rec.complete("serve.queue_wait", job.enqueued_t,
                              job.started_t or t0,
                              {"job": job.id, "trace_id": job.trace_id})
+            elif self._flight is not None:
+                # untraced jobs (the router's child shards deliberately
+                # run without a scoped trace — obs/trace.scoped
+                # serializes on a module lock, which would serialize
+                # same-replica shards) still leave their queue-wait in
+                # the always-on flight ring, tagged with the trace id,
+                # so a later `trace_pull` can window them out
+                self._flight.complete(
+                    "serve.queue_wait", job.enqueued_t,
+                    job.started_t or t0,
+                    {"job": job.id, "trace_id": job.trace_id})
             polisher = create_polisher(
                 job.sequences, job.overlaps, job.target,
                 PolisherType.kF if opts.get("fragment_correction")
@@ -1641,6 +1654,11 @@ class PolishServer:
             # into the OWNING job's timeline
             polisher.serve_trace_id = job.trace_id
             polisher.serve_job_id = job.id
+            # tenant identity rides the polisher too: the batcher
+            # prorates each lane iteration's device seconds onto the
+            # tenants whose windows shared it (per-tenant device-cost
+            # accounting, serve.tenant_device_seconds)
+            polisher.serve_tenant = job.tenant
             # the absolute deadline rides the polisher so the batcher's
             # iteration-boundary doomed check can see it (mid-run
             # speculative abort, RACON_TPU_SERVE_ABORT_MARGIN)
@@ -1811,6 +1829,12 @@ class PolishServer:
             # with the ping handshake's clock offset, the client maps
             # every server span onto its own timeline (client.py)
             resp["trace_base_mono"] = rec._base
+        elif self._flight is not None:
+            # untraced twin of the span above, into the always-on ring,
+            # for trace_pull (see the queue-wait comment)
+            self._flight.complete(
+                "serve.job", t0, time.perf_counter(),
+                {"job": job.id, "trace_id": job.trace_id})
         return resp
 
     # -------------------------------------------------- flight recorder
@@ -1859,6 +1883,48 @@ class PolishServer:
         return {"type": "debug", "events": events,
                 "dumps": list(self._dumps),
                 "flight_installed": self._flight_installed}
+
+    def _trace_pull(self, req: dict) -> dict:
+        """The `trace_pull` RPC body: flight-ring spans windowed to ONE
+        distributed trace id (exact or dotted `<trace>.s<k>` child
+        match — obs/flight.trace_events), with this process's recorder
+        base and a fresh mono sample so the router can rebase the
+        events onto its own timeline after a `clock_sync()`. An
+        optional `trace_ids` list narrows the window to exactly those
+        ids (union) — the router pulls each replica for only the child
+        traces that completed there. Always-on: it reads the ring that
+        is already recording, so pulling a trace costs the replica
+        nothing beyond the reply frame."""
+        trace_id = req.get("trace_id")
+        if (not isinstance(trace_id, str) or not trace_id
+                or len(trace_id) > 64
+                or not set(trace_id) <= self._TRACE_ID_OK):
+            return error_response(
+                "bad-request", "trace_pull needs a trace_id of "
+                "[A-Za-z0-9._-], at most 64 chars")
+        want = trace_id
+        tids = req.get("trace_ids")
+        if tids is not None:
+            if (not isinstance(tids, list) or not tids
+                    or not all(isinstance(t, str) and t
+                               and len(t) <= 64
+                               and set(t) <= self._TRACE_ID_OK
+                               for t in tids)):
+                return error_response(
+                    "bad-request", "trace_pull trace_ids must be a "
+                    "non-empty list of [A-Za-z0-9._-] ids")
+            want = tids
+        events: list = []
+        base = None
+        if self._flight is not None:
+            cap = req.get("max_events")
+            events = obs_flight.trace_events(
+                self._flight, want,
+                max_events=int(cap) if cap is not None else None)
+            base = self._flight._base
+        return {"type": "trace", "trace_id": trace_id,
+                "events": events, "base_mono": base,
+                "mono_s": time.perf_counter()}
 
     # --------------------------------------------------------- exposition
     def prometheus_text(self) -> str:
@@ -1952,6 +2018,18 @@ class PolishServer:
                 [({"tenant": t}, tc.get("credit", 0.0))
                  for t, tc in sorted(tenants.items())],
                 "accrued DRR credit per tenant (spent one per pop)")
+        # per-tenant device-cost accounting (batcher proration of lane
+        # iteration wall by window share). Armed-only like the views
+        # above: appears once a NAMED tenant has accrued device time;
+        # the "" bucket then rides along so the series sum stays equal
+        # to total lane device seconds (test-pinned)
+        tdev = b.get("tenant_device_s")
+        if tdev:
+            counters["serve.tenant_device_seconds"] = obs_prom.Labeled(
+                [({"tenant": t}, v) for t, v in sorted(tdev.items())],
+                "device-seconds charged per tenant (lane iteration "
+                "wall prorated by window share; empty tenant label = "
+                "untenanted traffic)")
         # identity-audit families (obs/audit.py) — rendered ONLY when
         # the sentinel is armed, so an audit-off scrape stays
         # byte-identical to the pre-audit exposition (test-pinned)

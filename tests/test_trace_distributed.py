@@ -1,0 +1,531 @@
+"""Fleet-wide distributed tracing tests (serve/router.py +
+serve/client.py + obs/trace.py + tools/tracereport.py) — the ISSUE's
+pinned contracts:
+
+  - clock sync: `PolishClient.clock_sync()` against a server whose
+    mono clock is skewed by +/-50ms recovers the injected offset to
+    within the min-RTT bracket (rtt/2) — the accuracy claim the
+    merged-timeline construction rests on;
+  - rebase: `obs.trace.rebase_events` onto colliding pids keeps every
+    replica's events on its own process track (same thread tids on
+    two replicas must not interleave), prefixes process_name metadata,
+    and never mutates the input events;
+  - routed trace matrix: a 2-replica routed `submit_traced` job over
+    unix (contig-sharded) AND TCP (range-sharded) produces ONE valid
+    Chrome-trace JSON with client/router/per-replica tracks on a
+    common clock, and `tools/tracereport.py` walks it: the per-stage
+    attribution sums to the job wall (exact by construction) with
+    every check green (--check rc 0) — including with a REQUEUE
+    injected via a dying replica (the kill -9 shape, deterministic);
+  - per-tenant device-cost accounting: each replica's tenant
+    device-seconds buckets sum to its total lane busy seconds, the
+    labeled counter federates across a 2-replica fleet through
+    FleetAggregator, and the federated sum equals the fleet total;
+  - flagless pin: an untraced, untenanted routed job's response frame
+    carries NO trace/trace_replicas/shards_detail keys and the replica
+    scrape has no tenant device-seconds family — the trace plane is
+    invisible until armed.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import socket
+import sys
+import threading
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "tools"))
+
+import tracereport
+
+from racon_tpu.core.polisher import PolisherType, create_polisher
+from racon_tpu.obs.fleet import FleetAggregator
+from racon_tpu.obs.journal import check_consistency, read_journal
+from racon_tpu.obs.trace import rebase_events
+from racon_tpu.serve import (PolishClient, PolishRouter, PolishServer,
+                             make_synth_dataset)
+from racon_tpu.serve.client import ServeError
+from racon_tpu.serve.protocol import ProtocolError, recv_frame, send_frame
+
+TENANT_FAMILY = "racon_tpu_serve_tenant_device_seconds_total"
+
+
+# --------------------------------------------------------------- fixtures
+@pytest.fixture(scope="module")
+def dataset4(tmp_path_factory):
+    """Four independent contigs — contig-shards across 2 replicas."""
+    return make_synth_dataset(str(tmp_path_factory.mktemp("trace_data4")),
+                              contigs=4)
+
+
+@pytest.fixture(scope="module")
+def dataset1(tmp_path_factory):
+    """ONE contig (4 windows at wl=500) — forces range sharding."""
+    return make_synth_dataset(str(tmp_path_factory.mktemp("trace_data1")))
+
+
+def _polish_solo(paths) -> bytes:
+    p = create_polisher(*paths, PolisherType.kC, 500, 10.0, 0.3,
+                        num_threads=2)
+    p.initialize()
+    return b"".join(b">" + s.name.encode() + b"\n" + s.data + b"\n"
+                    for s in p.polish())
+
+
+@pytest.fixture(scope="module")
+def solo4(dataset4):
+    return _polish_solo(dataset4)
+
+
+@pytest.fixture(scope="module")
+def solo1(dataset1):
+    return _polish_solo(dataset1)
+
+
+@pytest.fixture(scope="module")
+def trace_replicas(tmp_path_factory):
+    d = tmp_path_factory.mktemp("trace_reps")
+    socks = [str(d / f"rep{i}.sock") for i in range(2)]
+    servers = [PolishServer(socket_path=s, workers=2).start()
+               for s in socks]
+    yield socks
+    for srv in servers:
+        srv.drain(timeout=10)
+
+
+def _wait_routable(cli: PolishClient, want: int, deadline_s: float = 30.0):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < deadline_s:
+        with contextlib.suppress(Exception):
+            hz = cli.request({"type": "healthz"})
+            if hz.get("routable") == want:
+                return hz
+        time.sleep(0.1)
+    raise AssertionError(f"router never reached routable == {want}")
+
+
+# ------------------------------------------------------------ clock sync
+class _SkewedPingServer:
+    """Frame-protocol stub whose pong reports a mono clock shifted by
+    `skew_s` from this process's perf_counter — a replica on another
+    host, seen over a localhost-fast link."""
+
+    def __init__(self, sock_path: str, skew_s: float):
+        self.skew_s = skew_s
+        self._stop = threading.Event()
+        self._lst = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._lst.bind(sock_path)
+        self._lst.listen(4)
+        self._lst.settimeout(0.2)
+        self.path = sock_path
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def _loop(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._lst.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            try:
+                while True:
+                    req = recv_frame(conn)
+                    if req is None:
+                        break
+                    if req.get("type") == "ping":
+                        send_frame(conn, {
+                            "type": "pong",
+                            "mono_s": time.perf_counter() + self.skew_s})
+                    else:
+                        send_frame(conn, {"type": "ok"})
+            except (OSError, ProtocolError):
+                pass
+            finally:
+                with contextlib.suppress(OSError):
+                    conn.close()
+
+    def close(self):
+        self._stop.set()
+        with contextlib.suppress(OSError):
+            self._lst.close()
+
+
+@pytest.mark.parametrize("skew_s", [0.05, -0.05])
+def test_clock_sync_recovers_injected_skew(skew_s, tmp_path):
+    """offset_s must land within the min-RTT bracket of the true skew:
+    both clocks are THIS process's perf_counter, so the injected shift
+    is exactly the offset clock_sync should estimate."""
+    stub = _SkewedPingServer(str(tmp_path / "skew.sock"), skew_s)
+    try:
+        cl = PolishClient(socket_path=stub.path)
+        clock = cl.clock_sync(samples=5)
+        assert clock["rtt_s"] > 0
+        # rtt/2 is the claimed accuracy; a small epsilon absorbs the
+        # perf_counter reads between the skew injection and the pong
+        assert abs(clock["offset_s"] - skew_s) <= \
+            clock["rtt_s"] / 2.0 + 0.005
+    finally:
+        stub.close()
+
+
+def test_clock_sync_requires_mono_sample(tmp_path):
+    """A pre-tracing server (pong without mono_s) answers clock_sync
+    with a typed error, not a silent zero offset."""
+    class _Bare(_SkewedPingServer):
+        def _loop(self):
+            while not self._stop.is_set():
+                try:
+                    conn, _ = self._lst.accept()
+                except socket.timeout:
+                    continue
+                except OSError:
+                    return
+                with contextlib.suppress(OSError, ProtocolError):
+                    while True:
+                        req = recv_frame(conn)
+                        if req is None:
+                            break
+                        send_frame(conn, {"type": "pong"})
+                with contextlib.suppress(OSError):
+                    conn.close()
+
+    stub = _Bare(str(tmp_path / "bare.sock"), 0.0)
+    try:
+        with pytest.raises(ServeError) as exc_info:
+            PolishClient(socket_path=stub.path).clock_sync()
+        assert "mono_s" in str(exc_info.value)
+    finally:
+        stub.close()
+
+
+# --------------------------------------------------------------- rebase
+def test_rebase_events_keeps_colliding_tracks_distinct():
+    """Two replicas emit events with IDENTICAL thread tids and names
+    (every PolishServer numbers its workers from zero) — rebasing onto
+    pids 3 and 4 must keep each set on its own process track."""
+    def replica_events():
+        return [
+            {"name": "thread_name", "ph": "M", "pid": 0, "tid": 7,
+             "args": {"name": "worker-0"}},
+            {"name": "serve.iteration", "ph": "X", "pid": 0, "tid": 7,
+             "ts": 10.0, "dur": 5.0, "args": {"trace_ids": ["t.s0"]}},
+            {"name": "serve.queue_wait", "ph": "X", "pid": 0, "tid": 7,
+             "ts": 2.0, "dur": 1.0, "args": {"trace_id": "t.s0"}},
+        ]
+
+    a_src, b_src = replica_events(), replica_events()
+    a = rebase_events(a_src, pid=3, shift_us=100.0, name="replica a")
+    b = rebase_events(b_src, pid=4, shift_us=200.0, name="replica b")
+    # every event landed on its OWN pid — no cross-track bleed
+    assert {ev["pid"] for ev in a} == {3}
+    assert {ev["pid"] for ev in b} == {4}
+    # process_name metadata labels each track
+    for evs, pid, label in ((a, 3, "replica a"), (b, 4, "replica b")):
+        metas = [ev for ev in evs if ev["ph"] == "M"
+                 and ev["name"] == "process_name"]
+        assert len(metas) == 1 and metas[0]["pid"] == pid
+        assert metas[0]["args"]["name"] == label
+    # spans shifted onto their own timelines; thread metadata keeps its
+    # timestampless shape (the tid collision is fine ACROSS pids —
+    # Chrome tracks are keyed (pid, tid))
+    span_a = next(ev for ev in a if ev["name"] == "serve.iteration")
+    span_b = next(ev for ev in b if ev["name"] == "serve.iteration")
+    assert span_a["ts"] == 110.0 and span_b["ts"] == 210.0
+    assert span_a["tid"] == span_b["tid"] == 7
+    assert all("ts" not in ev for ev in a if ev["ph"] == "M")
+    # inputs were not mutated
+    assert a_src[1]["pid"] == 0 and a_src[1]["ts"] == 10.0
+
+
+# ------------------------------------------------------ routed trace pins
+def _track_names(doc: dict) -> dict[int, str]:
+    return {ev["pid"]: ev["args"]["name"]
+            for ev in doc["traceEvents"]
+            if ev.get("ph") == "M" and ev.get("name") == "process_name"}
+
+
+def _assert_report_green(doc: dict, path: str):
+    """The tracereport acceptance core: per-stage attribution sums to
+    the span wall exactly, every consistency check passes, and the CLI
+    exits 0 under --check."""
+    rep = tracereport.analyze(doc)
+    assert rep["routed"]
+    assert sum(rep["stages"].values()) == pytest.approx(
+        rep["wall_s"], abs=1e-6)
+    eps = 2.0 * rep["bracket_s"] + 1e-3
+    for name, v in rep["stages"].items():
+        assert v >= -eps, f"stage {name} = {v}"
+    assert tracereport.check(doc, rep) == []
+    assert tracereport.main([path, "--check"]) == 0
+
+
+def test_routed_trace_unix_contig_sharded(dataset4, solo4,
+                                          trace_replicas, tmp_path):
+    """The acceptance gate over unix sockets: a 2-replica contig-
+    sharded traced job yields ONE merged Chrome-trace JSON with
+    router + both replica + client tracks on a common clock, and
+    tracereport's critical path + attribution come out green."""
+    router = PolishRouter(replicas=",".join(trace_replicas),
+                          socket_path=str(tmp_path / "r.sock"),
+                          health_interval_s=0.2).start()
+    try:
+        cli = PolishClient(socket_path=router.config.socket_path)
+        _wait_routable(cli, 2)
+        path = str(tmp_path / "merged.json")
+        result, doc = cli.submit_traced(*dataset4, trace_out=path,
+                                        tenant="acme")
+        assert result.fasta == solo4
+        assert json.load(open(path)) == doc
+        # tracks: client(1), router(2), one process per replica (3+)
+        names = _track_names(doc)
+        assert "client" in names[1] and "router" in names[2]
+        rep_pids = [p for p, n in names.items() if "replica" in n]
+        assert len(rep_pids) == 2
+        for spec in trace_replicas:
+            assert any(spec in names[p] for p in rep_pids)
+        # every replica track really carries serve-side spans
+        for p in rep_pids:
+            have = {ev["name"] for ev in doc["traceEvents"]
+                    if ev.get("pid") == p and ev.get("ph") == "X"}
+            assert "serve.job" in have and "serve.iteration" in have
+        # per-replica clock metadata rode into the context
+        ctx = doc["trace_context"]
+        assert len(ctx["replicas"]) == 2
+        assert all(r["rtt_s"] >= 0 for r in ctx["replicas"])
+        assert ctx["stats"]["router"]["shards"] == 2
+        assert len(ctx["stats"]["router"]["shards_detail"]) == 2
+        _assert_report_green(doc, path)
+    finally:
+        router.drain()
+
+
+def test_routed_trace_tcp_range_sharded(dataset1, solo1, tmp_path):
+    """The same gate over localhost TCP with sub-contig RANGE sharding
+    (one contig across two replicas): distinct tracks, green report."""
+    servers = [PolishServer(port=0, workers=2).start() for _ in range(2)]
+    specs = [f"127.0.0.1:{s.config.port}" for s in servers]
+    router = PolishRouter(replicas=",".join(specs), port=0,
+                          health_interval_s=0.2).start()
+    try:
+        cli = PolishClient(port=router.config.port)
+        _wait_routable(cli, 2)
+        path = str(tmp_path / "merged_tcp.json")
+        result, doc = cli.submit_traced(*dataset1, trace_out=path)
+        assert result.fasta == solo1
+        assert result.router["range"] is True
+        assert result.router["range_shards"] == 2
+        names = _track_names(doc)
+        rep_pids = [p for p, n in names.items() if "replica" in n]
+        assert len(rep_pids) == 2
+        assert {names[p] for p in rep_pids} == \
+            {f"racon_tpu replica {s}" for s in specs}
+        _assert_report_green(doc, path)
+    finally:
+        router.drain()
+        for s in servers:
+            s.drain(timeout=10)
+
+
+class _DyingTracedReplica:
+    """Protocol-complete fake replica that streams its shard's TRUE
+    first polished contig and then drops the connection — the
+    deterministic kill -9 shape (tests/test_router.py). It never
+    COMPLETES a shard, so the router's per-owner trace pull must never
+    ask it for spans (it has no flight ring to answer with)."""
+
+    def __init__(self, sock_path: str, polished_records: dict):
+        self.path = sock_path
+        self.polished = polished_records
+        self.submits = 0
+        self._stop = threading.Event()
+        self._lst = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._lst.bind(sock_path)
+        self._lst.listen(8)
+        self._lst.settimeout(0.2)
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def _loop(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._lst.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            threading.Thread(target=self._handle, args=(conn,),
+                             daemon=True).start()
+
+    def _handle(self, conn):
+        try:
+            while True:
+                req = recv_frame(conn)
+                if req is None:
+                    return
+                rtype = req.get("type")
+                if rtype == "healthz":
+                    send_frame(conn, {"type": "healthz", "ok": True,
+                                      "draining": False})
+                elif rtype == "scrape":
+                    send_frame(conn, {"type": "metrics", "text": ""})
+                elif rtype == "ping":
+                    send_frame(conn, {"type": "pong"})
+                elif rtype == "submit":
+                    self.submits += 1
+                    from racon_tpu.io.parsers import \
+                        create_sequence_parser
+                    contigs: list = []
+                    create_sequence_parser(req["target"],
+                                           "test").parse(contigs, -1)
+                    name = contigs[0].name
+                    send_frame(conn, {"type": "result_part",
+                                      "job_id": "stub", "part": 0,
+                                      "name": name,
+                                      "fasta": self.polished[name]})
+                    with contextlib.suppress(OSError):
+                        conn.shutdown(socket.SHUT_RDWR)
+                    return
+                else:
+                    send_frame(conn, {"type": "ok"})
+        except (OSError, ProtocolError):
+            return
+        finally:
+            with contextlib.suppress(OSError):
+                conn.close()
+
+    def close(self):
+        self._stop.set()
+        with contextlib.suppress(OSError):
+            self._lst.close()
+
+
+def _records_by_name(fasta: bytes) -> dict:
+    out = {}
+    for chunk in fasta.split(b">")[1:]:
+        header, _, _body = chunk.partition(b"\n")
+        out[header.split()[0].decode()] = (b">" + chunk).decode("latin-1")
+    return out
+
+
+def test_routed_trace_with_requeue_injected(dataset4, solo4,
+                                            trace_replicas, tmp_path):
+    """The failover x tracing composition: a shard's replica dies after
+    one streamed part, the shard requeues to a survivor — the merged
+    artifact records the router.requeue instant, carries NO spans from
+    the lost attempt (per-owner pulls), and tracereport still sums the
+    attribution to the wall with every check green."""
+    stub = _DyingTracedReplica(str(tmp_path / "stub.sock"),
+                               _records_by_name(solo4))
+    journal = str(tmp_path / "router.jsonl")
+    router = PolishRouter(
+        replicas=f"{stub.path},{trace_replicas[0]}",
+        socket_path=str(tmp_path / "r.sock"), journal=journal,
+        health_interval_s=0.2).start()
+    try:
+        cli = PolishClient(socket_path=router.config.socket_path)
+        path = str(tmp_path / "merged_requeue.json")
+        result, doc = cli.submit_traced(*dataset4, trace_out=path)
+        assert result.fasta == solo4
+        assert result.router["requeues"] >= 1
+        assert stub.submits >= 1  # the dying replica really got a shard
+        # the requeue is a first-class instant on the router track
+        requeues = [ev for ev in doc["traceEvents"]
+                    if ev.get("name") == "router.requeue"]
+        assert len(requeues) == result.router["requeues"]
+        # only the SURVIVOR contributed a replica track: the stub never
+        # completed a shard, so the per-owner pull skipped it
+        names = _track_names(doc)
+        rep_names = [n for n in names.values() if "replica" in n]
+        assert rep_names == [f"racon_tpu replica {trace_replicas[0]}"]
+        _assert_report_green(doc, path)
+    finally:
+        router.drain()
+        stub.close()
+    entries = read_journal(journal)
+    events = [e["event"] for e in entries]
+    assert "replica-down" in events and "requeued" in events
+    assert check_consistency(entries) == []
+
+
+# --------------------------------------------- tenant device accounting
+def test_tenant_device_seconds_federate_across_fleet(dataset1,
+                                                     tmp_path):
+    """The cost-accounting pin: per-replica tenant buckets sum to that
+    replica's total lane busy seconds, and the labeled counter
+    federates through FleetAggregator with the fleet sum equal to the
+    sum of the replica totals."""
+    socks = [str(tmp_path / f"acct{i}.sock") for i in range(2)]
+    servers = [PolishServer(socket_path=s, workers=2,
+                            warmup=False).start() for s in socks]
+    try:
+        PolishClient(socket_path=socks[0]).submit(*dataset1,
+                                                  tenant="gold")
+        PolishClient(socket_path=socks[1]).submit(*dataset1,
+                                                  tenant="blue")
+        PolishClient(socket_path=socks[1]).submit(*dataset1)  # untenanted
+        totals = []
+        for s in socks:
+            b = PolishClient(socket_path=s).stats()["batcher"]
+            buckets = b["tenant_device_s"]
+            lane_busy = sum(l["busy_s"] for l in b["lanes"])
+            # proration invariant: the buckets partition lane busy time
+            assert sum(buckets.values()) == pytest.approx(
+                lane_busy, abs=2e-3)
+            totals.append(sum(buckets.values()))
+        # the "" bucket rides along only where untenanted traffic ran
+        b1 = PolishClient(socket_path=socks[1]).stats()["batcher"]
+        assert "" in b1["tenant_device_s"]
+
+        snap = FleetAggregator(endpoints=socks).poll()
+        assert snap.healthy
+        series = snap.counter_series[TENANT_FAMILY]
+        by_tenant = {lbl["tenant"]: v for _, (lbl, v) in series.items()}
+        assert by_tenant["gold"] > 0 and by_tenant["blue"] > 0
+        assert sum(by_tenant.values()) == pytest.approx(
+            sum(totals), abs=2e-3)
+        # the federated scrape body renders the labeled family too
+        agg = FleetAggregator(endpoints=socks)
+        agg.poll()
+        assert TENANT_FAMILY + '{tenant="gold"}' in agg.prometheus_text()
+    finally:
+        for srv in servers:
+            srv.drain(timeout=10)
+
+
+def test_flagless_routed_job_has_no_trace_surface(dataset1, solo1,
+                                                  tmp_path):
+    """The byte-identity discipline: with no --trace-out and no tenant,
+    the routed response frame carries none of the trace-plane keys and
+    the replica scrape has no tenant device-seconds family."""
+    socks = [str(tmp_path / f"plain{i}.sock") for i in range(2)]
+    servers = [PolishServer(socket_path=s, workers=2,
+                            warmup=False).start() for s in socks]
+    router = PolishRouter(replicas=",".join(socks),
+                          socket_path=str(tmp_path / "r.sock"),
+                          health_interval_s=0.2).start()
+    try:
+        cli = PolishClient(socket_path=router.config.socket_path)
+        _wait_routable(cli, 2)
+        raw = cli.request({"type": "submit",
+                           "sequences": dataset1[0],
+                           "overlaps": dataset1[1],
+                           "target": dataset1[2]})
+        assert raw["fasta"].encode("latin-1") == solo1
+        assert "trace" not in raw
+        assert "trace_replicas" not in raw
+        assert "trace_base_mono" not in raw
+        assert "shards_detail" not in raw["router"]
+        for s in socks:
+            text = PolishClient(socket_path=s).scrape()
+            assert "tenant_device_seconds" not in text
+    finally:
+        router.drain()
+        for srv in servers:
+            srv.drain(timeout=10)

@@ -50,6 +50,14 @@ must complete on the survivor with the reassembled contig
 byte-identical to a solo run, the `range-plan`/`requeued` lines on the
 ledger, and obsreport's segment-receipt check tiling clean.
 
+A TRACE section (one gated cell) exercises the distributed-trace plane
+under the same fault: a TRACED routed job (`submit_traced`) with one
+replica killed -9 mid-job must complete byte-identically AND leave a
+merged Chrome trace that tells the story straight — the
+`router.requeue` instant present, `tools/tracereport.py --check` green
+(the per-stage attribution still partitions the wall, the requeue
+count still matches the router block), the journal still consistent.
+
 A PREEMPT section (two gated cells) exercises the preemptive-QoS layer:
 a gold-priority job preempting a running free job on a one-worker
 server (both outputs byte-identical to an undisturbed run, balanced
@@ -70,6 +78,7 @@ from __future__ import annotations
 
 import argparse
 import gzip
+import json
 import os
 import random
 import sys
@@ -702,6 +711,141 @@ def run_range_cells(tmp: str) -> list[tuple[str, str]]:
     return cells
 
 
+def run_trace_cells(tmp: str) -> list[tuple[str, str]]:
+    """The distributed-trace section (serve/router.py trace collection
+    + tools/tracereport.py): a TRACED routed job over two real replica
+    subprocesses, one killed -9 mid-job. The job must complete via the
+    journal-backed requeue byte-identically AND the merged Chrome
+    trace must tell that story honestly: the `router.requeue` instant
+    present for the re-dispatched shard, the dead replica simply
+    absent as a track (trace_pull is best-effort), `tracereport
+    --check` green — the per-stage attribution still partitions the
+    job wall and the requeue-instant count still matches the router
+    block's `requeues` — and the router journal still
+    lifecycle-consistent. A crash that corrupts the trace artifact or
+    double-counts the requeued shard's spans is a red cell here, not a
+    plausible-looking report."""
+    import signal
+    import subprocess
+
+    from racon_tpu.core.polisher import PolisherType, create_polisher
+    from racon_tpu.obs.journal import check_consistency, read_journal
+    from racon_tpu.serve import (PolishClient, PolishRouter,
+                                 make_synth_dataset)
+
+    name = "traced requeue kill -9 mid-job"
+    cells: list[tuple[str, str]] = []
+    data_dir = os.path.join(tmp, "trace_data")
+    os.makedirs(data_dir, exist_ok=True)
+    rpaths = make_synth_dataset(data_dir, contigs=4)
+    p = create_polisher(*rpaths, PolisherType.kC, 500, 10.0, 0.3,
+                        num_threads=2)
+    p.initialize()
+    clean = b"".join(b">" + s.name.encode() + b"\n" + s.data + b"\n"
+                     for s in p.polish())
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               RACON_TPU_DEVICE_RETRIES="1")
+    env["PYTHONPATH"] = os.pathsep.join(
+        [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+        + [q for q in env.get("PYTHONPATH", "").split(os.pathsep)
+           if q and "axon_site" not in q])
+    socks = [os.path.join(tmp, f"trace_rep{i}.sock") for i in range(2)]
+    procs = [subprocess.Popen(
+        [sys.executable, "-m", "racon_tpu.cli", "serve", "--socket", s,
+         "--workers", "2", "--no-warmup"],
+        env=env, stderr=subprocess.DEVNULL) for s in socks]
+    router = None
+    journal = os.path.join(tmp, "trace_journal.jsonl")
+    trace_out = os.path.join(tmp, "trace_merged.json")
+    try:
+        for s in socks:
+            probe = PolishClient(socket_path=s, timeout=30)
+            deadline = time.perf_counter() + 90
+            while time.perf_counter() < deadline:
+                try:
+                    probe.request({"type": "ping"})
+                    break
+                except Exception:  # noqa: BLE001 — still starting
+                    time.sleep(0.2)
+            else:
+                raise RuntimeError(f"replica {s} never came up")
+        router = PolishRouter(replicas=",".join(socks),
+                              socket_path=os.path.join(
+                                  tmp, "trace_router.sock"),
+                              journal=journal,
+                              health_interval_s=0.5).start()
+        # the same watchdog-absorbed hang plan the router cell uses:
+        # bytes unchanged, every shard busy long enough for the kill
+        # to land genuinely mid-job
+        slow = {"fault_plan": "device:chunk=0:hang=8",
+                "options": {"tpu_device_timeout": 2.0}}
+        res: dict = {}
+
+        def run_job(out: dict):
+            mine = PolishClient(socket_path=router.config.socket_path)
+            try:
+                r, _doc = mine.submit_traced(*rpaths,
+                                             trace_out=trace_out,
+                                             **slow)
+                out["fasta"] = r.fasta
+            except Exception as exc:  # noqa: BLE001 — checked below
+                out["exc"] = exc
+
+        t = threading.Thread(target=run_job, args=(res,))
+        t.start()
+        time.sleep(1.0)  # shards dispatched and stalled on chunk 0
+        procs[0].send_signal(signal.SIGKILL)  # the real kill -9
+        t.join(WALL_CAP)
+        entries = read_journal(journal)
+        events = [e["event"] for e in entries]
+        requeue_spans = 0
+        if os.path.exists(trace_out):
+            with open(trace_out) as fh:
+                doc = json.load(fh)
+            requeue_spans = sum(
+                1 for ev in doc.get("traceEvents") or []
+                if ev.get("ph") == "i"
+                and ev.get("name") == "router.requeue")
+        report = subprocess.run(
+            [sys.executable,
+             os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "tracereport.py"),
+             trace_out, "--check"],
+            env=env, capture_output=True, text=True)
+        checks = [("completed", "fasta" in res),
+                  ("identical", res.get("fasta") == clean),
+                  ("requeued-journaled", "requeued" in events
+                   and "replica-down" in events),
+                  ("journal-consistent",
+                   not check_consistency(entries)),
+                  ("requeue-span", requeue_spans >= 1),
+                  ("tracereport-check",
+                   report.returncode == 0)]
+        failed = [n for n, ok in checks if not ok]
+        if "exc" in res:
+            failed.append(f"({type(res['exc']).__name__}: "
+                          f"{res['exc']})")
+        if report.returncode != 0:
+            failed.append(
+                "(" + (report.stderr.strip().splitlines() or ["?"])[-1]
+                + ")")
+        cells.append((name,
+                      "pass  requeue span present, report consistent"
+                      if not failed else f"FAIL {' '.join(failed)}"))
+    except Exception as exc:  # noqa: BLE001 — a crashed section is a
+        # red cell, not a crashed grid
+        cells.append((name,
+                      f"FAIL crashed ({type(exc).__name__}: {exc})"))
+    finally:
+        if router is not None:
+            router.drain()
+        for proc in procs:
+            if proc.poll() is None:
+                proc.kill()
+            proc.wait(timeout=10)
+    return cells
+
+
 def run_preempt_cells(tmp: str) -> list[tuple[str, str]]:
     """The preemptive-QoS section (serve QoS: --preempt + cancel RPC):
     a gold-priority job preempts a running free job on a one-worker
@@ -969,6 +1113,13 @@ def main() -> int:
         for name, cell in range_cells:
             failures += cell.startswith("FAIL")
             print(f"{name:<{width}}  {cell}", file=sys.stderr)
+        # the distributed-trace section: kill -9 under a TRACED routed
+        # job — the merged trace must show the requeue and survive
+        # tracereport --check with the journal still consistent
+        trace_cells = run_trace_cells(tmp)
+        for name, cell in trace_cells:
+            failures += cell.startswith("FAIL")
+            print(f"{name:<{width}}  {cell}", file=sys.stderr)
         # the preemptive-QoS section: gold preempts free byte-
         # identically; a cancel RPC lands during a watchdog-absorbed
         # hang and the server survives
@@ -978,7 +1129,7 @@ def main() -> int:
             print(f"{name:<{width}}  {cell}", file=sys.stderr)
     n_cells = ((len(columns) + 2) * len(rows) + len(audit_cells)
                + len(router_cells) + len(range_cells)
-               + len(preempt_cells))
+               + len(trace_cells) + len(preempt_cells))
     print(f"[faultcheck] {'FAIL' if failures else 'PASS'}: "
           f"{n_cells - failures}/{n_cells} cells green",
           file=sys.stderr)

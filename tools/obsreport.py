@@ -48,7 +48,21 @@ contig's segments, sorted by `lo`, tile the coordinate axis from 0
 with no gap, overlap, or duplicate — so a segment merged twice (a
 requeue dedupe bug) or a hole silently dropped from a reassembled
 contig is a red check; whole-contig `part-routed` lines are pinned to
-exactly one per contig per job."""
+exactly one per contig per job.
+
+Fleet elasticity renders alongside the jobs it served: the PR-18
+autoscaler journals `autoscale-up` / `autoscale-down` with no job
+field (a scale decision belongs to the fleet, not one job), so each
+one is interleaved — tagged `[fleet]` — into the timeline of every
+job whose lifetime it fell inside: the operator reads "this job
+queued, the fleet scaled up, the shard dispatched" as one sequence.
+Shard `hold` annotations (the router held a dispatch for the
+autoscale idle-hold window) carry their job and render natively.
+`--check` adds `check_autoscale`: every `autoscale-down` must name a
+replica a prior `autoscale-up` spawned and not already drained — the
+autoscaler only ever drains replicas it created, so a down without
+its up (or a double-down) means the elasticity ledger lost a
+transition."""
 
 from __future__ import annotations
 
@@ -98,18 +112,35 @@ def _fields(e: dict) -> str:
     return f" ({', '.join(parts)})" if parts else ""
 
 
+def fleet_events(entries: list[dict]) -> list[dict]:
+    """The jobless elasticity transitions (`autoscale-up` /
+    `autoscale-down`) in journal order — render_job interleaves each
+    into every job whose lifetime it fell inside."""
+    return [e for e in entries
+            if e.get("event") in ("autoscale-up", "autoscale-down")
+            and not e.get("job")]
+
+
 def render_job(job: str, events: list[dict], dumps: list[dict],
-               out) -> None:
+               out, fleet: list[dict] | None = None) -> None:
     trace = next((e["trace"] for e in events if e.get("trace")), None)
     t0 = events[0].get("t", 0.0)
+    t_last = events[-1].get("t", t0)
     head = f"job {job}"
     if trace:
         head += f"  trace={trace}"
     print(head, file=out)
     names = {e.get("event") for e in events}
-    for e in events:
-        dt = e.get("t", t0) - t0
-        print(f"  +{dt:8.3f}s  {e.get('event', '?'):<18}{_fields(e)}",
+    lines = [(e.get("t", t0), e.get("event", "?"), _fields(e), "")
+             for e in events]
+    for e in fleet or []:
+        t = e.get("t", t0)
+        if t0 <= t <= t_last:
+            lines.append((t, e.get("event", "?"), _fields(e),
+                          " [fleet]"))
+    lines.sort(key=lambda x: x[0])
+    for t, name, fields, tag in lines:
+        print(f"  +{t - t0:8.3f}s  {name:<18}{fields}{tag}",
               file=out)
     # dumps exist only for failed / deadline-missed jobs; job ids
     # restart per server lifetime, so a dump naming a job whose journal
@@ -163,11 +194,12 @@ def main(argv=None) -> int:
     jobs = job_timelines(entries)
 
     out = sys.stdout
+    fleet = fleet_events(entries)
     shown = 0
     for job, events in jobs.items():
         if args.job and job != args.job:
             continue
-        render_job(job, events, dumps, out)
+        render_job(job, events, dumps, out, fleet=fleet)
         shown += 1
     if args.job and not shown:
         print(f"[obsreport] error: job {args.job!r} not in journal "
@@ -192,6 +224,7 @@ def main(argv=None) -> int:
     problems += check_parts_routed(entries)
     problems += check_rounds(entries)
     problems += check_preemptions(entries)
+    problems += check_autoscale(entries)
     for p in problems:
         print(f"consistency: {p}", file=out)
     print(f"consistency: {'OK' if not problems else 'FAIL'} "
@@ -354,6 +387,33 @@ def check_preemptions(entries: list[dict]) -> list[str]:
             problems.append(
                 f"job {job}: {n_pre} preempted events vs "
                 f"{n_res} resumed")
+    return problems
+
+
+def check_autoscale(entries: list[dict]) -> list[str]:
+    """Elasticity-ledger invariant: the autoscaler only drains
+    replicas IT spawned (the operator's configured fleet is the floor
+    it never touches), so every `autoscale-down` must name a replica
+    with a prior, not-yet-drained `autoscale-up` — a down without its
+    up, or a second down for the same spawn, means the up/down ledger
+    lost a transition. Ups left open at the end of the journal are
+    fine: spawned replicas legitimately outlive the window (the next
+    idle pass, or the router's drain, retires them)."""
+    live: dict[str, int] = {}
+    problems: list[str] = []
+    for e in entries:
+        ev = e.get("event")
+        if ev not in ("autoscale-up", "autoscale-down"):
+            continue
+        spec = str(e.get("replica"))
+        if ev == "autoscale-up":
+            live[spec] = live.get(spec, 0) + 1
+        elif live.get(spec, 0) > 0:
+            live[spec] -= 1
+        else:
+            problems.append(
+                f"autoscale-down for {spec!r} without a prior "
+                "autoscale-up (or already drained)")
     return problems
 
 

@@ -59,7 +59,12 @@ Metric extraction understands both artifact shapes:
     requested, rc 2 naming the dotted key when absent). The headline
     `router.jobs_per_s` gates RELATIVELY only against an explicit
     `--against` router artifact — there is no implicit baseline for a
-    replica-count sweep.
+    replica-count sweep. Router artifacts may also carry a `trace`
+    block (the traced-vs-untraced sequential A/B at the top count):
+    `trace.overhead_pct` gates ABSOLUTELY at the established
+    observability budget (default 2.0 whenever the block is present;
+    `--trace-overhead-max` makes it mandatory, rc 2 naming the dotted
+    key when absent).
 
   - servebench `--ramp` artifacts (`"mode": "ramp"`) carry an
     `autoscale` block (the elastic-fleet loop under a 1x->10x Poisson
@@ -261,6 +266,12 @@ def extract(doc: dict, path: str = "<artifact>") -> dict:
         out = {"name": "router jobs/s", "value": float(value),
                "unit": "jobs/sec", "higher_better": True,
                "kind": "router"}
+        # distributed-trace plane cost (the traced-vs-untraced A/B the
+        # router bench runs at its top count): gated absolutely at the
+        # <2% observability budget via trace_checks
+        trace_ov = _lookup(inner, "trace.overhead_pct")
+        if trace_ov is not None:
+            out["trace_overhead_pct"] = float(trace_ov)
         if isinstance(inner.get("mesh"), dict):
             out["mesh"] = inner["mesh"]
         return out
@@ -888,6 +899,29 @@ def audit_checks(cand: dict, args,
     return checks
 
 
+def trace_checks(cand: dict, args,
+                 candidate_path: str) -> list[tuple[str, float, float]]:
+    """Distributed-trace plane gate for servebench --router artifacts:
+    `trace.overhead_pct` (the traced-vs-untraced sequential-job A/B
+    the router bench runs at its top replica count — client spans,
+    router spans, per-replica trace_pull and the clock-chained merge
+    all armed) gates ABSOLUTELY at the established <2% observability
+    budget — default whenever the artifact carries the key, mandatory
+    via `--trace-overhead-max` (an artifact without it then exits 2
+    naming the dotted key)."""
+    explicit = args.trace_overhead_max is not None
+    if "trace_overhead_pct" not in cand:
+        if explicit:
+            raise GateError(
+                f"{candidate_path}: artifact lacks gated metric "
+                "'trace.overhead_pct' (--trace-overhead-max gates "
+                "servebench --router artifacts)")
+        return []
+    limit = args.trace_overhead_max if explicit else 2.0
+    return [("trace.overhead_pct", cand["trace_overhead_pct"],
+             limit)]
+
+
 def wps_floor_check(cand: dict, args,
                     candidate_path: str) -> list[tuple[str, float, float]]:
     """Absolute windows/s floor (--windows-per-s-min): mandatory once
@@ -995,6 +1029,12 @@ def run(args) -> int:
         print(f"[perfgate] {'PASS' if check_ok else 'FAIL'}: "
               f"{os.path.basename(candidate_path)} {name} = {value:g} "
               f"(limit {limit:g})", file=sys.stderr)
+    for name, value, limit in trace_checks(cand, args, candidate_path):
+        check_ok = value <= limit
+        failures += 0 if check_ok else 1
+        print(f"[perfgate] {'PASS' if check_ok else 'FAIL'}: "
+              f"{os.path.basename(candidate_path)} {name} = {value:g}% "
+              f"(limit {limit:g}%)", file=sys.stderr)
     for name, value, limit in slo_checks(doc, cand, args,
                                          candidate_path):
         check_ok = value <= limit
@@ -1105,6 +1145,15 @@ def main(argv=None) -> int:
                          "without it then exits 2 naming the dotted "
                          "key). Artifacts with an audit block are also "
                          "always gated on audit.mismatches == 0")
+    ap.add_argument("--trace-overhead-max", type=float, default=None,
+                    help="absolute bound in PERCENT on the distributed-"
+                         "trace plane's measured cost "
+                         "(trace.overhead_pct, the traced-vs-untraced "
+                         "A/B in servebench --router artifacts; "
+                         "default: gate at 2.0 whenever the artifact "
+                         "carries the key; passing a value makes the "
+                         "gate mandatory — an artifact without it then "
+                         "exits 2 naming the dotted key)")
     ap.add_argument("--scrape-overhead-max", type=float, default=None,
                     help="absolute bound in PERCENT on the fleet "
                          "observability overhead "

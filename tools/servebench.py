@@ -46,7 +46,12 @@ count (zero on a healthy fleet, any requeue fails the bench), the
 router's merge overhead (job wall minus slowest-shard exec) and
 byte-identity vs a direct single-replica submit — plus `scaling_x`
 (jobs/s at N over jobs/s at 1), which tools/perfgate.py gates via
-`router.identical` and `--router-scaling-min`. Sequential single-job
+`router.identical` and `--router-scaling-min`. The block also
+carries the routed time-to-first-part (`ttfb_s`) and, at the top
+count, a `trace` block A/Bing the same job traced vs untraced —
+`trace.overhead_pct`, gated by perfgate's `--trace-overhead-max`
+at the same <2% budget as every other observability tax.
+Sequential single-job
 submits per count additionally measure `range_scaling_x` — how much
 faster ONE job finishes when the router window-range-shards its
 contig across the fleet (a `--contigs 1` workload makes every
@@ -424,7 +429,14 @@ def run_router_bench(args, PolishClient, PolishServer) -> int:
     over jobs/s at 1) which tools/perfgate.py gates via
     `router.identical` (always, when the block is present),
     `--router-scaling-min` and `--range-scaling-min` (each mandatory
-    once requested)."""
+    once requested). The sequential submits also stream parts, so the
+    block carries the routed `ttfb_s` (submit start to the first
+    part-routed frame — the router twin of the direct-submit ttfb),
+    and the top count A/Bs the same job with the distributed-trace
+    plane armed (submit_traced: client + router spans, per-replica
+    trace_pull, clock-chained merge) vs untraced into a `trace`
+    artifact block whose `overhead_pct` perfgate holds to its <=2%
+    budget (`--trace-overhead-max`)."""
     from racon_tpu.serve.queue import nearest_rank
     from racon_tpu.serve.router import PolishRouter
 
@@ -492,15 +504,53 @@ def run_router_bench(args, PolishClient, PolishServer) -> int:
                 seq_cl = PolishClient(
                     socket_path=router.config.socket_path)
                 seq_walls: list[float] = []
+                ttfbs: list[float] = []
                 r_seq = None
                 for _ in range(3):
                     t_seq = time.perf_counter()
-                    r_seq = seq_cl.submit(*paths, retries=5)
+                    marks: list[float] = []
+                    r_seq = seq_cl.submit(
+                        *paths, retries=5,
+                        on_part=lambda f: marks.append(
+                            time.perf_counter()))
                     seq_walls.append(time.perf_counter() - t_seq)
+                    # routed time-to-first-part: submit start to the
+                    # first result_part frame the router forwarded —
+                    # the router twin of the direct-submit ttfb the
+                    # latency sweep reports
+                    if marks:
+                        ttfbs.append(marks[0] - t_seq)
                     if r_seq.fasta != solo.fasta:
                         fail.append(f"router x{c}: sequential job "
                                     "FASTA diverged from the direct "
                                     "single-replica bytes")
+                # trace overhead A/B at the top count: the same
+                # sequential job with the full distributed-trace
+                # plane armed (client spans + router spans + replica
+                # trace_pull + merge) vs the untraced walls above —
+                # min-of-3 on both sides, the steady-state number
+                # perfgate gates as trace.overhead_pct
+                trace_pt = None
+                if c == n_max:
+                    tr_walls: list[float] = []
+                    for _ in range(3):
+                        t_tr = time.perf_counter()
+                        r_tr, _doc = seq_cl.submit_traced(*paths,
+                                                          retries=5)
+                        tr_walls.append(time.perf_counter() - t_tr)
+                        if r_tr.fasta != solo.fasta:
+                            fail.append(
+                                f"router x{c}: traced job FASTA "
+                                "diverged from the direct "
+                                "single-replica bytes")
+                    base_w = min(seq_walls) if seq_walls else 0.0
+                    traced_w = min(tr_walls)
+                    trace_pt = {
+                        "untraced_wall_s": round(base_w, 3),
+                        "traced_wall_s": round(traced_w, 3),
+                        "overhead_pct": round(
+                            (traced_w - base_w)
+                            / max(base_w, 1e-9) * 100.0, 2)}
                 requeues = router.counters["requeues"]
                 router.drain(timeout=30)
                 done = [r for r in results if r is not None]
@@ -524,6 +574,8 @@ def run_router_bench(args, PolishClient, PolishServer) -> int:
                       if shards else 0,
                       "job_wall_s": round(min(seq_walls), 3)
                       if seq_walls else None,
+                      "ttfb_s": round(min(ttfbs), 3)
+                      if ttfbs else None,
                       "range": bool(rb.get("range")),
                       "range_shards": rb.get("range_shards"),
                       "requeues": requeues,
@@ -566,6 +618,7 @@ def run_router_bench(args, PolishClient, PolishServer) -> int:
         "curve": curve,
         "jobs_per_s": curve[-1]["jobs_per_s"] if curve else 0.0,
         "job_wall_s": curve[-1]["job_wall_s"] if curve else None,
+        "ttfb_s": curve[-1]["ttfb_s"] if curve else None,
         "range": bool(curve) and bool(curve[-1].get("range")),
         "requeues": sum(pt["requeues"] for pt in curve),
         "merge_overhead_pct": max(
@@ -601,6 +654,14 @@ def run_router_bench(args, PolishClient, PolishServer) -> int:
     if args.json:
         artifact = {"mode": "router", "jobs": args.jobs,
                     "router": router_block, "pass": not fail}
+        if trace_pt is not None:
+            artifact["trace"] = trace_pt
+            print(f"[servebench] trace overhead: "
+                  f"{trace_pt['overhead_pct']:+.2f}% "
+                  f"({trace_pt['untraced_wall_s']:.2f}s untraced -> "
+                  f"{trace_pt['traced_wall_s']:.2f}s traced — "
+                  "perfgate gates trace.overhead_pct)",
+                  file=sys.stderr)
         with open(args.json, "w") as fh:
             json.dump(artifact, fh, indent=2, sort_keys=True)
         print(f"[servebench] wrote {args.json}", file=sys.stderr)

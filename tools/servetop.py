@@ -151,14 +151,21 @@ def replica_row(rs, prev: dict, dt: float) -> dict:
 
 
 def tenant_rows(snap) -> list[dict]:
-    """Merged per-tenant queued/credit across the fleet."""
+    """Merged per-tenant queued/credit/device-seconds across the
+    fleet (device_s from the prorated cost-accounting counter)."""
     tenants: dict[str, dict] = {}
+
+    def _row(t: str) -> dict:
+        return tenants.setdefault(
+            t, {"queued": 0, "credit": 0.0, "device_s": 0.0})
+
     for name, key in ((G + "tenant_queue_depth", "queued"),
                       (G + "tenant_credit", "credit")):
         for labels, v in snap.gauge_series.get(name, {}).values():
-            t = labels.get("tenant", "")
-            row = tenants.setdefault(t, {"queued": 0, "credit": 0.0})
-            row[key] = row.get(key, 0) + v
+            _row(labels.get("tenant", ""))[key] += v
+    for labels, v in snap.counter_series.get(
+            G + "tenant_device_seconds_total", {}).values():
+        _row(labels.get("tenant", ""))["device_s"] += v
     return [dict(row, tenant=t or "<anon>")
             for t, row in sorted(tenants.items())]
 
@@ -307,10 +314,12 @@ def render_screen(snap, burn: dict, rows: list[dict], prev: dict,
     tenants = tenant_rows(snap)
     if tenants:
         lines.append("")
-        lines.append(f"{'tenant':<20} {'queued':>6} {'credit':>8}")
+        lines.append(f"{'tenant':<20} {'queued':>6} {'credit':>8} "
+                     f"{'dev-s':>8}")
         for t in tenants:
             lines.append(f"{t['tenant']:<20} {int(t['queued']):>6} "
-                         f"{t['credit']:>8.2f}")
+                         f"{t['credit']:>8.2f} "
+                         f"{t.get('device_s', 0.0):>8.2f}")
     tunes = autotune_rows(snap)
     if tunes:
         lines.append("")
