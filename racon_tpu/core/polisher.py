@@ -172,6 +172,15 @@ class Polisher:
         # (the default) is the classic whole-target run, byte-identical
         # to the pre-range code path.
         self.window_range: tuple[int, int] | None = None
+        # fragment read-range shard slice (serve/router.py fragment
+        # fan-out): (lo, hi) TARGET-INDEX bounds — initialize() keeps
+        # only targets whose index in the target file falls in [lo, hi).
+        # Overlaps onto dropped targets resolve to no target and are
+        # skipped (Overlap.transmute marks them invalid), so a shard
+        # corrects exactly its read slice. None (the default) is the
+        # classic whole-set run. Orthogonal to window_range, which
+        # slices one target's COORDINATE axis.
+        self.target_range: tuple[int, int] | None = None
         #: per-contig segment accounting for range-shard runs —
         #: {name: {polished, windows, total_windows, coverage, lo, hi}};
         #: the router re-derives the solo LN/RC/XC tags from these when
@@ -429,6 +438,26 @@ class Polisher:
         # -- targets (loaded whole; reference polisher.cpp:202-217)
         self.tparser.reset()
         self.tparser.parse(self.sequences, -1)
+        target_base = 0
+        if self.target_range is not None:
+            # fragment read-range shard: keep only the targets whose
+            # FILE index falls in [lo, hi). The id_to_id keys below use
+            # the original file index, so id-keyed overlap formats
+            # (MHAP) resolve identically to name-keyed ones; overlaps
+            # onto dropped targets simply fail to resolve and are
+            # skipped as invalid.
+            lo, hi = self.target_range
+            total = len(self.sequences)
+            lo, hi = max(0, int(lo)), min(int(hi), total)
+            if hi <= lo:
+                raise RaconError(
+                    "Polisher.initialize",
+                    f"target_range [{self.target_range[0]}, "
+                    f"{self.target_range[1]}) selects no targets out of "
+                    f"{total}!")
+            del self.sequences[hi:]
+            del self.sequences[:lo]
+            target_base = lo
         targets_size = len(self.sequences)
         self._num_targets = targets_size
         if targets_size == 0:
@@ -438,7 +467,7 @@ class Polisher:
         id_to_id: dict[int, int] = {}
         for i in range(targets_size):
             name_to_id[self.sequences[i].name + "t"] = i
-            id_to_id[i << 1 | 1] = i
+            id_to_id[(target_base + i) << 1 | 1] = i
 
         has_name = [True] * targets_size
         has_data = [True] * targets_size
@@ -496,7 +525,11 @@ class Polisher:
         # -- overlaps streamed; per-query filtering (polisher.cpp:284-355)
         overlaps = self._load_overlaps(name_to_id, id_to_id,
                                        has_data, has_reverse_data)
-        if not overlaps:
+        if not overlaps and self.target_range is None:
+            # a fragment read-range shard may legitimately hold only
+            # targets without overlaps (they come back unpolished, and
+            # drop the same way a solo run drops them) — the whole-set
+            # run keeps the reference's hard error
             raise RaconError("Polisher.initialize", "empty overlap set!")
 
         log.log("[racon_tpu::Polisher.initialize] loaded overlaps")
@@ -813,7 +846,8 @@ class Polisher:
 
     # ---------------------------------------------------------------- polish
     def polish(self, drop_unpolished_sequences: bool = True,
-               batcher=None, on_part=None) -> list[Sequence]:
+               batcher=None, on_part=None, on_group=None,
+               group_size: int = 64) -> list[Sequence]:
         """Per-window consensus + stitch (reference polisher.cpp:486-548).
 
         Set RACON_TPU_PROFILE=<dir> (CLI: --tpu-jax-profile) to capture a
@@ -834,12 +868,24 @@ class Polisher:
         results are independent of batch composition, so both the
         streamed parts and the final list stay byte-identical to a solo
         run (test-pinned).
+
+        `on_group` (fragment serve jobs, mutually exclusive with
+        `on_part`) swaps the streamer for the read-order
+        FragmentStreamer: callable(list[Sequence], lo, hi) receives
+        corrected reads in bounded groups of `group_size` instead of
+        one callback per read — see FragmentStreamer.
         """
         import time as _time
 
         if batcher is not None:
-            streamer = ContigStreamer(self, drop_unpolished_sequences,
-                                      on_part)
+            if on_group is not None:
+                streamer = FragmentStreamer(self,
+                                            drop_unpolished_sequences,
+                                            on_group, group_size)
+            else:
+                streamer = ContigStreamer(self,
+                                          drop_unpolished_sequences,
+                                          on_part)
             batcher.consensus(self, on_windows=streamer.on_windows)
             dst = streamer.finish()
             stitch_s = streamer.stitch_s
@@ -1098,4 +1144,64 @@ class ContigStreamer:
         """The full stitched output, identical to `_stitch`'s list.
         Valid once the batcher's consensus() returned (every window
         delivered)."""
+        return self._out
+
+
+class FragmentStreamer(ContigStreamer):
+    """Read-order analogue of ContigStreamer for fragment correction
+    (PolisherType.kF): every target is a READ, so the per-contig
+    delivery contract would mean one `result_part` frame per read —
+    millions of tiny frames on a real read set. Corrected reads instead
+    ship in bounded GROUPS: `on_group(seqs, lo, hi)` fires once per
+    completed group of `group_size` consecutive targets, where
+    [lo, hi) is the contiguous local target-INDEX range the group
+    covers. Reads dropped as unpolished still advance the range (a
+    group may even be empty), so sibling shards' group receipts tile
+    the read axis exactly — the dedupe/requeue ledger and obsreport's
+    receipt checks lean on that.
+
+    finish() flushes the final partial group; the returned list is the
+    authoritative output, byte-identical to `Polisher._stitch`'s
+    one-shot result exactly like the contig streamer's. `on_group`
+    exceptions are swallowed (streaming is decoration)."""
+
+    def __init__(self, polisher: "Polisher", drop_unpolished: bool,
+                 on_group=None, group_size: int = 64):
+        super().__init__(polisher, drop_unpolished, on_part=None)
+        self._on_group = on_group
+        self._group_size = max(1, int(group_size))
+        self._pend: list[Sequence] = []
+        self._group_lo = 0
+
+    def on_windows(self, windows: list[Window]) -> None:
+        for w in windows:
+            self._remaining[self._contig_of[id(w)]] -= 1
+        while (self._next < len(self._slices)
+               and self._remaining[self._next] == 0):
+            start, end = self._slices[self._next]
+            t0 = time.perf_counter()
+            seq = self._polisher._stitch_contig(
+                self._polisher.windows[start:end], self._drop)
+            self.stitch_s += time.perf_counter() - t0
+            self._next += 1
+            if seq is not None:
+                self._out.append(seq)
+                self._pend.append(seq)
+            if self._next - self._group_lo >= self._group_size:
+                self._flush_group()
+
+    def _flush_group(self) -> None:
+        if self._next == self._group_lo:
+            return
+        group, lo, hi = self._pend, self._group_lo, self._next
+        self._pend = []
+        self._group_lo = self._next
+        if self._on_group is not None:
+            try:
+                self._on_group(group, lo, hi)
+            except Exception:  # noqa: BLE001 — see docstring
+                pass
+
+    def finish(self) -> list[Sequence]:
+        self._flush_group()
         return self._out

@@ -48,7 +48,7 @@ DEFAULT_MAX_BYTES = 8 << 20
 #: finished-late job (it still terminates via `finished`), not terminal.
 TERMINAL_EVENTS = frozenset((
     "finished", "failed", "expired", "rejected-full",
-    "rejected-quota", "rejected-draining"))
+    "rejected-quota", "rejected-draining", "rejected-ingest"))
 
 #: terminal states that imply the job actually ran (must pair with a
 #: `started` event)

@@ -50,10 +50,35 @@ def _write(path: str, seqs, ext: str) -> None:
                 f.write(b">" + s.name.encode() + b"\n" + s.data + b"\n")
 
 
+def _resolve_seed(seed: int | None) -> int:
+    """Explicit `seed=` wins; RACON_TPU_SUBSAMPLE_SEED next; 17 (the
+    historical constant) last. A typo'd env value is a hard error — a
+    silently random subsample is exactly the nondeterminism the seed
+    exists to prevent."""
+    if seed is not None:
+        return int(seed)
+    raw = os.environ.get("RACON_TPU_SUBSAMPLE_SEED")
+    if raw is None:
+        return 17
+    try:
+        return int(raw)
+    except ValueError:
+        raise RaconError(
+            "rampler.subsample",
+            f"invalid RACON_TPU_SUBSAMPLE_SEED {raw!r} (want an "
+            "integer)!") from None
+
+
 def subsample(sequences_path: str, reference_length: int, coverage: int,
-              out_directory: str = ".", seed: int = 17) -> str:
+              out_directory: str = ".", seed: int | None = None) -> str:
     """Random subsample to ~reference_length * coverage total bases.
-    Returns the output path `<base>_<coverage>x.<ext>`."""
+    Returns the output path `<base>_<coverage>x.<ext>`.
+
+    Deterministic: the shuffle is seeded (explicit `seed=`, else
+    RACON_TPU_SUBSAMPLE_SEED, else a fixed default), so the same inputs
+    and seed always pick the same reads — subsample-on-admit
+    (serve/ingest.py) and tests rely on this."""
+    seed = _resolve_seed(seed)
     seqs = _load(sequences_path)
     base, ext = _base_and_ext(sequences_path)
     if ext == ".fastq" and not all(s.quality for s in seqs):
@@ -116,6 +141,9 @@ def main(argv: list[str] | None = None) -> int:
     p_sub.add_argument("sequences")
     p_sub.add_argument("reference_length", type=int)
     p_sub.add_argument("coverage", type=int)
+    p_sub.add_argument("--seed", type=int, default=None,
+                       help="shuffle seed (default: "
+                            "RACON_TPU_SUBSAMPLE_SEED, else 17)")
     p_spl = sub.add_parser("split")
     p_spl.add_argument("sequences")
     p_spl.add_argument("chunk_size", type=int)
@@ -124,7 +152,7 @@ def main(argv: list[str] | None = None) -> int:
     try:
         if args.mode == "subsample":
             subsample(args.sequences, args.reference_length, args.coverage,
-                      args.out_directory)
+                      args.out_directory, seed=args.seed)
         else:
             split(args.sequences, args.chunk_size, args.out_directory)
     except RaconError as exc:

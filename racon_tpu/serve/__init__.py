@@ -35,13 +35,16 @@ from .batcher import WindowBatcher
 from .client import (DeadlineDoomed, JobCancelled, JobFailed,
                      PolishClient, PolishResult, QueueFull, ServeError,
                      ServerDraining, TenantQuota)
+from .ingest import IngestError
 from .queue import Job, JobQueue
 from .router import PolishRouter, RouterConfig
-from .server import PolishServer, ServeConfig, make_synth_dataset
+from .server import (PolishServer, ServeConfig,
+                     make_fragment_dataset, make_synth_dataset)
 
 __all__ = ["WindowBatcher", "PolishClient", "PolishResult", "PolishServer",
            "PolishRouter", "RouterConfig",
            "ServeConfig", "Job", "JobQueue", "ServeError", "QueueFull",
            "ServerDraining", "TenantQuota", "JobFailed",
            "JobCancelled", "DeadlineDoomed",
-           "make_synth_dataset"]
+           "IngestError",
+           "make_fragment_dataset", "make_synth_dataset"]

@@ -301,6 +301,10 @@ class PolishClient:
                fault_plan: str | None = None, strict: bool | None = None,
                trace: bool = False, trace_id: str | None = None,
                tenant: str | None = None, rounds: int | None = None,
+               fragment: bool = False,
+               frag_lo: int | None = None, frag_hi: int | None = None,
+               ingest: bool = False, subsample: dict | None = None,
+               normalize: bool = False,
                on_progress=None, on_part=None, stream: bool = False,
                recorder=None, retries: int = 0,
                cancel_on_timeout: bool = False) -> PolishResult:
@@ -354,6 +358,24 @@ class PolishClient:
             req["tenant"] = str(tenant)
         if rounds is not None:
             req["rounds"] = int(rounds)
+        if fragment:
+            # fragment traffic class (`mode: "fragment"`): corrected
+            # reads instead of polished contigs — PolisherType.kF with
+            # bounded-group result_part streaming (protocol.py
+            # "Fragment jobs")
+            req["mode"] = "fragment"
+        if frag_lo is not None:
+            req["frag_lo"] = int(frag_lo)
+        if frag_hi is not None:
+            req["frag_hi"] = int(frag_hi)
+        # admit-time ingest plane (serve/ingest.py): validate-only,
+        # subsample-on-admit, paired-end normalization
+        if ingest:
+            req["ingest"] = True
+        if subsample is not None:
+            req["subsample"] = dict(subsample)
+        if normalize:
+            req["normalize"] = True
         if on_progress is not None:
             req["progress"] = True
         if stream or on_part is not None:
@@ -651,7 +673,28 @@ def submit_main(argv: list[str]) -> int:
                          "Perfetto) with both sides on a handshake-"
                          "aligned timeline")
     ap.add_argument("-u", "--include-unpolished", action="store_true")
-    ap.add_argument("-f", "--fragment-correction", action="store_true")
+    ap.add_argument("-f", "--fragment-correction", action="store_true",
+                    help="fragment (read) error correction instead of "
+                         "contig polishing: submits the job with "
+                         "mode \"fragment\" — corrected reads stream "
+                         "in bounded groups, byte-identical to the "
+                         "one-shot CLI's -f output")
+    ap.add_argument("--ingest", action="store_true",
+                    help="admit-time validation: the server streaming-"
+                         "parses all three inputs before queueing, so "
+                         "a malformed file fails typed at the door")
+    ap.add_argument("--subsample", nargs=2, type=int, default=None,
+                    metavar=("REF_LEN", "COV"),
+                    help="subsample-on-admit: the server subsamples "
+                         "the reads to ~REF_LEN*COV bases (seeded "
+                         "rampler.subsample) before polishing")
+    ap.add_argument("--subsample-seed", type=int, default=None,
+                    help="explicit subsample shuffle seed (default: "
+                         "the server's RACON_TPU_SUBSAMPLE_SEED, else "
+                         "the fixed default)")
+    ap.add_argument("--normalize", action="store_true",
+                    help="paired-end header normalization on admit "
+                         "(racon_tpu preprocess equivalent)")
     ap.add_argument("-w", "--window-length", type=int, default=None)
     ap.add_argument("-q", "--quality-threshold", type=float, default=None)
     ap.add_argument("-e", "--error-threshold", type=float, default=None)
@@ -667,8 +710,6 @@ def submit_main(argv: list[str]) -> int:
 
     options: dict = {}
     for key, val in (("include_unpolished", args.include_unpolished
-                      or None),
-                     ("fragment_correction", args.fragment_correction
                       or None),
                      ("window_length", args.window_length),
                      ("quality_threshold", args.quality_threshold),
@@ -694,10 +735,19 @@ def submit_main(argv: list[str]) -> int:
             sys.stdout.buffer.write(
                 frame.get("fasta", "").encode("latin-1"))
             sys.stdout.buffer.flush()
+    subsample = None
+    if args.subsample is not None:
+        subsample = {"reference_length": args.subsample[0],
+                     "coverage": args.subsample[1]}
+        if args.subsample_seed is not None:
+            subsample["seed"] = args.subsample_seed
     common = dict(options=options, priority=args.priority,
                   deadline_s=args.deadline, retries=args.retries,
                   tenant=args.tenant, rounds=args.rounds,
                   trace_id=args.trace_id,
+                  fragment=args.fragment_correction,
+                  ingest=args.ingest, subsample=subsample,
+                  normalize=args.normalize,
                   on_progress=on_progress, on_part=on_part,
                   cancel_on_timeout=args.cancel_on_timeout)
     trace_doc = None

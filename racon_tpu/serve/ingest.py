@@ -1,0 +1,204 @@
+"""Admit-time streaming ingest plane.
+
+The reference's L7 wrapper owns ingest: `racon_wrapper` subsamples reads
+with rampler and `racon_preprocess` uniquifies paired-end headers BEFORE
+racon ever sees a file. This module promotes that role into the server,
+so clients ship raw (optionally gzipped) FASTA/FASTQ/SAM files and opt
+in per job on the submit frame:
+
+    ingest: true                  validate-only — streaming-parse all
+                                  three inputs on admit so a malformed
+                                  file fails the job typed at the door
+                                  instead of mid-polish
+    subsample: {reference_length: int, coverage: int[, seed: int]}
+                                  subsample-on-admit via the seeded
+                                  `rampler.subsample` (deterministic:
+                                  explicit seed, else
+                                  RACON_TPU_SUBSAMPLE_SEED, else the
+                                  fixed default)
+    normalize: true               paired-end header uniquification via
+                                  `preprocess.process` (mate 1 -> "1",
+                                  mate 2 -> "2" suffixes)
+
+Any opt-in implies validation. All parsing is STREAMING — bounded
+chunks through the framework parsers (gzip sniffed from magic bytes),
+never a whole-file slurp — so a multi-GiB read set costs O(chunk)
+admit-time memory. Failures raise `IngestError` (typed with the failing
+stage); the server maps that to a `bad-request` response plus a
+`rejected-ingest` journal terminal. Jobs that opt in get `ingested` /
+`normalized` / `subsampled` journal annotations; jobs that don't never
+touch this module, keeping the flagless serve surface byte-identical.
+
+Rewritten inputs (subsample output, normalized reads) land in the
+server-lifetime ingest workdir (PolishServer._ingest_workdir), named by
+job id so concurrent admits never collide.
+"""
+
+from __future__ import annotations
+
+import os
+
+from ..errors import RaconError
+from ..io.parsers import create_overlap_parser, create_sequence_parser
+
+#: per-parse byte budget: the admit-time memory bound. Matches the
+#: polisher's own streaming chunk scale — large enough to amortize the
+#: generator overhead, small enough that admission never balloons.
+CHUNK_BYTES = 4 << 20
+
+
+class IngestError(RaconError):
+    """A typed admit-time ingest failure. `stage` names the phase that
+    failed — "spec" (malformed opt-in keys), "validate" (parse error in
+    an input file), "normalize", or "subsample" — and rides the
+    `rejected-ingest` journal line as `error`."""
+
+    def __init__(self, stage: str, message: str):
+        self.stage = stage
+        super().__init__(f"serve.ingest.{stage}", message)
+
+
+class IngestSpec:
+    """Validated ingest opt-in parsed from a submit frame."""
+
+    __slots__ = ("subsample", "normalize")
+
+    def __init__(self, subsample: dict | None = None,
+                 normalize: bool = False):
+        self.subsample = subsample
+        self.normalize = normalize
+
+    @classmethod
+    def from_request(cls, req: dict) -> "IngestSpec":
+        """Parse and validate the `ingest` / `subsample` / `normalize`
+        submit-frame keys. Raises IngestError("spec") on any malformed
+        shape — the server maps that to `bad-request` BEFORE a job id
+        is minted."""
+        ing = req.get("ingest")
+        if ing is not None and not isinstance(ing, bool):
+            raise IngestError("spec", "ingest must be a boolean")
+        norm = req.get("normalize")
+        if norm is not None and not isinstance(norm, bool):
+            raise IngestError("spec", "normalize must be a boolean")
+        sub = req.get("subsample")
+        if sub is not None:
+            if not isinstance(sub, dict):
+                raise IngestError(
+                    "spec",
+                    "subsample must be an object like "
+                    "{reference_length, coverage}")
+            unknown = set(sub) - {"reference_length", "coverage", "seed"}
+            if unknown:
+                raise IngestError(
+                    "spec",
+                    "unknown subsample key(s): "
+                    f"{', '.join(sorted(unknown))}")
+            for key in ("reference_length", "coverage"):
+                val = sub.get(key)
+                if isinstance(val, bool) or not isinstance(val, int) \
+                        or val <= 0:
+                    raise IngestError(
+                        "spec",
+                        f"subsample.{key} must be a positive integer")
+            seed = sub.get("seed")
+            if seed is not None and (isinstance(seed, bool)
+                                     or not isinstance(seed, int)):
+                raise IngestError(
+                    "spec", "subsample.seed must be an integer")
+        return cls(subsample=dict(sub) if sub else None,
+                   normalize=bool(norm))
+
+
+def _count_sequences(path: str) -> tuple[int, int]:
+    """Streaming-validate one sequence file; returns (records, bytes).
+    Bounded memory: each CHUNK_BYTES batch of records is discarded
+    before the next is parsed."""
+    try:
+        parser = create_sequence_parser(path, "serve.ingest")
+        records = 0
+        nbytes = 0
+        more = True
+        while more:
+            chunk: list = []
+            more = parser.parse(chunk, CHUNK_BYTES)
+            records += len(chunk)
+            nbytes += sum(len(s.data) for s in chunk)
+    except RaconError as exc:
+        raise IngestError("validate", str(exc)) from None
+    if records == 0:
+        raise IngestError("validate", f"empty sequence file {path}!")
+    return records, nbytes
+
+
+def _count_overlaps(path: str) -> int:
+    """Streaming-validate one overlap file; returns the record count."""
+    try:
+        parser = create_overlap_parser(path, "serve.ingest")
+        records = 0
+        more = True
+        while more:
+            chunk: list = []
+            more = parser.parse(chunk, CHUNK_BYTES)
+            records += len(chunk)
+    except RaconError as exc:
+        raise IngestError("validate", str(exc)) from None
+    if records == 0:
+        raise IngestError("validate", f"empty overlap file {path}!")
+    return records
+
+
+def prepare(sequences: str, overlaps: str, target: str,
+            spec: IngestSpec, workdir: str, job_id: str,
+            trace_id: str | None = None,
+            journal=None) -> tuple[str, str, str]:
+    """Run the admit-time ingest pipeline for one job: validate all
+    three inputs (always), then optionally pair-normalize and/or
+    subsample the reads. Returns the (sequences, overlaps, target)
+    paths the job should actually polish — rewritten files live in
+    `workdir`, untouched stages pass the original paths through."""
+    n_reads, read_bytes = _count_sequences(sequences)
+    n_targets, _ = _count_sequences(target)
+    n_overlaps = _count_overlaps(overlaps)
+    if journal is not None:
+        journal.record("ingested", job=job_id, trace=trace_id,
+                       reads=n_reads, read_bytes=read_bytes,
+                       targets=n_targets, overlaps=n_overlaps)
+
+    if spec.normalize:
+        # paired-end header uniquification (preprocess.process): output
+        # is FASTQ by construction (dummy qualities for FASTA input)
+        from .. import preprocess
+
+        norm_path = os.path.join(workdir, f"{job_id}_norm.fastq")
+        try:
+            with open(norm_path, "wb") as fh:
+                preprocess.process([sequences], out=fh)
+        except RaconError as exc:
+            raise IngestError("normalize", str(exc)) from None
+        sequences = norm_path
+        if journal is not None:
+            journal.record("normalized", job=job_id, trace=trace_id,
+                           reads=n_reads)
+
+    if spec.subsample is not None:
+        from .. import rampler
+
+        sub = spec.subsample
+        subdir = os.path.join(workdir, job_id)
+        os.makedirs(subdir, exist_ok=True)
+        try:
+            sub_path = rampler.subsample(
+                sequences, sub["reference_length"], sub["coverage"],
+                out_directory=subdir, seed=sub.get("seed"))
+        except RaconError as exc:
+            raise IngestError("subsample", str(exc)) from None
+        reads_out, _ = _count_sequences(sub_path)
+        if journal is not None:
+            journal.record("subsampled", job=job_id, trace=trace_id,
+                           reads_in=n_reads, reads_out=reads_out,
+                           reference_length=sub["reference_length"],
+                           coverage=sub["coverage"],
+                           seed=sub.get("seed"))
+        sequences = sub_path
+
+    return sequences, overlaps, target

@@ -142,6 +142,56 @@ cannot be combined with ``rounds`` (typed ``bad-request``). Because a
 pre-range replica would silently ignore the keys and return the FULL
 contig, the router treats a range part arriving without ``seg`` as a
 typed ``replica-incompatible`` failure rather than merging garbage.
+
+Fragment jobs (read error correction, README "Fragment correction &
+ingest"): a ``submit`` may carry ``mode: "contig"`` (the default, a
+no-op) or ``mode: "fragment"``, which routes the job into the
+reference's second workload — ``PolisherType.kF`` read correction
+(one-shot CLI ``-f``) — through the same warm-reuse / continuous-
+batcher / QoS / audit / journal path contig jobs use. Because targets
+are many small reads, a streaming fragment job ships its corrected
+reads in BOUNDED GROUPS, never one frame per read: each
+``result_part`` frame carries ``{"part", "reads", "frag": [lo, hi),
+"fasta"}`` — ``fasta`` is the classic concatenation-is-the-body FASTA
+of up to ``frag_group`` (RACON_TPU_FRAG_GROUP, default 64) consecutive
+corrected reads, ``reads`` how many survived dropping, and ``frag``
+the half-open GLOBAL target-index interval the group accounts for
+(dropped reads still advance it, so consecutive frames' intervals
+tile). Invalid combinations are typed ``bad-request``: an unknown
+``mode`` value, ``mode: "fragment"`` with ``range_lo``/``range_hi``
+(fragment jobs shard the read INDEX axis, not a coordinate axis), and
+``mode: "fragment"`` with ``rounds > 1`` (corrected reads are not a
+draft to re-map onto; ``rounds: 1`` is accepted). A submit WITHOUT a
+``mode`` field is byte-identical to the pre-fragment wire contract —
+including legacy ``options.fragment_correction`` jobs, which keep
+their per-contig streaming shape.
+
+Fragment child jobs (read-range sharding, serve/router.py): the
+router's third planner shards a ``mode: "fragment"`` submit across
+replicas by TARGET-INDEX slices at read boundaries — every child
+shares the parent's original target path (no per-shard file rewrite)
+and adds ``frag_lo`` / ``frag_hi`` (integers, ``0 <= lo < hi``,
+require ``mode: "fragment"``, reject ``rounds``): the replica corrects
+only the reads whose target-file index falls in ``[lo, hi)`` and
+rebases its group frames' ``frag`` receipts to the GLOBAL read axis.
+Slices are contiguous and ascending, so the router's shard-order merge
+IS global read order, and the requeue/dedupe ledger (kill -9 failover,
+preemption, tracing all unchanged) operates at read-group granularity
+— the ``frag`` receipts across shards tile ``[0, n_reads)``. The
+routed ``result`` adds ``fragment: true`` / ``frag_shards`` /
+``reads`` to its ``router`` block.
+
+Admit-time ingest (serve/ingest.py, README "Fragment correction &
+ingest"): a ``submit`` may opt in with ``ingest: true`` (streaming-
+validate all three inputs on admit — gzipped FASTA/FASTQ/SAM parsed in
+bounded chunks; a malformed file fails typed ``bad-request`` with a
+``rejected-ingest`` journal terminal, never mid-polish and never the
+server), ``subsample: {"reference_length": int, "coverage": int,
+"seed"?: int}`` (subsample-on-admit through the seeded
+``rampler.subsample`` — deterministic, so resubmits and router
+children agree byte-for-byte) and/or ``normalize: true`` (paired-end
+header uniquification, the ``racon_tpu preprocess`` role). Jobs
+without these keys never touch the ingest plane.
 """
 
 from __future__ import annotations

@@ -188,7 +188,8 @@ class Job:
                  "want_trace", "enqueued_t", "started_t", "response",
                  "event", "stats_ref", "trace_id", "want_progress",
                  "want_stream", "tenant", "rounds", "cancelled",
-                 "range_lo", "range_hi", "_outbox")
+                 "range_lo", "range_hi", "fragment", "frag_lo",
+                 "frag_hi", "_outbox")
 
     def __init__(self, id_: str, sequences: str, overlaps: str,
                  target: str, options: dict, priority: int = 0,
@@ -200,7 +201,10 @@ class Job:
                  want_stream: bool = False, tenant: str = "",
                  rounds: int | None = None,
                  range_lo: int | None = None,
-                 range_hi: int | None = None):
+                 range_hi: int | None = None,
+                 fragment: bool = False,
+                 frag_lo: int | None = None,
+                 frag_hi: int | None = None):
         self.id = id_
         self.sequences = sequences
         self.overlaps = overlaps
@@ -236,6 +240,19 @@ class Job:
         #: (enforced at submit validation).
         self.range_lo = range_lo
         self.range_hi = range_hi
+        #: fragment traffic class (`mode: "fragment"` on the submit
+        #: frame, protocol.py "Fragment jobs"): the worker runs
+        #: PolisherType.kF and streams corrected reads in bounded
+        #: GROUPS through the read-order FragmentStreamer instead of
+        #: one part per target. Mutually exclusive with range_lo/hi
+        #: and with rounds > 1 (enforced at submit validation).
+        self.fragment = bool(fragment)
+        #: fragment read-range shard slice (router fan-out, protocol.py
+        #: "Fragment child jobs"): the worker corrects only the reads
+        #: whose TARGET-FILE index falls in [frag_lo, frag_hi); None =
+        #: the whole read set. Requires `fragment`.
+        self.frag_lo = frag_lo
+        self.frag_hi = frag_hi
         #: cancel-RPC flag for RUNNING jobs the batcher cannot reach
         #: (isolation/solo paths never pool): the worker checks it at
         #: round boundaries and fails the job typed `cancelled`

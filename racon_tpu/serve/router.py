@@ -122,7 +122,7 @@ DEFAULT_ROUTER_SOCKET = "/tmp/racon_tpu_router.sock"
 ROUTER_EVENTS = frozenset((
     "router-start", "router-stop", "shard-dispatched", "shard-finished",
     "part-routed", "requeued", "replica-down", "replica-up",
-    "cancelled", "siblings-cancelled", "range-plan",
+    "cancelled", "siblings-cancelled", "range-plan", "frag-plan",
     "replica-added", "replica-removed", "autoscale-up",
     "autoscale-down", "hold"))
 
@@ -337,6 +337,15 @@ class _JobMerge:
         #: accepted range segments (post-dedupe) — the obsreport
         #: receipt unit; classic mode leaves it 0
         self.segments_routed = 0
+        #: fragment mode: (shard, buffered-part position) -> the
+        #: frame's global read-axis receipt (frag_lo, frag_hi, reads).
+        #: Keyed by BUFFER position so a requeued shard's re-streamed
+        #: duplicates (dropped above by the arrived/len dedupe) never
+        #: re-record a receipt — part-routed stays one line per
+        #: accepted read group.
+        self._frag_meta: dict[tuple[int, int], tuple] = {}
+        #: corrected reads routed (sum of accepted groups' `reads`)
+        self.reads_routed = 0
 
     def on_part(self, k: int, frame: dict) -> None:
         with self.lock:
@@ -366,6 +375,14 @@ class _JobMerge:
                                     lo=seg.get("lo"), hi=seg.get("hi"))
                 self._pump_locked()
                 return
+            frag = frame.get("frag")
+            if isinstance(frag, (list, tuple)) and len(frag) == 2:
+                # fragment group: remember the read-axis receipt for
+                # this buffered position so the in-order pump can
+                # journal it (part-routed frag_lo/frag_hi tiling)
+                self._frag_meta[(k, len(self.parts[k]))] = (
+                    frag[0], frag[1], frame.get("reads"))
+                self.reads_routed += int(frame.get("reads") or 0)
             self.parts[k].append(
                 (frame.get("name"), frame.get("fasta", "")))
             self._pump_locked()
@@ -394,11 +411,18 @@ class _JobMerge:
             k = self._cursor_shard
             while self._cursor_part < len(self.parts[k]):
                 name, fasta = self.parts[k][self._cursor_part]
+                meta = self._frag_meta.get((k, self._cursor_part))
                 part_index = self.total_routed
                 self.total_routed += 1
                 self._cursor_part += 1
                 if self._on_routed is not None:
-                    self._on_routed(k, part_index, name, len(fasta))
+                    if meta is not None:
+                        self._on_routed(k, part_index, name,
+                                        len(fasta), frag_lo=meta[0],
+                                        frag_hi=meta[1], reads=meta[2])
+                    else:
+                        self._on_routed(k, part_index, name,
+                                        len(fasta))
                 if self._emit_part is not None:
                     self._emit_part(k, part_index, name, fasta)
             if not self.done[k]:
@@ -1185,7 +1209,32 @@ class PolishRouter:
             # a segment, which is not what solo rounds compute).
             groups: list[dict] | None = None
             shard_ranges: list[tuple[int, int] | None]
-            if cap > len(contigs) and req.get("rounds") is None:
+            # fragment read-range sharding (the third planner): a
+            # fragment job's targets are its READS — many small
+            # records, so the contig planner's whole-record partition
+            # would rewrite a multi-GiB read file per shard. Instead
+            # every child shares the ORIGINAL target path and carries a
+            # [frag_lo, frag_hi) target-INDEX slice at read boundaries
+            # (protocol.py "Fragment child jobs"); slices are
+            # contiguous and ascending, so shard-order concatenation
+            # IS global read order and the classic merge ledger's
+            # part-granularity dedupe = read-GROUP granularity.
+            fragment = req.get("mode") == "fragment"
+            frag_ranges: list[tuple[int, int]] | None = None
+            if fragment:
+                n_reads = len(contigs)
+                n_shards = max(1, min(cap, n_reads))
+                shard_ranges = [None] * n_shards
+                shard_targets = [req["target"]] * n_shards
+                if n_shards > 1:
+                    frag_ranges = [(k * n_reads // n_shards,
+                                    (k + 1) * n_reads // n_shards)
+                                   for k in range(n_shards)]
+                    if self.journal is not None:
+                        self.journal.record(
+                            "frag-plan", job=job_id, trace=trace_id,
+                            shards=n_shards, reads=n_reads)
+            elif cap > len(contigs) and req.get("rounds") is None:
                 wl = 500
                 opts_in = req.get("options")
                 if isinstance(opts_in, dict):
@@ -1237,7 +1286,9 @@ class PolishRouter:
             self.recorder.complete(
                 "router.plan", t0, time.perf_counter(),
                 {"job": job_id, "trace_id": trace_id or job_id,
-                 "mode": "range" if groups is not None else "contig",
+                 "mode": ("fragment" if fragment
+                          else "range" if groups is not None
+                          else "contig"),
                  "shards": n_shards, "contigs": n_contigs})
             requeues_before = self.counters["requeues"]
             emit_part = None
@@ -1285,7 +1336,9 @@ class PolishRouter:
                     target=self._run_shard,
                     args=(req, job_id, trace_id, k, n_shards,
                           shard_targets[k], merge, conn, send_lock,
-                          want_progress, deadline_t, shard_ranges[k]),
+                          want_progress, deadline_t, shard_ranges[k],
+                          frag_ranges[k] if frag_ranges is not None
+                          else None),
                     name=f"racon-tpu-router-{job_id}-s{k}", daemon=True)
                 t.start()
                 threads.append(t)
@@ -1349,6 +1402,10 @@ class PolishRouter:
                 out["router"]["range"] = True
                 out["router"]["range_shards"] = n_shards
                 out["router"]["segments"] = merge.segments_routed
+            if fragment:
+                out["router"]["fragment"] = True
+                out["router"]["frag_shards"] = n_shards
+                out["router"]["reads"] = merge.reads_routed
             if trace_id:
                 out["trace_id"] = trace_id
             if metrics:
@@ -1470,7 +1527,8 @@ class PolishRouter:
                    merge: _JobMerge, conn: socket.socket,
                    send_lock: threading.Lock, want_progress: bool,
                    deadline_t: float | None = None,
-                   rng: tuple[int, int] | None = None) -> None:
+                   rng: tuple[int, int] | None = None,
+                   frng: tuple[int, int] | None = None) -> None:
         """One shard's dispatch loop: submit to the least-loaded
         routable replica, stream parts into the merge, and on replica
         loss requeue to a healthy one (journal-backed, dedupe by the
@@ -1488,7 +1546,8 @@ class PolishRouter:
                        "parent": job_id, "shard": k, "shards": n_shards,
                        "trace_id": f"{trace_id or job_id}.s{k}"}
         for key in ("options", "priority", "fault_plan",
-                    "strict", "tenant", "rounds"):
+                    "strict", "tenant", "rounds", "mode",
+                    "ingest", "subsample", "normalize"):
             if req.get(key) is not None:
                 child[key] = req[key]
         if rng is not None:
@@ -1498,6 +1557,13 @@ class PolishRouter:
             # "Child-job fields"); never combined with rounds (range
             # plans are only built for round-less submits)
             child["range_lo"], child["range_hi"] = rng
+        if frng is not None:
+            # fragment read-range shard: the child shares the parent's
+            # target file and corrects only the reads whose file index
+            # falls in [frag_lo, frag_hi) — group frames come back
+            # with GLOBAL `frag` receipts (the server rebases by
+            # frag_lo), so the merge ledger tiles the read axis
+            child["frag_lo"], child["frag_hi"] = frng
         if want_progress:
             child["progress"] = True
 

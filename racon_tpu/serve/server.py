@@ -352,6 +352,30 @@ class ServeConfig:
                 f"invalid wincache_max_bytes "
                 f"{self.wincache_max_bytes} (expected a positive "
                 "integer)")
+        # fragment streaming group size (core/polisher.FragmentStreamer):
+        # corrected reads of a fragment job ship in bounded groups of
+        # this many targets per result_part frame — one frame per read
+        # would mean millions of tiny frames on a real read set. Strict
+        # env parsing like every other serve knob.
+        if "frag_group" in kw:
+            self.frag_group = int(kw.pop("frag_group"))
+        else:
+            raw = env("RACON_TPU_FRAG_GROUP")
+            if raw:
+                try:
+                    self.frag_group = int(raw)
+                except ValueError:
+                    raise RaconError(
+                        "ServeConfig",
+                        f"invalid RACON_TPU_FRAG_GROUP value {raw!r} "
+                        "(expected an integer)") from None
+            else:
+                self.frag_group = 64
+        if self.frag_group <= 0:
+            raise RaconError(
+                "ServeConfig",
+                f"invalid frag_group {self.frag_group} (expected a "
+                "positive integer)")
         self.warmup = kw.pop("warmup", True)
         self.max_frame = kw.pop("max_frame", max_frame_bytes())
         # telemetry exposition: None = no HTTP endpoint (the scrape RPC
@@ -494,6 +518,72 @@ def make_synth_dataset(dirname: str, seed: int = 11,
     return paths
 
 
+def make_fragment_dataset(dirname: str, seed: int = 13,
+                          genome_len: int = 2000, read_len: int = 400,
+                          step: int = 100) -> tuple[str, str, str]:
+    """Tiny deterministic reads-correcting-reads dataset for the
+    fragment traffic class: staggered noisy reads off one truth genome
+    plus their all-vs-all overlaps (PAF rows between position-adjacent
+    read pairs). Returns (sequences, overlaps, target) where sequences
+    and target are the SAME reads file — the one-shot CLI's
+    `racon_tpu -f reads.fasta.gz ava.paf.gz reads.fasta.gz` shape —
+    used by the serve fragment tests, servebench --fragment and
+    faultcheck."""
+    rng = random.Random(seed)
+    acgt = b"ACGT"
+
+    def mutate(s, rate):
+        out = bytearray()
+        for c in s:
+            r = rng.random()
+            if r < rate / 3:
+                continue
+            if r < 2 * rate / 3:
+                out.append(rng.choice(acgt))
+                out.append(c)
+                continue
+            if r < rate:
+                out.append(rng.choice(acgt))
+                continue
+            out.append(c)
+        return bytes(out)
+
+    truth = bytes(rng.choice(acgt) for _ in range(genome_len))
+    reads: list[tuple[str, bytes, int, int]] = []
+    for k, start in enumerate(range(0, genome_len - read_len + 1,
+                                    step)):
+        end = min(start + read_len, genome_len)
+        reads.append((f"f{k}", mutate(truth[start:end], 0.05),
+                      start, end))
+    paf = []
+    for qn, qd, qs0, qe0 in reads:
+        for tn, td, ts0, te0 in reads:
+            if qn == tn:
+                continue
+            ov0, ov1 = max(qs0, ts0), min(qe0, te0)
+            if ov1 - ov0 < read_len // 4:
+                continue  # only meaningfully overlapping pairs
+            # truth-coordinate overlap mapped onto each noisy read,
+            # clamped to its (indel-shifted) actual length
+            qlo = min(max(0, ov0 - qs0), len(qd))
+            qhi = min(ov1 - qs0, len(qd))
+            tlo = min(max(0, ov0 - ts0), len(td))
+            thi = min(ov1 - ts0, len(td))
+            if qhi <= qlo or thi <= tlo:
+                continue
+            paf.append(f"{qn}\t{len(qd)}\t{qlo}\t{qhi}\t+\t"
+                       f"{tn}\t{len(td)}\t{tlo}\t{thi}\t"
+                       f"{qhi - qlo}\t{qhi - qlo}\t60")
+    reads_path = os.path.join(dirname, "frags.fasta.gz")
+    ovl_path = os.path.join(dirname, "frags_ava.paf.gz")
+    with gzip.open(reads_path, "wb") as f:
+        for name, data, _s, _e in reads:
+            f.write(b">" + name.encode() + b"\n" + data + b"\n")
+    with gzip.open(ovl_path, "wb") as f:
+        f.write(("\n".join(paf) + "\n").encode())
+    return reads_path, ovl_path, reads_path
+
+
 class PolishServer:
     def __init__(self, config: ServeConfig | None = None, **overrides):
         self.config = config if config is not None \
@@ -605,6 +695,24 @@ class PolishServer:
         self._scrape_count = 0
         self._scrape_render_s = 0.0
         self._scrape_lock = threading.Lock()
+        #: admit-time ingest workdir (serve/ingest.py): lazily created
+        #: server-lifetime scratch directory holding subsampled /
+        #: pair-normalized inputs; removed on close()
+        self._ingest_dir: str | None = None
+        self._ingest_lock = threading.Lock()
+
+    def _ingest_workdir(self) -> str:
+        """Lazily created server-lifetime scratch directory for the
+        ingest plane's rewritten inputs (subsample-on-admit, pair
+        normalization). One directory per server so close() can remove
+        every rewritten file in one sweep."""
+        with self._ingest_lock:
+            if self._ingest_dir is None:
+                import tempfile
+
+                self._ingest_dir = tempfile.mkdtemp(
+                    prefix="racon-tpu-ingest-")
+            return self._ingest_dir
 
     # ---------------------------------------------------------- lifecycle
     def start(self) -> "PolishServer":
@@ -937,6 +1045,12 @@ class PolishServer:
         if self.config.port is None:
             with contextlib.suppress(OSError):
                 os.unlink(self.config.socket_path)
+        if self._ingest_dir is not None:
+            import shutil
+
+            with contextlib.suppress(OSError):
+                shutil.rmtree(self._ingest_dir)
+            self._ingest_dir = None
         if self.journal is not None:
             self.journal.record(
                 "serve-stop", clean=clean,
@@ -1159,6 +1273,78 @@ class PolishServer:
                 return error_response(
                     "bad-request",
                     "rounds cannot be combined with range_lo/range_hi")
+        # fragment traffic class (reference `-f`, PolisherType.kF): an
+        # explicit `mode` field rather than a bare option so the
+        # router, journal, and streaming shape can tell the traffic
+        # classes apart. Absent mode keeps every surface byte-identical
+        # — including legacy `options.fragment_correction` jobs, which
+        # keep their per-contig streaming shape.
+        mode = req.get("mode")
+        if mode is not None and mode not in ("contig", "fragment"):
+            return error_response(
+                "bad-request", 'mode must be "contig" or "fragment"')
+        fragment = mode == "fragment"
+        if fragment:
+            if range_lo is not None or range_hi is not None:
+                # the window-range planner slices ONE target's
+                # coordinate axis; fragment jobs shard across the
+                # target INDEX axis instead (frag_lo/frag_hi)
+                return error_response(
+                    "bad-request",
+                    'mode "fragment" cannot be combined with '
+                    "range_lo/range_hi")
+            if rounds is not None and rounds > 1:
+                # rounds re-polish a DRAFT assembly; corrected reads
+                # are terminal outputs with nothing to re-map onto
+                return error_response(
+                    "bad-request",
+                    'rounds > 1 cannot be combined with mode '
+                    '"fragment"')
+            # mode implies the kF polisher; normalize here so _run_job
+            # and the audit config keep a single source of truth
+            options = dict(options)
+            options["fragment_correction"] = True
+        # fragment child-job shard slice (router fan-out, protocol.py
+        # "Fragment child jobs"): [frag_lo, frag_hi) target-INDEX
+        # bounds, mirroring the range_lo/range_hi discipline
+        frag_lo = req.get("frag_lo")
+        frag_hi = req.get("frag_hi")
+        if frag_lo is not None or frag_hi is not None:
+            if (isinstance(frag_lo, bool) or isinstance(frag_hi, bool)
+                    or not isinstance(frag_lo, int)
+                    or not isinstance(frag_hi, int)
+                    or frag_lo < 0 or frag_hi <= frag_lo):
+                return error_response(
+                    "bad-request",
+                    "frag_lo/frag_hi must be integers with "
+                    "0 <= frag_lo < frag_hi")
+            if not fragment:
+                return error_response(
+                    "bad-request",
+                    'frag_lo/frag_hi require mode "fragment"')
+            if rounds is not None:
+                return error_response(
+                    "bad-request",
+                    "rounds cannot be combined with frag_lo/frag_hi")
+        # streaming ingest plane (serve/ingest.py): opt-in via any of
+        # `ingest: true` (validate-only), `subsample: {...}`, or
+        # `normalize: true`. Shapes are validated HERE so a typo'd
+        # request fails typed before a job id is minted; the actual
+        # (possibly slow) streaming parse runs after `received` below.
+        ingest_spec = None
+        if (req.get("ingest") is not None or req.get("subsample")
+                is not None or req.get("normalize") is not None):
+            from . import ingest as ingest_mod
+
+            try:
+                ingest_spec = ingest_mod.IngestSpec.from_request(req)
+            except ingest_mod.IngestError as exc:
+                return error_response("bad-request", str(exc))
+            if not (req.get("ingest") or ingest_spec.subsample
+                    or ingest_spec.normalize):
+                # `ingest: false` with no other opt-in: shapes were
+                # still validated above, but nothing to run
+                ingest_spec = None
         with self._job_seq_lock:
             self._job_seq += 1
             job_id = f"j{self._job_seq}"
@@ -1171,7 +1357,8 @@ class PolishServer:
                   want_progress=bool(req.get("progress")),
                   want_stream=bool(req.get("stream")),
                   tenant=tenant or "", rounds=rounds,
-                  range_lo=range_lo, range_hi=range_hi)
+                  range_lo=range_lo, range_hi=range_hi,
+                  fragment=fragment, frag_lo=frag_lo, frag_hi=frag_hi)
         # child-job fields from a serve router (router.py): `parent` is
         # the router-side parent job id, `shard`/`shards` this child's
         # slot in the contig fan-out. Purely observational replica-side
@@ -1195,7 +1382,34 @@ class PolishServer:
                                 parent=parent, shard=shard,
                                 shards=shards,
                                 range_lo=job.range_lo,
-                                range_hi=job.range_hi)
+                                range_hi=job.range_hi,
+                                mode="fragment" if job.fragment
+                                else None,
+                                frag_lo=job.frag_lo,
+                                frag_hi=job.frag_hi)
+        if ingest_spec is not None:
+            # admit-time ingest: streaming-validate (and optionally
+            # subsample / pair-normalize) the raw inputs. A parse error
+            # fails THIS job typed — `rejected-ingest` terminal, no
+            # queue time, never the server — and rewritten paths land
+            # on the job before it is queued.
+            from . import ingest as ingest_mod
+
+            try:
+                done = ingest_mod.prepare(
+                    job.sequences, job.overlaps, job.target,
+                    ingest_spec, workdir=self._ingest_workdir(),
+                    job_id=job.id, trace_id=trace_id,
+                    journal=self.journal)
+            except ingest_mod.IngestError as exc:
+                if self.journal is not None:
+                    self.journal.record("rejected-ingest", job=job.id,
+                                        trace=trace_id,
+                                        error=exc.stage,
+                                        detail=str(exc))
+                return error_response("bad-request", str(exc),
+                                      job_id=job_id)
+            job.sequences, job.overlaps, job.target = done
         try:
             self.queue.submit(job)
         except QueueFull as exc:
@@ -1673,6 +1887,12 @@ class PolishServer:
                 # polisher emits bare-named segments and records the
                 # stitch accounting in segment_meta (core/polisher.py)
                 polisher.window_range = (job.range_lo, job.range_hi)
+            if job.frag_lo is not None:
+                # fragment child shard: correct only the reads whose
+                # target-file index falls in [frag_lo, frag_hi) — the
+                # read-axis twin of window_range (core/polisher.py
+                # target_range)
+                polisher.target_range = (job.frag_lo, job.frag_hi)
             polisher.initialize()
             # per-contig sink: every serve job stitches incrementally
             # through the continuous batcher, so each finished contig is
@@ -1708,13 +1928,45 @@ class PolishServer:
                     frame["seg"] = polisher.segment_meta.get(seq.name)
                 job.notify_part(frame)
 
+            def on_group(seqs, lo, hi) -> None:
+                # fragment traffic class: targets are many small reads,
+                # so corrected reads ship one result_part frame per
+                # BOUNDED GROUP (cfg.frag_group consecutive targets,
+                # core/polisher.FragmentStreamer), never one frame per
+                # read. `lo`/`hi` are this polisher's local target
+                # indices; the frame's `frag` receipt is rebased to the
+                # GLOBAL read axis so a router's dedupe ledger can tile
+                # [0, n_reads) across child shards. Dropped
+                # (unpolished) reads still advance the receipt range,
+                # so a group may carry fewer reads than indices — or
+                # none at all.
+                body = b"".join(b">" + s.name.encode() + b"\n" + s.data
+                                + b"\n" for s in seqs)
+                parts.append(body)
+                if self.journal is not None:
+                    self.journal.record(
+                        "part-streamed", job=job.id, trace=job.trace_id,
+                        part=len(parts), bytes=len(body),
+                        reads=len(seqs))
+                base = job.frag_lo or 0
+                job.notify_part({"type": "result_part",
+                                 "job_id": job.id, "part": len(parts),
+                                 "reads": len(seqs),
+                                 "frag": [base + lo, base + hi],
+                                 "fasta": body.decode("latin-1")})
+
             drop = not opts.get("include_unpolished", False)
             per_round: list[dict] = []
             if job.rounds is None:
                 # no rounds requested: the pre-rounds single-pass path,
                 # byte-identical in output, journal and scrape
-                polished = polisher.polish(
-                    drop, batcher=self.batcher, on_part=on_part)
+                if job.fragment:
+                    polished = polisher.polish(
+                        drop, batcher=self.batcher, on_group=on_group,
+                        group_size=cfg.frag_group)
+                else:
+                    polished = polisher.polish(
+                        drop, batcher=self.batcher, on_part=on_part)
             else:
                 # serve-native polishing rounds: round k's stitched
                 # contigs loop back as round k+1's draft WITHOUT
@@ -1744,9 +1996,20 @@ class PolishServer:
                                     trace=job.trace_id, round=rnd,
                                     of=rounds)
                             rt0 = time.perf_counter()
-                            polished = polisher.polish(
-                                drop, batcher=self.batcher,
-                                on_part=on_part if final else None)
+                            if job.fragment:
+                                # only rounds == 1 reaches here (the
+                                # submit validation rejects more), so
+                                # `final` is always true — but keep the
+                                # guard shape symmetric
+                                polished = polisher.polish(
+                                    drop, batcher=self.batcher,
+                                    on_group=on_group if final
+                                    else None,
+                                    group_size=cfg.frag_group)
+                            else:
+                                polished = polisher.polish(
+                                    drop, batcher=self.batcher,
+                                    on_part=on_part if final else None)
                             wall = time.perf_counter() - rt0
                             batch = getattr(polisher, "serve_batch",
                                             None) or {}
@@ -2295,6 +2558,13 @@ def serve_main(argv: list[str]) -> int:
                     help="window-cache capacity bound in bytes, "
                          "LRU-evicted (RACON_TPU_WINCACHE_MAX_BYTES, "
                          "default 64 MiB)")
+    ap.add_argument("--frag-group", type=int, default=None,
+                    help="reads per streamed result_part frame on "
+                         "fragment-correction jobs "
+                         "(RACON_TPU_FRAG_GROUP, default 64; keep "
+                         "homogeneous across a routed fleet — the "
+                         "router's requeue dedupe assumes replicas "
+                         "decompose a shard into the same read groups)")
     ap.add_argument("--preempt", action="store_true", default=None,
                     help="arm priority preemption: a newly admitted "
                          "higher-priority job parks the pooled windows "
@@ -2399,6 +2669,8 @@ def serve_main(argv: list[str]) -> int:
         kw["worker_lanes"] = args.worker_lanes
     if args.audit_rate is not None:
         kw["audit_rate"] = args.audit_rate
+    if args.frag_group is not None:
+        kw["frag_group"] = args.frag_group
     if args.wincache:
         kw["wincache"] = True
     if args.wincache_max_bytes is not None:

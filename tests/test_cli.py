@@ -104,3 +104,56 @@ def test_cli_end_to_end_sam(capsys, monkeypatch):
     assert out.count(b">") == 1
     seq = out.split(b"\n", 2)[1]
     assert 45000 < len(seq) < 50000
+
+
+# ---------------------------------------------- one-shot -f parity oracle
+def _cli_bytes(args, monkeypatch):
+    buf = io.BytesIO()
+
+    class _Out:
+        buffer = buf
+
+        @staticmethod
+        def write(s):
+            pass
+
+        @staticmethod
+        def flush():
+            pass
+
+    monkeypatch.setattr(sys, "stdout", _Out)
+    rc = main(args)
+    assert rc == 0
+    return buf.getvalue()
+
+
+@pytest.fixture(scope="module")
+def frag_dataset(tmp_path_factory):
+    from racon_tpu.serve.server import make_fragment_dataset
+    return make_fragment_dataset(str(tmp_path_factory.mktemp("cli_frag")))
+
+
+def test_cli_fragment_correction_parity(frag_dataset, monkeypatch):
+    """One-shot `-f` parity (ISSUE 20 satellite): the CLI's fragment
+    correction run on the reads-correcting-reads fixture is invariant
+    over pipeline depth 0/2 and the session/fused engines, and equals
+    the library-level kF oracle — the pinned identity target for the
+    serve fragment traffic class (tests/test_serve_fragment.py)."""
+    from racon_tpu.core.polisher import PolisherType, create_polisher
+
+    p = create_polisher(*frag_dataset, PolisherType.kF, 500, 10.0, 0.3,
+                        num_threads=2)
+    p.initialize()
+    golden = b"".join(b">" + s.name.encode() + b"\n" + s.data + b"\n"
+                      for s in p.polish(True))
+    # corrected reads come back "r"-tagged with per-read accounting
+    assert golden.startswith(b">f0r LN:i:")
+    assert golden.count(b">") == 17
+
+    for depth in ("0", "2"):
+        for engine in ("session", "fused"):
+            got = _cli_bytes(["-f", "-t", "2",
+                              "--tpu-engine", engine,
+                              "--tpu-pipeline-depth", depth,
+                              *frag_dataset], monkeypatch)
+            assert got == golden, (engine, depth)

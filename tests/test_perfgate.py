@@ -589,3 +589,55 @@ def test_ramp_p99_flat_max_mandatory_when_requested(tmp_path, capsys):
     with pytest.raises(perfgate.GateError,
                        match="autoscale.gold_p99_flat"):
         perfgate.extract({"mode": "ramp", "autoscale": {}})
+
+
+def fragment_artifact(identical=True, jobs_per_s=4.0, vs_contig=3.2):
+    return {"mode": "fragment", "jobs": 8,
+            "fragment": {"identical": identical, "reads": 17,
+                         "jobs_per_s": jobs_per_s, "p50_s": 0.4,
+                         "p99_s": 0.9, "parts_per_job": 3.0,
+                         "vs_contig_x": vs_contig}}
+
+
+def test_fragment_gates(tmp_path, capsys):
+    """ISSUE-20 satellite: servebench --fragment artifacts gate
+    fragment.identical (serve bytes == solo kF bytes) and
+    fragment.vs_contig_x > 1 whenever the block is present;
+    --fragment-jobs-min adds the absolute throughput floor."""
+    ok = write(tmp_path / "ok.json", fragment_artifact())
+    assert perfgate.main(["--artifact", ok]) == 0
+    err = capsys.readouterr().err
+    assert "fragment.identical" in err
+    assert "fragment.vs_contig_x" in err
+    # divergence from the solo bytes fails — serving is a transport,
+    # never an answer change
+    div = write(tmp_path / "div.json",
+                fragment_artifact(identical=False))
+    assert perfgate.main(["--artifact", div]) == 1
+    assert "fragment.identical" in capsys.readouterr().err
+    # a fragment rate at or below the contig wave fails
+    slow = write(tmp_path / "slow.json",
+                 fragment_artifact(vs_contig=0.8))
+    assert perfgate.main(["--artifact", slow]) == 1
+    assert "fragment.vs_contig_x" in capsys.readouterr().err
+    # explicit floor honored both ways
+    assert perfgate.main(["--artifact", ok,
+                          "--fragment-jobs-min", "2.0"]) == 0
+    assert perfgate.main(["--artifact", ok,
+                          "--fragment-jobs-min", "99.0"]) == 1
+
+
+def test_fragment_jobs_min_mandatory_when_requested(tmp_path, capsys):
+    """--fragment-jobs-min over an artifact without a fragment block
+    is a named-key broken gate, rc 2 (the slo.miss_rate convention) —
+    and a fragment artifact has no implicit baseline without
+    --against."""
+    plain = write(tmp_path / "plain.json", serve_artifact(p50=1.0))
+    assert perfgate.main(["--artifact", plain, "--ref-value", "1.0",
+                          "--tolerance-pct", "50",
+                          "--fragment-jobs-min", "1.0"]) == 2
+    assert "fragment.jobs_per_s" in capsys.readouterr().err
+    # a fragment artifact missing the throughput key cannot extract
+    with pytest.raises(perfgate.GateError,
+                       match="fragment.jobs_per_s"):
+        perfgate.extract({"mode": "fragment", "fragment": {}})

@@ -1,0 +1,264 @@
+"""Router fragment read-range sharding tests (serve/router.py third
+planner) — the ISSUE 20 pinned contracts:
+
+  - byte-identity: a fragment job through the router over {1, 2, 4}
+    replicas produces the SAME corrected-reads FASTA as a solo kF run
+    — at 2 and 4 the job really read-range-sharded (`router.fragment`
+    / `frag_shards`), children carried contiguous ascending
+    [frag_lo, frag_hi) slices, and the merged `reads` accounting
+    matches the output record count;
+  - streamed surface: group frames relay through the router in global
+    read order, and their concatenation is the whole job;
+  - failover: a replica that drops its fragment shard's connection
+    gets the [frag_lo, frag_hi) slice re-dispatched to a survivor —
+    output byte-identical, `frag-plan` and `requeued` in the journal;
+  - mid-stream kill: a replica that dies AFTER streaming some read
+    groups triggers a requeue whose re-streamed duplicates are dropped
+    at read-GROUP granularity (the merge ledger), so the journal's
+    `part-routed` frag receipts still tile [0, n_reads) exactly once
+    and `obsreport --check` stays green.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import socket
+import sys
+import threading
+import time
+
+import pytest
+
+from racon_tpu.core.polisher import PolisherType, create_polisher
+from racon_tpu.obs.journal import read_journal
+from racon_tpu.serve import PolishClient, PolishRouter, PolishServer
+from racon_tpu.serve.protocol import ProtocolError, recv_frame, send_frame
+from racon_tpu.serve.server import make_fragment_dataset
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "tools"))
+
+N_READS = 17
+
+
+# --------------------------------------------------------------- fixtures
+@pytest.fixture(scope="module")
+def dataset(tmp_path_factory):
+    return make_fragment_dataset(
+        str(tmp_path_factory.mktemp("rfrag_data")))
+
+
+@pytest.fixture(scope="module")
+def solo_bytes(dataset):
+    p = create_polisher(*dataset, PolisherType.kF, 500, 10.0, 0.3,
+                        num_threads=2)
+    p.initialize()
+    return b"".join(b">" + s.name.encode() + b"\n" + s.data + b"\n"
+                    for s in p.polish(True))
+
+
+@pytest.fixture(scope="module")
+def replicas(tmp_path_factory):
+    d = tmp_path_factory.mktemp("rfrag_reps")
+    socks = [str(d / f"rep{i}.sock") for i in range(4)]
+    servers = [PolishServer(socket_path=s, workers=2,
+                            warmup=False).start() for s in socks]
+    yield socks
+    for srv in servers:
+        srv.drain(timeout=10)
+
+
+def _wait_routable(cli: PolishClient, want: int, deadline_s: float = 30.0):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < deadline_s:
+        with contextlib.suppress(Exception):
+            hz = cli.request({"type": "healthz"})
+            if hz.get("routable") == want:
+                return hz
+        time.sleep(0.1)
+    raise AssertionError(f"router never reached routable == {want}")
+
+
+# ------------------------------------------------------------- byte pins
+def test_fragment_byte_identity_1_2_4_replicas(dataset, solo_bytes,
+                                               replicas, tmp_path):
+    for n in (1, 2, 4):
+        router = PolishRouter(replicas=",".join(replicas[:n]),
+                              socket_path=str(tmp_path / f"rf{n}.sock"),
+                              health_interval_s=0.2).start()
+        try:
+            cli = PolishClient(socket_path=router.config.socket_path)
+            _wait_routable(cli, n)
+            r = cli.submit(*dataset, fragment=True)
+            assert r.fasta == solo_bytes
+            assert r.router["fragment"] is True
+            assert r.router["frag_shards"] == n
+            assert r.router["requeues"] == 0
+            assert r.router["reads"] == solo_bytes.count(b">")
+            # streamed surface: group frames relay in global read order
+            parts: list[dict] = []
+            res = cli.submit(*dataset, fragment=True,
+                             on_part=parts.append)
+            assert res.fasta == solo_bytes
+            assert b"".join(p["fasta"].encode("latin-1")
+                            for p in parts) == solo_bytes
+        finally:
+            router.drain()
+
+
+# ------------------------------------------------------------- failover
+class _StubReplica:
+    """Protocol-complete fake replica: healthy to every probe, submit
+    behavior injectable (see tests/test_router_range.py)."""
+
+    def __init__(self, sock_path: str, on_submit):
+        self.path = sock_path
+        self.on_submit = on_submit
+        self.submits = 0
+        self._stop = threading.Event()
+        self._lst = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._lst.bind(sock_path)
+        self._lst.listen(8)
+        self._lst.settimeout(0.2)
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def _loop(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._lst.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            threading.Thread(target=self._handle, args=(conn,),
+                             daemon=True).start()
+
+    def _handle(self, conn):
+        try:
+            while True:
+                req = recv_frame(conn)
+                if req is None:
+                    return
+                rtype = req.get("type")
+                if rtype == "healthz":
+                    send_frame(conn, {"type": "healthz", "ok": True,
+                                      "draining": False})
+                elif rtype == "scrape":
+                    send_frame(conn, {"type": "metrics", "text": ""})
+                elif rtype == "submit":
+                    self.submits += 1
+                    self.on_submit(conn, req)
+                    return
+                else:
+                    send_frame(conn, {"type": "ok"})
+        except (OSError, ProtocolError):
+            return
+        finally:
+            with contextlib.suppress(OSError):
+                conn.close()
+
+    def close(self):
+        self._stop.set()
+        with contextlib.suppress(OSError):
+            self._lst.close()
+
+
+def test_fragment_shard_requeues_to_survivor(dataset, solo_bytes,
+                                             tmp_path):
+    """A replica dropping the connection the moment its fragment shard
+    lands: the [frag_lo, frag_hi) slice re-dispatches to the survivor
+    and the merged output stays byte-identical."""
+    def drop(conn, _req):
+        with contextlib.suppress(OSError):
+            conn.shutdown(socket.SHUT_RDWR)
+
+    stub = _StubReplica(str(tmp_path / "stub.sock"), drop)
+    real = PolishServer(socket_path=str(tmp_path / "real.sock"),
+                        workers=2, warmup=False).start()
+    journal = str(tmp_path / "router.jsonl")
+    router = PolishRouter(
+        replicas=f"{stub.path},{real.config.socket_path}",
+        socket_path=str(tmp_path / "r.sock"), journal=journal,
+        health_interval_s=0.2).start()
+    try:
+        cli = PolishClient(socket_path=router.config.socket_path)
+        _wait_routable(cli, 2)
+        r = cli.submit(*dataset, fragment=True)
+        assert r.fasta == solo_bytes
+        assert r.router["fragment"] is True
+        assert r.router["requeues"] >= 1
+        assert stub.submits >= 1  # the dying replica really got a slice
+    finally:
+        router.drain()
+        stub.close()
+        real.drain(timeout=10)
+    events = [e["event"] for e in read_journal(journal)]
+    assert "frag-plan" in events
+    assert "requeued" in events
+
+
+def test_fragment_midstream_kill_group_granularity_dedupe(
+        dataset, solo_bytes, tmp_path):
+    """The read-GROUP granularity requeue acceptance: a replica streams
+    the FIRST read group of its shard, then dies. The survivor re-runs
+    the whole [frag_lo, frag_hi) slice with the SAME group size (a
+    homogeneous fleet, the decomposition contract in protocol.py), so
+    the merge ledger drops the re-streamed duplicate of the accepted
+    group — output byte-identical, and the journal's `part-routed`
+    frag receipts tile [0, n_reads) exactly once, green under
+    `obsreport --check`."""
+    import obsreport
+
+    # shard 0 of 2 over 17 reads is [0, 8); with frag_group=4 the real
+    # replica decomposes it into groups [0,4) and [4,8). The stub
+    # pre-streams the exact [0,4) frame the survivor would produce.
+    records = solo_bytes.split(b"\n>")
+    records = [records[0]] + [b">" + r for r in records[1:]]
+    records = [r if r.endswith(b"\n") else r + b"\n" for r in records]
+    assert len(records) == N_READS
+    first_group = b"".join(records[:4])
+
+    def stream_then_die(conn, req):
+        assert req.get("frag_lo") == 0 and req.get("frag_hi") == 8
+        with contextlib.suppress(OSError):
+            send_frame(conn, {"type": "result_part",
+                              "job_id": "stub-child", "part": 1,
+                              "reads": 4, "frag": [0, 4],
+                              "fasta": first_group.decode("latin-1")})
+            conn.shutdown(socket.SHUT_RDWR)
+
+    stub = _StubReplica(str(tmp_path / "stub.sock"), stream_then_die)
+    real = PolishServer(socket_path=str(tmp_path / "real.sock"),
+                        workers=2, warmup=False, frag_group=4).start()
+    journal = str(tmp_path / "router.jsonl")
+    router = PolishRouter(
+        replicas=f"{stub.path},{real.config.socket_path}",
+        socket_path=str(tmp_path / "r.sock"), journal=journal,
+        health_interval_s=0.2).start()
+    try:
+        cli = PolishClient(socket_path=router.config.socket_path)
+        _wait_routable(cli, 2)
+        r = cli.submit(*dataset, fragment=True)
+        assert r.fasta == solo_bytes
+        assert r.router["requeues"] >= 1
+        assert r.router["reads"] == solo_bytes.count(b">")
+    finally:
+        router.drain()
+        stub.close()
+        real.drain(timeout=10)
+    entries = read_journal(journal)
+    routed = [e for e in entries if e.get("event") == "part-routed"]
+    receipts = sorted((e["frag_lo"], e["frag_hi"]) for e in routed)
+    # exactly-once tiling of the read axis, no duplicate for the
+    # pre-streamed group
+    expect = 0
+    for lo, hi in receipts:
+        assert lo == expect and hi > lo
+        expect = hi
+    assert expect == N_READS
+    rc = obsreport.main(["--journal", journal,
+                         "--flight-dir", str(tmp_path / "none"),
+                         "--check"])
+    assert rc == 0

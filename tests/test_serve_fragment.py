@@ -1,0 +1,403 @@
+"""Serve-native fragment correction + admit-time ingest tests.
+
+The acceptance spine of the fragment traffic class (ISSUE 20):
+
+  - a `mode: "fragment"` serve job is byte-identical to the one-shot
+    CLI `-f` run — pinned over BOTH transports (unix socket and
+    localhost TCP) on the wincache-off path;
+  - corrected reads stream as bounded GROUPS of `result_part` frames
+    (`frag` read-axis receipts tiling [0, n_reads)), never one frame
+    per read, and the parts' concatenation is the job's full FASTA;
+  - invalid combinations (`mode` typos, fragment + range_lo/hi,
+    fragment + rounds>1, frag_lo/hi without fragment) are typed
+    `bad-request` rejections, and the VALID neighbours of each are
+    accepted — pinned both directions;
+  - `frag_lo`/`frag_hi` child slices concatenate (in slice order) to
+    the whole-job bytes — the router's merge invariant, pinned here
+    without a router;
+  - admit-time ingest: validate-only catches a poisoned input at the
+    door (`bad-request` + `rejected-ingest` terminal, server
+    survives), subsample-on-admit is seed-deterministic, normalize
+    rewrites paired headers — all journaled as annotations that
+    `obsreport --check` accepts;
+  - flagless byte-identity: a submit with NO mode/ingest keys journals
+    exactly the same `received` field set as before this PR.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "tools"))
+
+from racon_tpu.core.polisher import PolisherType, create_polisher
+from racon_tpu.serve.client import PolishClient, ServeError
+from racon_tpu.serve.server import PolishServer, make_fragment_dataset
+
+N_READS = 17  # make_fragment_dataset: (2000 - 400) // 100 + 1
+
+
+# --------------------------------------------------------------- fixtures
+@pytest.fixture(scope="module")
+def dataset(tmp_path_factory):
+    return make_fragment_dataset(
+        str(tmp_path_factory.mktemp("frag_data")))
+
+
+def solo_fragment(paths) -> bytes:
+    """The one-shot `-f` oracle: same defaults the CLI resolves, same
+    defaults ServeConfig resolves — byte-identity is only meaningful
+    because both sides share them."""
+    p = create_polisher(*paths, PolisherType.kF, 500, 10.0, 0.3,
+                        num_threads=2)
+    p.initialize()
+    return b"".join(b">" + s.name.encode() + b"\n" + s.data + b"\n"
+                    for s in p.polish(True))
+
+
+@pytest.fixture(scope="module")
+def solo_bytes(dataset):
+    return solo_fragment(dataset)
+
+
+@pytest.fixture(scope="module")
+def server(dataset, tmp_path_factory):
+    sock = str(tmp_path_factory.mktemp("frag_sock") / "s.sock")
+    srv = PolishServer(socket_path=sock, workers=2, warmup=False,
+                       wincache=False).start()
+    yield srv
+    srv.drain(timeout=10)
+
+
+@pytest.fixture(scope="module")
+def client(server):
+    return PolishClient(socket_path=server.config.socket_path)
+
+
+# --------------------------------------------- identity over transports
+def test_fragment_byte_identical_to_oneshot_unix(client, dataset,
+                                                 solo_bytes):
+    r = client.submit(*dataset, fragment=True)
+    assert r.fasta == solo_bytes
+
+
+def test_fragment_byte_identical_to_oneshot_tcp(dataset, solo_bytes):
+    srv = PolishServer(port=0, warmup=False, wincache=False).start()
+    try:
+        cl = PolishClient(port=srv.config.port)
+        assert cl.submit(*dataset, fragment=True).fasta == solo_bytes
+    finally:
+        srv.drain(timeout=10)
+
+
+def test_fragment_warm_reuse_second_job_identical(client, dataset,
+                                                  solo_bytes):
+    """Warm-server reuse: the SECOND fragment job on the same process
+    (engines, batcher, caches all warm) must still be byte-identical."""
+    assert client.submit(*dataset, fragment=True).fasta == solo_bytes
+
+
+# ------------------------------------------------------ bounded groups
+def test_fragment_streams_bounded_groups(dataset, solo_bytes,
+                                         tmp_path_factory):
+    """With frag_group below the read count, corrected reads arrive in
+    bounded groups whose `frag` receipts tile [0, n_reads) — and the
+    parts' concatenation is the whole-job FASTA."""
+    sock = str(tmp_path_factory.mktemp("frag_grp") / "s.sock")
+    srv = PolishServer(socket_path=sock, warmup=False, wincache=False,
+                       frag_group=8).start()
+    try:
+        cl = PolishClient(socket_path=sock)
+        parts: list[dict] = []
+        r = cl.submit(*dataset, fragment=True, on_part=parts.append)
+    finally:
+        srv.drain(timeout=10)
+    assert r.fasta == solo_bytes
+    assert b"".join(p["fasta"].encode("latin-1")
+                    for p in parts) == solo_bytes
+    # bounded: more than one frame, none larger than the group knob
+    assert len(parts) > 1
+    assert all(p["reads"] <= 8 for p in parts)
+    # receipts tile the read axis from 0
+    expect = 0
+    for p in parts:
+        lo, hi = p["frag"]
+        assert lo == expect and hi > lo
+        expect = hi
+    assert expect == N_READS
+    assert sum(p["reads"] for p in parts) == solo_bytes.count(b">")
+
+
+def test_frag_group_env_knob_strict(monkeypatch):
+    from racon_tpu.errors import RaconError
+    from racon_tpu.serve.server import ServeConfig
+
+    monkeypatch.setenv("RACON_TPU_FRAG_GROUP", "12")
+    assert ServeConfig().frag_group == 12
+    monkeypatch.setenv("RACON_TPU_FRAG_GROUP", "soon")
+    with pytest.raises(RaconError):
+        ServeConfig()
+    monkeypatch.delenv("RACON_TPU_FRAG_GROUP")
+    assert ServeConfig().frag_group == 64
+    with pytest.raises(RaconError):
+        ServeConfig(frag_group=0)
+
+
+# ------------------------------------------------- frag_lo/frag_hi slices
+def test_frag_slices_concatenate_to_whole(client, dataset, solo_bytes):
+    """The router's fragment-merge invariant, pinned without a router:
+    contiguous ascending [frag_lo, frag_hi) child jobs concatenate (in
+    slice order) to the whole-job bytes."""
+    cuts = (0, 5, 11, N_READS)
+    got = b"".join(
+        client.submit(*dataset, fragment=True,
+                      frag_lo=lo, frag_hi=hi).fasta
+        for lo, hi in zip(cuts, cuts[1:]))
+    assert got == solo_bytes
+
+
+# ------------------------------------------------- validation, both ways
+def test_invalid_mode_rejected_valid_modes_accepted(client, dataset,
+                                                    solo_bytes):
+    seqs, ovl, tgt = (os.path.abspath(p) for p in dataset)
+    base = {"type": "submit", "sequences": seqs, "overlaps": ovl,
+            "target": tgt}
+    with pytest.raises(ServeError) as exc_info:
+        client.request(dict(base, mode="fragmnt"))
+    assert exc_info.value.code == "bad-request"
+    assert "mode" in str(exc_info.value)
+    # both spellings of the valid surface are accepted
+    ok = client.request(dict(base, mode="fragment"))
+    assert ok.get("fasta", "").encode("latin-1") == solo_bytes
+    assert client.request(dict(base, mode="contig")).get("type") == "result"
+
+
+def test_fragment_plus_range_rejected(client, dataset):
+    with pytest.raises(ServeError) as exc_info:
+        client.request({"type": "submit",
+                        "sequences": os.path.abspath(dataset[0]),
+                        "overlaps": os.path.abspath(dataset[1]),
+                        "target": os.path.abspath(dataset[2]),
+                        "mode": "fragment", "range_lo": 0,
+                        "range_hi": 4})
+    assert exc_info.value.code == "bad-request"
+    assert "range" in str(exc_info.value)
+
+
+def test_fragment_rounds_gt1_rejected_rounds1_accepted(client, dataset,
+                                                       solo_bytes):
+    with pytest.raises(ServeError) as exc_info:
+        client.submit(*dataset, fragment=True, rounds=2)
+    assert exc_info.value.code == "bad-request"
+    assert "rounds" in str(exc_info.value)
+    # rounds == 1 is the single-pass surface and stays accepted
+    assert client.submit(*dataset, fragment=True,
+                         rounds=1).fasta == solo_bytes
+
+
+def test_frag_bounds_validation_matrix(client, dataset):
+    # malformed bounds via the client helper (ints, wrong ordering)
+    for lo, hi in ((3, 3), (-1, 4)):
+        with pytest.raises(ServeError) as exc_info:
+            client.submit(*dataset, fragment=True, frag_lo=lo,
+                          frag_hi=hi)
+        assert exc_info.value.code == "bad-request"
+    # malformed TYPES must be rejected server-side, so raw frames (the
+    # client helper would coerce them before the wire)
+    base = {"type": "submit",
+            "sequences": os.path.abspath(dataset[0]),
+            "overlaps": os.path.abspath(dataset[1]),
+            "target": os.path.abspath(dataset[2]), "mode": "fragment"}
+    for lo, hi in ((True, 4), (0, "many"), (0.5, 4)):
+        with pytest.raises(ServeError) as exc_info:
+            client.request(dict(base, frag_lo=lo, frag_hi=hi))
+        assert exc_info.value.code == "bad-request"
+    # frag bounds without fragment mode
+    with pytest.raises(ServeError) as exc_info:
+        client.submit(*dataset, frag_lo=0, frag_hi=4)
+    assert exc_info.value.code == "bad-request"
+    assert "fragment" in str(exc_info.value)
+
+
+# ------------------------------------------------------- admit-time ingest
+def test_ingest_validate_only_accepts_clean_inputs(client, dataset,
+                                                   solo_bytes):
+    r = client.submit(*dataset, fragment=True, ingest=True)
+    assert r.fasta == solo_bytes
+
+
+def test_ingest_rejects_poisoned_input_server_survives(
+        dataset, solo_bytes, tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("frag_ingest")
+    bad = str(tmp / "bad.fasta")
+    with open(bad, "w") as fh:
+        fh.write("this is not fasta\n")
+    journal = str(tmp / "journal.jsonl")
+    srv = PolishServer(socket_path=str(tmp / "s.sock"), warmup=False,
+                       wincache=False, journal=journal).start()
+    try:
+        cl = PolishClient(socket_path=srv.config.socket_path)
+        with pytest.raises(ServeError) as exc_info:
+            cl.submit(bad, dataset[1], dataset[2], fragment=True,
+                      ingest=True)
+        assert exc_info.value.code == "bad-request"
+        # the warm server then completes a clean job byte-identically
+        assert cl.submit(*dataset, fragment=True).fasta == solo_bytes
+    finally:
+        srv.drain(timeout=10)
+    from racon_tpu.obs.journal import read_journal
+    events = [e["event"] for e in read_journal(journal)]
+    assert "rejected-ingest" in events
+    # the rejected job terminated at the door: no started/failed pair
+    import obsreport
+    assert obsreport.main(["--journal", journal,
+                           "--flight-dir", str(tmp / "none"),
+                           "--check"]) == 0
+
+
+def test_ingest_bad_spec_rejected_before_job(client, dataset):
+    for sub in ({"reference_length": 0, "coverage": 2},
+                {"reference_length": 2000, "coverage": 2, "pct": 50},
+                {"reference_length": 2000, "coverage": 2,
+                 "seed": "lucky"}):
+        with pytest.raises(ServeError) as exc_info:
+            client.submit(*dataset, subsample=sub)
+        assert exc_info.value.code == "bad-request"
+    # a non-object subsample must be rejected server-side (raw frame:
+    # the client helper would throw before the wire)
+    with pytest.raises(ServeError) as exc_info:
+        client.request({"type": "submit",
+                        "sequences": os.path.abspath(dataset[0]),
+                        "overlaps": os.path.abspath(dataset[1]),
+                        "target": os.path.abspath(dataset[2]),
+                        "subsample": "half"})
+    assert exc_info.value.code == "bad-request"
+
+
+def test_subsample_on_admit_deterministic(client, dataset):
+    """Seeded subsample-on-admit: identical seeds give identical output
+    bytes; a different seed picks a different read subset."""
+    kw = dict(subsample={"reference_length": 2000, "coverage": 2,
+                         "seed": 7})
+    a = client.submit(*dataset, **kw)
+    b = client.submit(*dataset, **kw)
+    assert a.fasta == b.fasta
+    c = client.submit(*dataset,
+                      subsample={"reference_length": 2000,
+                                 "coverage": 2, "seed": 8})
+    assert c.fasta != a.fasta
+
+
+def test_normalize_on_admit(tmp_path_factory):
+    """Paired-end header normalization on admit: the client ships raw
+    reads whose headers only become unique after the `preprocess`
+    rename (first occurrence -> "1"), with overlaps written against
+    the POST-normalization names — the server normalizes before the
+    polisher parses, and the journal carries the annotation trail."""
+    import gzip
+
+    from racon_tpu.serve.server import make_synth_dataset
+
+    tmp = tmp_path_factory.mktemp("frag_norm")
+    reads, ovl, draft = make_synth_dataset(str(tmp))
+    # raw paired-end-shaped reads: same names as the synth set, but
+    # the PAF is rewritten to the names normalization WILL produce
+    # ("r0" -> "r01"), so the job only polishes if the server actually
+    # ran the preprocess rename on admit
+    ovl_norm = str(tmp / "ovl_norm.paf.gz")
+    with gzip.open(ovl, "rt") as fh, \
+            gzip.open(ovl_norm, "wt") as out:
+        for line in fh:
+            cols = line.split("\t")
+            cols[0] += "1"
+            out.write("\t".join(cols))
+    journal = str(tmp / "journal.jsonl")
+    srv = PolishServer(socket_path=str(tmp / "s.sock"), warmup=False,
+                       wincache=False, journal=journal).start()
+    try:
+        cl = PolishClient(socket_path=srv.config.socket_path)
+        with pytest.raises(ServeError):
+            # without normalize the PAF names match nothing: typed fail
+            cl.submit(reads, ovl_norm, draft)
+        r = cl.submit(reads, ovl_norm, draft, normalize=True)
+        assert r.fasta.startswith(b">draft")
+    finally:
+        srv.drain(timeout=10)
+    from racon_tpu.obs.journal import read_journal
+    events = [e["event"] for e in read_journal(journal)]
+    assert "ingested" in events and "normalized" in events
+
+
+# -------------------------------------------- journal + flagless identity
+def test_fragment_journal_and_obsreport_check(dataset, solo_bytes,
+                                              tmp_path_factory):
+    """Fragment jobs journal group-granularity part-streamed lines
+    (`reads=N`), finished `sequences` equals the read total, and
+    `obsreport --check` accepts the aggregate receipt — then goes red
+    when a group line is dropped."""
+    import obsreport
+    from racon_tpu.obs.journal import read_journal
+
+    tmp = tmp_path_factory.mktemp("frag_journal")
+    journal = str(tmp / "journal.jsonl")
+    srv = PolishServer(socket_path=str(tmp / "s.sock"), warmup=False,
+                       wincache=False, frag_group=8,
+                       journal=journal).start()
+    try:
+        cl = PolishClient(socket_path=srv.config.socket_path)
+        r = cl.submit(*dataset, fragment=True)
+    finally:
+        srv.drain(timeout=10)
+    assert r.fasta == solo_bytes
+    entries = read_journal(journal)
+    received = [e for e in entries if e.get("event") == "received"
+                and e.get("job") == r.job_id]
+    assert received and received[0].get("mode") == "fragment"
+    groups = [e for e in entries if e.get("event") == "part-streamed"
+              and e.get("job") == r.job_id]
+    assert len(groups) == 3  # 17 reads / frag_group=8
+    assert sum(e["reads"] for e in groups) == solo_bytes.count(b">")
+    flight = str(tmp / "none")
+    assert obsreport.main(["--journal", journal, "--flight-dir",
+                           flight, "--check"]) == 0
+    # drop one group line: the aggregate receipt must go red
+    with open(journal) as fh:
+        lines = fh.readlines()
+    kept = [ln for ln in lines if '"part-streamed"' not in ln
+            or f'"{r.job_id}"' not in ln
+            or '"part":2' in ln or '"part":3' in ln]
+    assert len(kept) == len(lines) - 1
+    with open(journal, "w") as fh:
+        fh.writelines(kept)
+    assert obsreport.main(["--journal", journal, "--flight-dir",
+                           flight, "--check"]) == 1
+
+
+def test_flagless_submit_journal_fields_unchanged(dataset,
+                                                  tmp_path_factory):
+    """No mode / ingest keys on the frame ⇒ the journal `received`
+    line carries exactly the pre-PR field set — the flagless
+    byte-identity acceptance, checked at field granularity."""
+    from racon_tpu.obs.journal import read_journal
+
+    tmp = tmp_path_factory.mktemp("frag_flagless")
+    journal = str(tmp / "journal.jsonl")
+    srv = PolishServer(socket_path=str(tmp / "s.sock"), warmup=False,
+                       journal=journal).start()
+    try:
+        cl = PolishClient(socket_path=srv.config.socket_path)
+        r = cl.submit(*dataset)
+    finally:
+        srv.drain(timeout=10)
+    entries = read_journal(journal)
+    received = [e for e in entries if e.get("event") == "received"
+                and e.get("job") == r.job_id]
+    assert received
+    for key in ("mode", "frag_lo", "frag_hi", "ingest", "subsample",
+                "normalize"):
+        assert key not in received[0]

@@ -59,6 +59,42 @@ def test_rampler_subsample(tmp_path):
     assert len({s.name for s in seqs}) == len(seqs)
 
 
+def test_rampler_subsample_seed_deterministic(tmp_path, monkeypatch):
+    """Seeded subsample (ISSUE 20 satellite): the same explicit seed
+    always picks the same reads; different seeds pick differently; the
+    env knob is honoured when no explicit seed is given; a typo'd env
+    value is a hard error, not a silently random sample."""
+    from racon_tpu.errors import RaconError
+
+    src = tmp_path / "reads.fasta"
+    write_fasta(src, [(str(i).encode(), b"ACGT" * 100)
+                      for i in range(50)])
+
+    def pick(dirname, **kw):
+        os.makedirs(dirname, exist_ok=True)
+        out = rampler.subsample(str(src), 1000, 4, str(dirname), **kw)
+        return [s.name for s in _load(out)]
+
+    assert pick(tmp_path / "a", seed=7) == pick(tmp_path / "b", seed=7)
+    assert pick(tmp_path / "a2", seed=7) != pick(tmp_path / "c", seed=8)
+    # env knob drives the default; explicit seed still wins over it
+    monkeypatch.setenv("RACON_TPU_SUBSAMPLE_SEED", "7")
+    assert pick(tmp_path / "d") == pick(tmp_path / "a3", seed=7)
+    monkeypatch.setenv("RACON_TPU_SUBSAMPLE_SEED", "lucky")
+    with pytest.raises(RaconError):
+        pick(tmp_path / "e")
+    assert pick(tmp_path / "f", seed=7) == pick(tmp_path / "a4", seed=7)
+    # unseeded runs stay deterministic too (the fixed default)
+    monkeypatch.delenv("RACON_TPU_SUBSAMPLE_SEED")
+    assert pick(tmp_path / "g") == pick(tmp_path / "h")
+    # coverage math is seed-independent: every pick stops at the same
+    # >= ref_len * coverage budget
+    for sub in (tmp_path / "a", tmp_path / "c", tmp_path / "g"):
+        total = sum(len(s.data)
+                    for s in _load(str(sub / "reads_4x.fasta")))
+        assert 4000 <= total < 4000 + 400
+
+
 def test_preprocess_uniquifies_pairs(tmp_path):
     fq = tmp_path / "pairs.fastq"
     fq.write_bytes(b"@r1 x\nACGT\n+\nIIII\n@r1 y\nTTTT\n+\nIIII\n"

@@ -50,6 +50,18 @@ must complete on the survivor with the reassembled contig
 byte-identical to a solo run, the `range-plan`/`requeued` lines on the
 ledger, and obsreport's segment-receipt check tiling clean.
 
+A FRAGMENT section (two gated cells) exercises the serve-native
+fragment-correction mode (`mode: "fragment"`) and its admit-time ingest
+plane: a fragment submit pointing at a poisoned (non-FASTA) reads file
+with `ingest` validation armed must fail TYPED (`bad-request`,
+`rejected-ingest` on the ledger) while a CONCURRENT contig job on the
+same server completes byte-identically — and the warm server's next
+clean fragment job reproduces the solo kF bytes; then a fragment job
+read-range-sharded across two real replica subprocesses with one
+killed -9 mid-job must complete via the requeue byte-identically, the
+`frag-plan`/`requeued` lines on the ledger and obsreport's
+fragment-receipt check tiling the read axis clean.
+
 A TRACE section (one gated cell) exercises the distributed-trace plane
 under the same fault: a TRACED routed job (`submit_traced`) with one
 replica killed -9 mid-job must complete byte-identically AND leave a
@@ -711,6 +723,203 @@ def run_range_cells(tmp: str) -> list[tuple[str, str]]:
     return cells
 
 
+def run_fragment_cells(tmp: str) -> list[tuple[str, str]]:
+    """The fragment-correction section (serve mode: "fragment" + the
+    admit-time ingest plane). Two gated cells:
+
+      1. poisoned ingest: a fragment submit pointing at a non-FASTA
+         reads file with `ingest` validation armed must fail TYPED
+         (`bad-request`, `rejected-ingest` journaled, no started/failed
+         pair) while a CONCURRENT contig job on the same server
+         completes byte-identically — and the warm server then serves
+         a clean fragment job byte-identical to the solo kF run;
+      2. kill -9 mid-fragment-job: a fragment job read-range-sharded
+         across two REAL `racon_tpu serve` replica subprocesses, one
+         killed -9 mid-job. The requeue must re-run the dead replica's
+         [frag_lo, frag_hi) slice on the survivor, the merged
+         corrected reads must be byte-identical to a solo kF run, the
+         ledger must carry `frag-plan` and `requeued`, stay
+         lifecycle-consistent, and pass obsreport's fragment-receipt
+         tiling check (each read group journaled exactly once,
+         covering the read axis with no gap or overlap)."""
+    import signal
+    import subprocess
+
+    from racon_tpu.core.polisher import PolisherType, create_polisher
+    from racon_tpu.obs.journal import check_consistency, read_journal
+    from racon_tpu.serve import (PolishClient, PolishRouter,
+                                 PolishServer, ServeError,
+                                 make_synth_dataset)
+    from racon_tpu.serve.server import make_fragment_dataset
+
+    names = ("fragment poisoned ingest, contig alongside",
+             "fragment kill -9 mid-job")
+    cells: list[tuple[str, str]] = []
+    frag_dir = os.path.join(tmp, "frag_data")
+    os.makedirs(frag_dir, exist_ok=True)
+    fpaths = make_fragment_dataset(frag_dir)
+    pf = create_polisher(*fpaths, PolisherType.kF, 500, 10.0, 0.3,
+                         num_threads=2)
+    pf.initialize()
+    clean_frag = b"".join(b">" + s.name.encode() + b"\n" + s.data
+                          + b"\n" for s in pf.polish(True))
+    contig_dir = os.path.join(tmp, "frag_contig_data")
+    os.makedirs(contig_dir, exist_ok=True)
+    cpaths = make_synth_dataset(contig_dir)
+    pc = create_polisher(*cpaths, PolisherType.kC, 500, 10.0, 0.3,
+                         num_threads=2)
+    pc.initialize()
+    clean_contig = b"".join(b">" + s.name.encode() + b"\n" + s.data
+                            + b"\n" for s in pc.polish())
+
+    # ---- cell 1: poisoned fragment ingest, contig riding alongside
+    journal1 = os.path.join(tmp, "frag_journal1.jsonl")
+    try:
+        bad = os.path.join(tmp, "frag_bad.fasta")
+        with open(bad, "w") as fh:
+            fh.write("this is not fasta\n")
+        srv = PolishServer(socket_path=os.path.join(tmp, "frag.sock"),
+                           workers=2, warmup=False,
+                           journal=journal1).start()
+        try:
+            res: dict = {}
+
+            def contig_job(out: dict):
+                mine = PolishClient(
+                    socket_path=srv.config.socket_path)
+                try:
+                    out["resp"] = mine.submit(*cpaths)
+                except Exception as exc:  # noqa: BLE001 — checked
+                    out["exc"] = exc
+
+            t = threading.Thread(target=contig_job, args=(res,))
+            t.start()
+            client = PolishClient(socket_path=srv.config.socket_path)
+            typed = None
+            try:
+                client.submit(bad, fpaths[1], fpaths[2],
+                              fragment=True, ingest=True)
+            except ServeError as exc:
+                typed = exc
+            # the warm server still serves fragment work afterwards
+            after = client.submit(*fpaths, fragment=True)
+            t.join(WALL_CAP)
+        finally:
+            srv.drain(timeout=30)
+        entries = read_journal(journal1)
+        events = [e["event"] for e in entries]
+        checks = [("typed-reject", typed is not None
+                   and typed.code == "bad-request"),
+                  ("rejected-ingest-journaled",
+                   "rejected-ingest" in events),
+                  ("contig-survived", res.get("resp") is not None
+                   and res["resp"].fasta == clean_contig),
+                  ("fragment-after-reject-identical",
+                   after.fasta == clean_frag),
+                  ("journal-consistent",
+                   check_consistency(entries) == [])]
+        failed = [n for n, ok in checks if not ok]
+        if "exc" in res:
+            failed.append(f"({type(res['exc']).__name__}: "
+                          f"{res['exc']})")
+        cells.append((names[0],
+                      "pass  typed bad-request, contig unharmed"
+                      if not failed else f"FAIL {' '.join(failed)}"))
+    except Exception as exc:  # noqa: BLE001 — a crashed cell is a red
+        # cell, not a crashed grid
+        cells.append((names[0],
+                      f"FAIL crashed ({type(exc).__name__}: {exc})"))
+
+    # ---- cell 2: kill -9 one of two replicas mid-fragment-job
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               RACON_TPU_DEVICE_RETRIES="1")
+    env["PYTHONPATH"] = os.pathsep.join(
+        [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+        + [q for q in env.get("PYTHONPATH", "").split(os.pathsep)
+           if q and "axon_site" not in q])
+    socks = [os.path.join(tmp, f"frag_rep{i}.sock") for i in range(2)]
+    procs = [subprocess.Popen(
+        [sys.executable, "-m", "racon_tpu.cli", "serve", "--socket", s,
+         "--workers", "2", "--no-warmup"],
+        env=env, stderr=subprocess.DEVNULL) for s in socks]
+    router = None
+    journal2 = os.path.join(tmp, "frag_journal2.jsonl")
+    try:
+        for s in socks:
+            probe = PolishClient(socket_path=s, timeout=30)
+            deadline = time.perf_counter() + 90
+            while time.perf_counter() < deadline:
+                try:
+                    probe.request({"type": "ping"})
+                    break
+                except Exception:  # noqa: BLE001 — still starting
+                    time.sleep(0.2)
+            else:
+                raise RuntimeError(f"replica {s} never came up")
+        router = PolishRouter(replicas=",".join(socks),
+                              socket_path=os.path.join(tmp,
+                                                       "frag_rt.sock"),
+                              journal=journal2,
+                              health_interval_s=0.5).start()
+        # the same pacing trick as the range section: a
+        # watchdog-absorbed hang keeps both fragment shards busy long
+        # enough for the kill to land genuinely mid-job
+        slow = {"fault_plan": "device:chunk=0:hang=8",
+                "options": {"tpu_device_timeout": 2.0}}
+        res2: dict = {}
+
+        def run_job(out: dict):
+            mine = PolishClient(socket_path=router.config.socket_path)
+            try:
+                out["resp"] = mine.submit(*fpaths, fragment=True,
+                                          stream=True, **slow)
+            except Exception as exc:  # noqa: BLE001 — checked below
+                out["exc"] = exc
+
+        t = threading.Thread(target=run_job, args=(res2,))
+        t.start()
+        time.sleep(1.0)  # both fragment shards dispatched and stalled
+        procs[0].send_signal(signal.SIGKILL)  # the real kill -9
+        t.join(WALL_CAP)
+        entries = read_journal(journal2)
+        events = [e["event"] for e in entries]
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        import obsreport
+        resp = res2.get("resp")
+        checks = [("completed", resp is not None),
+                  ("identical",
+                   resp is not None and resp.fasta == clean_frag),
+                  ("fragment-sharded",
+                   resp is not None
+                   and resp.router.get("fragment") is True),
+                  ("frag-plan-journaled", "frag-plan" in events),
+                  ("requeued-journaled", "requeued" in events
+                   and "replica-down" in events),
+                  ("journal-consistent",
+                   check_consistency(entries) == []),
+                  ("read-groups-tile",
+                   obsreport.check_parts_routed(entries) == [])]
+        failed = [n for n, ok in checks if not ok]
+        if "exc" in res2:
+            failed.append(f"({type(res2['exc']).__name__}: "
+                          f"{res2['exc']})")
+        cells.append((names[1],
+                      "pass  requeued, read groups tiled, identical"
+                      if not failed else f"FAIL {' '.join(failed)}"))
+    except Exception as exc:  # noqa: BLE001 — a crashed section is a
+        # red cell, not a crashed grid
+        cells.append((names[1],
+                      f"FAIL crashed ({type(exc).__name__}: {exc})"))
+    finally:
+        if router is not None:
+            router.drain()
+        for proc in procs:
+            if proc.poll() is None:
+                proc.kill()
+            proc.wait(timeout=10)
+    return cells
+
+
 def run_trace_cells(tmp: str) -> list[tuple[str, str]]:
     """The distributed-trace section (serve/router.py trace collection
     + tools/tracereport.py): a TRACED routed job over two real replica
@@ -1113,6 +1322,15 @@ def main() -> int:
         for name, cell in range_cells:
             failures += cell.startswith("FAIL")
             print(f"{name:<{width}}  {cell}", file=sys.stderr)
+        # the fragment-correction section: a poisoned fragment ingest
+        # fails typed while a concurrent contig job survives; kill -9
+        # one of two replicas mid-fragment-job — the requeued read
+        # range must complete byte-identically with the read-group
+        # receipts tiling the read axis exactly once
+        fragment_cells = run_fragment_cells(tmp)
+        for name, cell in fragment_cells:
+            failures += cell.startswith("FAIL")
+            print(f"{name:<{width}}  {cell}", file=sys.stderr)
         # the distributed-trace section: kill -9 under a TRACED routed
         # job — the merged trace must show the requeue and survive
         # tracereport --check with the journal still consistent
@@ -1129,7 +1347,8 @@ def main() -> int:
             print(f"{name:<{width}}  {cell}", file=sys.stderr)
     n_cells = ((len(columns) + 2) * len(rows) + len(audit_cells)
                + len(router_cells) + len(range_cells)
-               + len(trace_cells) + len(preempt_cells))
+               + len(fragment_cells) + len(trace_cells)
+               + len(preempt_cells))
     print(f"[faultcheck] {'FAIL' if failures else 'PASS'}: "
           f"{n_cells - failures}/{n_cells} cells green",
           file=sys.stderr)

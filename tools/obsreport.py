@@ -50,6 +50,20 @@ requeue dedupe bug) or a hole silently dropped from a reassembled
 contig is a red check; whole-contig `part-routed` lines are pinned to
 exactly one per contig per job.
 
+Fragment jobs (`mode: "fragment"`, serve/protocol.py "Fragment jobs")
+stream corrected reads in BOUNDED GROUPS, so their receipts aggregate:
+each `part-streamed` line carries `reads=N` (the corrected reads in
+that group) and `--check` pins the SUM of reads — not the line count —
+against the finished job's `sequences`. Their router twin journals
+`part-routed` lines with `frag_lo`/`frag_hi` read-axis coordinates
+(no contig name), checked with the same tile-from-zero discipline as
+range segments but allowing empty groups (`frag_lo == frag_hi` — a
+group whose reads all dropped still advances the receipt). Admit-time
+ingest annotations (`ingested`, `normalized`, `subsampled`,
+`frag-plan`) render in the owning job's timeline like any annotation;
+`rejected-ingest` is a terminal state (a job refused at admission
+validation never starts).
+
 Fleet elasticity renders alongside the jobs it served: the PR-18
 autoscaler journals `autoscale-up` / `autoscale-down` with no job
 field (a scale decision belongs to the fleet, not one job), so each
@@ -235,10 +249,13 @@ def main(argv=None) -> int:
 def check_parts_streamed(entries: list[dict]) -> list[str]:
     """Streamed-results invariant: a job that `finished` successfully
     with N output sequences must have journaled exactly N
-    `part-streamed` events (one per stitched contig). Jobs whose
-    `finished` line predates the part-streamed era (no `sequences`
-    field) or that never finished are skipped — this is a per-job
-    receipt, not a schema migration."""
+    `part-streamed` events (one per stitched contig). Fragment jobs
+    stream reads in bounded GROUPS: their part-streamed lines carry
+    `reads=N`, and each such line accounts for N output sequences
+    instead of one. Jobs whose `finished` line predates the
+    part-streamed era (no `sequences` field) or that never finished
+    are skipped — this is a per-job receipt, not a schema
+    migration."""
     parts: dict[str, int] = {}
     finished: dict[str, int] = {}
     received: set[str] = set()
@@ -249,7 +266,8 @@ def check_parts_streamed(entries: list[dict]) -> list[str]:
         if e.get("event") == "received":
             received.add(str(job))
         elif e.get("event") == "part-streamed":
-            parts[str(job)] = parts.get(str(job), 0) + 1
+            n = e["reads"] if isinstance(e.get("reads"), int) else 1
+            parts[str(job)] = parts.get(str(job), 0) + n
         elif e.get("event") == "finished" \
                 and isinstance(e.get("sequences"), int):
             finished[str(job)] = e["sequences"]
@@ -279,9 +297,15 @@ def check_parts_routed(entries: list[dict]) -> list[str]:
     because the merge ledger dedupes requeue replays BEFORE journaling;
     a violation means a segment was merged twice or a hole shipped
     inside a reassembled contig. Whole-contig lines (no `lo`) must
-    appear exactly once per contig. Jobs whose `received` line fell out
+    appear exactly once per contig. Fragment-sharded jobs journal
+    read-axis receipts instead (`frag_lo`/`frag_hi`, no contig name):
+    per job, sorted by `frag_lo`, they must tile the read axis from
+    0 — same discipline, different axis (a group whose reads all
+    dropped still advances the receipt, so `reads` may be 0 but the
+    range never runs backwards). Jobs whose `received` line fell out
     of the rotation window are skipped (the shared tolerance)."""
     segs: dict[tuple[str, str], list[tuple[int, int]]] = {}
+    frags: dict[str, list[tuple[int, int]]] = {}
     whole: dict[tuple[str, str], int] = {}
     received: set[str] = set()
     for e in entries:
@@ -295,6 +319,10 @@ def check_parts_routed(entries: list[dict]) -> list[str]:
             if isinstance(e.get("lo"), int) \
                     and isinstance(e.get("hi"), int):
                 segs.setdefault(key, []).append((e["lo"], e["hi"]))
+            elif isinstance(e.get("frag_lo"), int) \
+                    and isinstance(e.get("frag_hi"), int):
+                frags.setdefault(str(job), []).append(
+                    (e["frag_lo"], e["frag_hi"]))
             else:
                 whole[key] = whole.get(key, 0) + 1
     problems: list[str] = []
@@ -308,6 +336,18 @@ def check_parts_routed(entries: list[dict]) -> list[str]:
                 problems.append(
                     f"job {job}: contig {name!r} segments do not tile "
                     f"— got [{lo},{hi}) where window {expect} was due")
+                break
+            expect = hi
+    for job, ranges in sorted(frags.items()):
+        if job not in received:
+            continue
+        ranges.sort()
+        expect = 0
+        for lo, hi in ranges:
+            if lo != expect or hi < lo:
+                problems.append(
+                    f"job {job}: fragment groups do not tile — got "
+                    f"[{lo},{hi}) where read {expect} was due")
                 break
             expect = hi
     for (job, name), n in sorted(whole.items()):

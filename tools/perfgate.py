@@ -292,6 +292,25 @@ def extract(doc: dict, path: str = "<artifact>") -> dict:
         if isinstance(inner.get("mesh"), dict):
             out["mesh"] = inner["mesh"]
         return out
+    if inner.get("mode") == "fragment":
+        # servebench --fragment artifact: fragment-correction jobs/s
+        # through the serve plane, HIGHER is better. No implicit
+        # baseline (the contig wave inside the artifact IS the
+        # comparison) — the fragment block's identity/vs-contig gates
+        # carry the verdict; --fragment-jobs-min adds the absolute
+        # floor; --against another fragment artifact adds the relative
+        # throughput gate.
+        value = _lookup(inner, "fragment.jobs_per_s")
+        if value is None:
+            raise GateError(
+                f"{path}: artifact lacks gated metric "
+                "'fragment.jobs_per_s'")
+        out = {"name": "fragment jobs/s", "value": float(value),
+               "unit": "jobs/sec", "higher_better": True,
+               "kind": "fragment"}
+        if isinstance(inner.get("mesh"), dict):
+            out["mesh"] = inner["mesh"]
+        return out
     if inner.get("mode") == "flood":
         # servebench --flood artifact: gold-tenant p99 under a
         # free-tenant flood with preemption, as a ratio over the idle
@@ -412,6 +431,11 @@ def resolve_baseline(cand: dict, args, candidate_path: str) -> tuple:
         # point; the cache block's absolute gates carry the verdict
         raise GateError("rounds artifact has no implicit baseline "
                         "(use --round2-speedup-min and/or --against)")
+    if cand.get("kind") == "fragment":
+        # the contig wave inside the artifact is the comparison point;
+        # the fragment block's absolute gates carry the verdict
+        raise GateError("fragment artifact has no implicit baseline "
+                        "(use --fragment-jobs-min and/or --against)")
     if cand.get("kind") == "flood":
         # the idle-fabric arm inside the artifact is the comparison
         # point; the qos block's absolute gates carry the verdict
@@ -714,6 +738,53 @@ def cache_checks(doc: dict, args,
     return checks
 
 
+def fragment_checks(doc: dict, args,
+                    candidate_path: str) -> list[tuple[str, bool, str]]:
+    """Fragment-correction gates for servebench --fragment artifacts:
+    (name, ok, detail) triples. Whenever the artifact carries a
+    `fragment` block: `fragment.identical` must be true (the serve
+    fragment path must reproduce the solo kF bytes exactly — serving
+    is a transport, never an answer change), and `fragment.vs_contig_x`
+    must exceed 1 when recorded (fragment jobs are per-read-pile
+    corrections with no contig assembly; a rate at or below the contig
+    wave means the fragment plane added overhead instead of removing
+    work). `--fragment-jobs-min X` additionally gates
+    `fragment.jobs_per_s` >= X, mandatory once requested — an artifact
+    without the key exits 2 naming it."""
+    explicit = args.fragment_jobs_min is not None
+    inner = doc.get("parsed", doc)
+    frag = inner.get("fragment") if isinstance(inner, dict) else None
+    if not isinstance(frag, dict):
+        if explicit:
+            raise GateError(
+                f"{candidate_path}: artifact lacks gated metric "
+                "'fragment.jobs_per_s' (--fragment-jobs-min gates "
+                "servebench --fragment artifacts)")
+        return []
+    identical = bool(frag.get("identical"))
+    checks = [("fragment.identical", identical,
+               "serve fragment FASTA byte-identical to the solo kF run"
+               if identical else
+               "serve fragment FASTA DIVERGED from the solo kF bytes")]
+    vs_contig = frag.get("vs_contig_x")
+    if vs_contig is not None:
+        checks.append(("fragment.vs_contig_x", float(vs_contig) > 1.0,
+                       f"{vs_contig:g} > 1"
+                       + ("" if float(vs_contig) > 1.0 else
+                          " (fragment jobs/s must clear the contig "
+                          "wave's rate)")))
+    if explicit:
+        jps = _lookup(inner, "fragment.jobs_per_s")
+        if jps is None:
+            raise GateError(
+                f"{candidate_path}: artifact lacks gated metric "
+                "'fragment.jobs_per_s'")
+        limit = float(args.fragment_jobs_min)
+        checks.append(("fragment.jobs_per_s", float(jps) >= limit,
+                       f"{jps:g} >= {limit:g}"))
+    return checks
+
+
 def qos_checks(doc: dict, args,
                candidate_path: str) -> list[tuple[str, bool, str]]:
     """Preemptive-QoS gates for servebench --flood artifacts:
@@ -977,6 +1048,11 @@ def run(args) -> int:
             # identity + hit-rate gates (plus --round2-speedup-min)
             # are absolute, no external baseline required
             reference, ref_desc, ref = None, "", None
+        elif cand.get("kind") == "fragment" and not args.against:
+            # fragment artifacts carry the contig wave internally:
+            # identity + vs-contig gates (plus --fragment-jobs-min)
+            # are absolute, no external baseline required
+            reference, ref_desc, ref = None, "", None
         elif cand.get("kind") == "flood" and not args.against:
             # flood artifacts carry the idle arm internally: the qos
             # block's flatness (plus --doomed-abort-min) gates are
@@ -1069,6 +1145,12 @@ def run(args) -> int:
               file=sys.stderr)
     for name, check_ok, detail in cache_checks(doc, args,
                                                candidate_path):
+        failures += 0 if check_ok else 1
+        print(f"[perfgate] {'PASS' if check_ok else 'FAIL'}: "
+              f"{os.path.basename(candidate_path)} {name} ({detail})",
+              file=sys.stderr)
+    for name, check_ok, detail in fragment_checks(doc, args,
+                                                  candidate_path):
         failures += 0 if check_ok else 1
         print(f"[perfgate] {'PASS' if check_ok else 'FAIL'}: "
               f"{os.path.basename(candidate_path)} {name} ({detail})",
@@ -1205,6 +1287,16 @@ def main(argv=None) -> int:
                          "gated on cache.identical, a nonzero "
                          "cache.hit_rate and audit.mismatches == 0 "
                          "whenever those keys are present")
+    ap.add_argument("--fragment-jobs-min", type=float, default=None,
+                    help="absolute floor on fragment-correction "
+                         "throughput (fragment.jobs_per_s, servebench "
+                         "--fragment artifacts); mandatory once passed "
+                         "— an artifact without the key exits 2 naming "
+                         "the dotted key. Fragment artifacts are also "
+                         "always gated on fragment.identical (serve "
+                         "bytes == solo kF bytes) and on "
+                         "fragment.vs_contig_x > 1 whenever those keys "
+                         "are present")
     ap.add_argument("--gold-p99-flat-max", type=float, default=None,
                     help="absolute bound on the flood-mode gold-p99 "
                          "flatness ratio (qos.gold_p99_flat: gold p99 "

@@ -839,6 +839,177 @@ def run_rounds_bench(args, PolishClient, PolishServer) -> int:
     return 0
 
 
+def run_fragment_bench(args, PolishClient, PolishServer) -> int:
+    """`--fragment N`: serve-native fragment error correction (the
+    read-vs-read mode, `mode: "fragment"` on the wire). One warm
+    server, three measurements:
+
+      1. identity: one fragment submit vs a solo kF run on the same
+         files — byte-identical, the gate that makes the throughput
+         numbers meaningful;
+      2. fragment wave: N concurrent fragment jobs, closed loop ->
+         jobs/s, latency percentiles, streamed parts per job (the
+         server runs with a small `frag_group` so every job really
+         streams multiple bounded read groups);
+      3. contig wave: the standard contig workload through the SAME
+         warm server -> the comparison row. Fragment jobs are
+         per-read-pile corrections with no contig assembly, so their
+         jobs/s must land ABOVE the contig rate at a flat p99 — that
+         ratio is the `fragment.vs_contig_x` column.
+
+    Gates (exit status): byte-identity, every wave job completed, and
+    vs_contig_x > 1. The `--json` artifact carries a `fragment` block
+    for tools/perfgate.py (`fragment.identical` whenever the block is
+    present, `--fragment-jobs-min` as the mandatory absolute floor on
+    `fragment.jobs_per_s`)."""
+    from racon_tpu.core.polisher import PolisherType, create_polisher
+    from racon_tpu.serve.queue import nearest_rank
+    from racon_tpu.serve.server import make_fragment_dataset
+
+    n_jobs = max(2, args.fragment)
+    fail: list[str] = []
+    with tempfile.TemporaryDirectory(prefix="racon_fragbench_") as tmp:
+        print(f"[servebench] fragment bench: {n_jobs} fragment jobs "
+              "vs the contig workload, one warm server",
+              file=sys.stderr)
+        frag_dir = os.path.join(tmp, "frag")
+        os.makedirs(frag_dir)
+        frag_paths = make_fragment_dataset(frag_dir)
+        contig_paths = build_dataset(tmp, args.genome_kb,
+                                     args.coverage, args.read_len,
+                                     args.seed, contigs=args.contigs)
+
+        # the solo oracle: same files, same kF parameters the serve
+        # path uses (ServeConfig defaults) — one process, no serving
+        solo_p = create_polisher(*frag_paths, PolisherType.kF, 500,
+                                 10.0, 0.3, num_threads=args.threads)
+        solo_p.initialize()
+        solo = b"".join(b">" + s.name.encode() + b"\n" + s.data + b"\n"
+                        for s in solo_p.polish(True))
+        n_reads = solo.count(b">")
+
+        srv = PolishServer(socket_path=os.path.join(tmp, "serve.sock"),
+                           workers=args.workers, warmup=False,
+                           job_threads=args.threads,
+                           tpu_poa_batches=args.tpupoa_batches,
+                           tpu_aligner_batches=args.tpualigner_batches,
+                           frag_group=8)
+        srv.warmup(paths=contig_paths)
+        srv.start()
+        try:
+            client = PolishClient(socket_path=srv.config.socket_path)
+
+            # ---- identity + streamed decomposition, one warm job each
+            parts: list[dict] = []
+            r = client.submit(*frag_paths, fragment=True,
+                              on_part=parts.append)
+            identical = r.fasta == solo
+            if not identical:
+                fail.append("serve fragment FASTA diverged from the "
+                            "solo kF bytes")
+            client.submit(*contig_paths)  # warm the contig job path too
+
+            def wave(paths, n, label, **kw):
+                lat: list = [None] * n
+                nparts = [0] * n
+
+                def submit(i):
+                    t0 = time.perf_counter()
+
+                    def on_part(_frame, _i=i):
+                        nparts[_i] += 1
+
+                    try:
+                        client.submit(*paths, retries=8,
+                                      on_part=on_part, **kw)
+                    except Exception as exc:
+                        print(f"[servebench] {label} job {i} failed: "
+                              f"{exc}", file=sys.stderr)
+                        return
+                    lat[i] = time.perf_counter() - t0
+
+                threads = [threading.Thread(target=submit, args=(i,))
+                           for i in range(n)]
+                t_start = time.perf_counter()
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join()
+                duration = time.perf_counter() - t_start
+                done = sorted(v for v in lat if v is not None)
+                out = {"jobs": n, "completed": len(done),
+                       "duration_s": round(duration, 3),
+                       "jobs_per_s": round(
+                           len(done) / max(duration, 1e-9), 3),
+                       "parts_per_job": round(
+                           sum(nparts) / max(n, 1), 2)}
+                if done:
+                    out.update(
+                        p50_s=round(nearest_rank(done, 0.50), 4),
+                        p95_s=round(nearest_rank(done, 0.95), 4),
+                        p99_s=round(nearest_rank(done, 0.99), 4))
+                return out
+
+            frag_wave = wave(frag_paths, n_jobs, "fragment",
+                             fragment=True)
+            contig_wave = wave(contig_paths,
+                               max(2, min(n_jobs, args.jobs)),
+                               "contig")
+        finally:
+            srv.drain(timeout=30)
+
+    for label, w in (("fragment", frag_wave), ("contig", contig_wave)):
+        if w["completed"] != w["jobs"]:
+            fail.append(f"{label} wave completed "
+                        f"{w['completed']}/{w['jobs']} jobs")
+    vs_contig = round(frag_wave["jobs_per_s"]
+                      / max(contig_wave["jobs_per_s"], 1e-9), 3)
+    if vs_contig <= 1.0:
+        fail.append(f"fragment jobs/s x{vs_contig:.2f} of contig — "
+                    "must be above 1 (a per-read-pile correction "
+                    "cheaper than contig assembly)")
+
+    print(f"[servebench] fragment identity vs solo kF "
+          f"({n_reads} reads): [{'OK' if identical else 'FAIL'}]",
+          file=sys.stderr)
+    print(f"[servebench] fragment wave: "
+          f"{frag_wave['jobs_per_s']:.2f} jobs/s "
+          f"(p99 {frag_wave.get('p99_s', 0):.2f}s, "
+          f"{frag_wave['parts_per_job']:.1f} parts/job)",
+          file=sys.stderr)
+    print(f"[servebench] contig wave:   "
+          f"{contig_wave['jobs_per_s']:.2f} jobs/s "
+          f"(p99 {contig_wave.get('p99_s', 0):.2f}s) — fragment "
+          f"x{vs_contig:.2f} [{'OK' if vs_contig > 1.0 else 'FAIL'}] "
+          "(perfgate gates fragment.identical / "
+          "--fragment-jobs-min)", file=sys.stderr)
+
+    if args.json:
+        fragment_block = {
+            "identical": identical,
+            "reads": n_reads,
+            "jobs_per_s": frag_wave["jobs_per_s"],
+            "p50_s": frag_wave.get("p50_s"),
+            "p99_s": frag_wave.get("p99_s"),
+            "parts_per_job": frag_wave["parts_per_job"],
+            "vs_contig_x": vs_contig,
+            "wave": frag_wave,
+            "contig": contig_wave,
+        }
+        artifact = {"mode": "fragment", "jobs": n_jobs,
+                    "fragment": fragment_block, "pass": not fail}
+        with open(args.json, "w") as fh:
+            json.dump(artifact, fh, indent=2, sort_keys=True)
+        print(f"[servebench] wrote {args.json}", file=sys.stderr)
+
+    if fail:
+        for f in fail:
+            print(f"[servebench] FAIL: {f}", file=sys.stderr)
+        return 1
+    print("[servebench] PASS", file=sys.stderr)
+    return 0
+
+
 def run_flood_bench(args, PolishClient, PolishServer) -> int:
     """`--flood N`: preemptive-QoS isolation under load. Two warm
     replicas behind the shard-aware router; N free-tenant submitter
@@ -1469,6 +1640,17 @@ def main(argv=None) -> int:
                          "`rounds` / `cache` blocks that "
                          "tools/perfgate.py gates via cache.identical "
                          "and --round2-speedup-min")
+    ap.add_argument("--fragment", type=int, default=None,
+                    help="fragment bench mode: run this many "
+                         "concurrent serve-native fragment-correction "
+                         "jobs (mode: fragment — corrected reads out, "
+                         "no contig assembly) on one warm server, "
+                         "gated byte-identical to a solo kF run, plus "
+                         "a contig comparison wave — the artifact "
+                         "gains a `fragment` block (jobs_per_s, p99, "
+                         "parts_per_job, vs_contig_x, identical) that "
+                         "tools/perfgate.py gates via "
+                         "fragment.identical and --fragment-jobs-min")
     ap.add_argument("--flood", type=int, default=None,
                     help="flood bench mode: this many free-tenant "
                          "submitter threads flood a 2-replica routed "
@@ -1576,6 +1758,9 @@ def main(argv=None) -> int:
 
     if args.rounds is not None:
         return run_rounds_bench(args, PolishClient, PolishServer)
+
+    if args.fragment is not None:
+        return run_fragment_bench(args, PolishClient, PolishServer)
 
     if args.flood is not None:
         return run_flood_bench(args, PolishClient, PolishServer)
